@@ -1,0 +1,12 @@
+"""karpenter_tpu: a TPU-native node-autoscaling framework with the capabilities
+of sigs.k8s.io/karpenter.
+
+The provisioning bin-packing solver and the disruption (consolidation) search —
+the reference's two compute-heavy kernels — run as jit-compiled JAX tensor
+programs on TPU (see karpenter_tpu.ops). The surrounding control plane (cluster
+state, lifecycle, termination, budgets, observability) is a standalone Python
+runtime over an in-memory watchable object store (see karpenter_tpu.controllers,
+karpenter_tpu.operator).
+"""
+
+__version__ = "0.1.0"

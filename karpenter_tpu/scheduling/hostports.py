@@ -1,0 +1,62 @@
+"""Per-node host-port conflict tracking.
+
+Mirrors /root/reference/pkg/scheduling/hostportusage.go:34-113: a port entry
+conflicts when (ip equal, or either side binds 0.0.0.0) and port+protocol match.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..api.objects import HostPort, Pod
+
+_WILDCARD = "0.0.0.0"
+
+
+@dataclass(frozen=True)
+class _Entry:
+    pod_uid: str
+    ip: str
+    port: int
+    protocol: str
+
+    def conflicts(self, other: "_Entry") -> bool:
+        if self.port != other.port or self.protocol != other.protocol:
+            return False
+        return self.ip == other.ip or self.ip == _WILDCARD or other.ip == _WILDCARD
+
+
+def get_host_ports(pod: Pod) -> "list[_Entry]":
+    out = []
+    for hp in pod.spec.host_ports:
+        ip = hp.host_ip or _WILDCARD
+        out.append(_Entry(pod_uid=pod.uid, ip=ip, port=hp.port, protocol=hp.protocol))
+    return out
+
+
+class HostPortUsage:
+    __slots__ = ("_entries",)
+
+    def __init__(self):
+        self._entries: List[_Entry] = []
+
+    def conflicts(self, pod: Pod, ports: "list[_Entry]") -> "list[str]":
+        errs = []
+        for p in ports:
+            for existing in self._entries:
+                if p.conflicts(existing):
+                    errs.append(
+                        f"port {p.port}/{p.protocol} on ip {p.ip} conflicts with existing usage")
+        return errs
+
+    def add(self, pod: Pod, ports: "list[_Entry]") -> None:
+        self._entries.extend(ports)
+
+    def delete_pod(self, pod_uid: str) -> None:
+        self._entries = [e for e in self._entries if e.pod_uid != pod_uid]
+
+    def copy(self) -> "HostPortUsage":
+        out = HostPortUsage()
+        out._entries = list(self._entries)
+        return out

@@ -1,0 +1,71 @@
+from karpenter_tpu.utils import quantity as q
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.api.objects import Pod
+
+
+def test_parse_plain():
+    assert q.parse("1") == 1000
+    assert q.parse(2) == 2000
+    assert q.parse("100m") == 100
+    assert q.parse("1500m") == 1500
+    assert q.parse("0") == 0
+
+
+def test_parse_binary_suffixes():
+    assert q.parse("1Ki") == 1024 * 1000
+    assert q.parse("1Gi") == 1024**3 * 1000
+    assert q.parse("20Gi") == 20 * 1024**3 * 1000
+
+
+def test_parse_decimal_suffixes():
+    assert q.parse("1k") == 1000 * 1000
+    assert q.parse("1M") == 10**6 * 1000
+    assert q.parse("1.5") == 1500
+
+
+def test_parse_fractional_exact():
+    # 3 x 100m must exactly equal 300m (float would drift)
+    total = sum([q.parse("100m")] * 3)
+    assert total == q.parse("300m")
+
+
+def test_format_roundtrip():
+    assert q.format_milli(q.parse("1500m")) == "1500m"
+    assert q.format_milli(q.parse("2")) == "2"
+
+
+def test_fits():
+    reqs = res.parse_list({"cpu": "1", "memory": "1Gi"})
+    avail = res.parse_list({"cpu": "2", "memory": "2Gi", "pods": "10"})
+    assert res.fits(reqs, avail)
+    assert not res.fits(res.parse_list({"cpu": "3"}), avail)
+    # zero-valued requests fit even when resource missing from available
+    assert res.fits({"gpu": 0}, avail)
+    # exact boundary fits
+    assert res.fits(res.parse_list({"cpu": "2"}), avail)
+    assert not res.fits({"cpu": 2001}, avail)
+
+
+def test_subtract_and_exceeds():
+    a = res.parse_list({"cpu": "4"})
+    b = res.parse_list({"cpu": "1", "memory": "1Gi"})
+    d = res.subtract(a, b)
+    assert d["cpu"] == 3000
+    assert d["memory"] < 0
+    assert res.exceeds({"cpu": 5000}, res.parse_list({"cpu": "4"})) == ["cpu"]
+    assert res.exceeds({"cpu": 4000}, res.parse_list({"cpu": "4"})) == []
+
+
+def test_pod_requests_includes_pod_slot():
+    p = Pod(container_requests=[res.parse_list({"cpu": "100m"}), res.parse_list({"cpu": "200m"})])
+    r = p.requests()
+    assert r["cpu"] == 300
+    assert r[res.PODS] == 1000
+
+
+def test_pod_requests_init_containers_max():
+    p = Pod(
+        container_requests=[res.parse_list({"cpu": "100m"})],
+        init_container_requests=[res.parse_list({"cpu": "1"})],
+    )
+    assert p.requests()["cpu"] == 1000

@@ -1,0 +1,211 @@
+"""Constraint-algebra semantics tests.
+
+Scenario behaviors match /root/reference/pkg/scheduling/{requirement,requirements}.go,
+including the complement/NotIn corner cases at requirements.go:283-304.
+"""
+
+import pytest
+from hypothesis import given, strategies as st
+
+from karpenter_tpu.scheduling.requirement import (
+    DOES_NOT_EXIST, EXISTS, GT, IN, INF, LT, NOT_IN, Requirement)
+from karpenter_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN, Requirements, label_requirements, pod_requirements,
+    strict_pod_requirements)
+from karpenter_tpu.api.objects import (
+    Affinity, NodeAffinity, NodeSelectorRequirement, NodeSelectorTerm, Pod, PodSpec,
+    PreferredSchedulingTerm)
+
+
+def R(key, op, *values, **kw):
+    return Requirement(key, op, values, **kw)
+
+
+class TestRequirement:
+    def test_operators(self):
+        assert R("k", IN, "a").operator() == IN
+        assert R("k", IN).operator() == DOES_NOT_EXIST
+        assert R("k", NOT_IN, "a").operator() == NOT_IN
+        assert R("k", EXISTS).operator() == EXISTS
+        assert R("k", GT, "5").operator() == EXISTS
+        assert R("k", LT, "5").operator() == EXISTS
+
+    def test_has(self):
+        assert R("k", IN, "a", "b").has("a")
+        assert not R("k", IN, "a").has("c")
+        assert R("k", NOT_IN, "a").has("b")
+        assert not R("k", NOT_IN, "a").has("a")
+        assert R("k", EXISTS).has("anything")
+        assert not R("k", DOES_NOT_EXIST).has("anything")
+        assert R("k", GT, "5").has("6")
+        assert not R("k", GT, "5").has("5")
+        assert not R("k", GT, "5").has("abc")  # non-integer invalid under bounds
+        assert R("k", LT, "5").has("4")
+        assert not R("k", LT, "5").has("5")
+
+    def test_length(self):
+        assert R("k", IN, "a", "b").length() == 2
+        assert R("k", DOES_NOT_EXIST).length() == 0
+        assert R("k", EXISTS).length() == INF
+        assert R("k", NOT_IN, "a").length() == INF - 1
+
+    def test_intersection_in_in(self):
+        r = R("k", IN, "a", "b").intersection(R("k", IN, "b", "c"))
+        assert r.operator() == IN and r.values == {"b"}
+
+    def test_intersection_in_notin(self):
+        r = R("k", IN, "a", "b").intersection(R("k", NOT_IN, "a"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_intersection_notin_notin(self):
+        r = R("k", NOT_IN, "a").intersection(R("k", NOT_IN, "b"))
+        assert r.complement and r.values == {"a", "b"}
+        assert r.operator() == NOT_IN
+
+    def test_intersection_exists_in(self):
+        r = R("k", EXISTS).intersection(R("k", IN, "a"))
+        assert r.operator() == IN and r.values == {"a"}
+
+    def test_intersection_gt_lt_crossed(self):
+        r = R("k", GT, "5").intersection(R("k", LT, "3"))
+        assert r.operator() == DOES_NOT_EXIST
+        assert r.length() == 0
+
+    def test_intersection_gt_lt_window(self):
+        r = R("k", GT, "1").intersection(R("k", LT, "5"))
+        assert r.has("2") and r.has("4")
+        assert not r.has("1") and not r.has("5")
+        assert r.length() == INF  # complement set remains "infinite"
+
+    def test_intersection_bounds_filter_values(self):
+        r = R("k", IN, "1", "7").intersection(R("k", GT, "5"))
+        assert r.values == {"7"} and not r.complement
+        # concrete results drop bounds (requirement.go:183-186)
+        assert r.greater_than is None
+
+    def test_intersection_equal_bound_crossed(self):
+        r = R("k", GT, "5").intersection(R("k", LT, "5"))
+        assert r.operator() == DOES_NOT_EXIST
+        r2 = R("k", GT, "4").intersection(R("k", LT, "6"))
+        assert r2.has("5")
+
+    def test_min_values_propagates(self):
+        a = Requirement("k", IN, ["a", "b"], min_values=2)
+        b = R("k", EXISTS)
+        assert a.intersection(b).min_values == 2
+        assert b.intersection(a).min_values == 2
+
+    def test_normalized_label_alias(self):
+        r = R("beta.kubernetes.io/arch", IN, "amd64")
+        assert r.key == "kubernetes.io/arch"
+
+    @given(
+        st.sets(st.sampled_from("abcdef"), max_size=4),
+        st.sets(st.sampled_from("abcdef"), max_size=4),
+        st.booleans(), st.booleans(),
+    )
+    def test_intersection_membership_property(self, va, vb, ca, cb):
+        """intersection(a,b).has(v) == a.has(v) and b.has(v) for all probe values."""
+        a = Requirement._raw("k", ca, set(va))
+        b = Requirement._raw("k", cb, set(vb))
+        inter = a.intersection(b)
+        for v in "abcdefgh":
+            assert inter.has(v) == (a.has(v) and b.has(v))
+
+
+class TestRequirements:
+    def test_add_intersects_per_key(self):
+        reqs = Requirements([R("k", IN, "a", "b")])
+        reqs.add(R("k", IN, "b", "c"))
+        assert reqs.get("k").values == {"b"}
+
+    def test_get_undefined_is_exists(self):
+        assert Requirements().get("missing").operator() == EXISTS
+
+    def test_intersects_ok(self):
+        a = Requirements([R("zone", IN, "z1", "z2")])
+        b = Requirements([R("zone", IN, "z2", "z3")])
+        assert a.intersects(b) == []
+
+    def test_intersects_disjoint_fails(self):
+        a = Requirements([R("zone", IN, "z1")])
+        b = Requirements([R("zone", IN, "z2")])
+        assert a.intersects(b)
+
+    def test_intersects_both_notin_exempt(self):
+        # NotIn vs NotIn with empty intersection of their concrete views is allowed
+        a = Requirements([R("k", DOES_NOT_EXIST)])
+        b = Requirements([R("k", NOT_IN, "x")])
+        assert a.intersects(b) == []
+
+    def test_intersects_dne_vs_in_fails(self):
+        a = Requirements([R("k", DOES_NOT_EXIST)])
+        b = Requirements([R("k", IN, "x")])
+        assert a.intersects(b)
+
+    def test_intersects_exists_vs_dne_fails(self):
+        # existing Exists is NOT exempt even though intersection is empty
+        a = Requirements([R("k", EXISTS)])
+        b = Requirements([R("k", DOES_NOT_EXIST)])
+        assert a.intersects(b)
+
+    def test_intersects_undefined_keys_allowed(self):
+        a = Requirements([R("zone", IN, "z1")])
+        b = Requirements([R("other", IN, "v")])
+        assert a.intersects(b) == []
+
+    def test_compatible_custom_label_undefined_denied(self):
+        node = Requirements([R("zone", IN, "z1")])
+        pod = Requirements([R("team", IN, "infra")])
+        assert node.compatible(pod)  # custom label undefined on node side -> error
+
+    def test_compatible_well_known_undefined_allowed(self):
+        node = Requirements()
+        pod = Requirements([R("topology.kubernetes.io/zone", IN, "z1")])
+        assert node.compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN) == []
+        assert node.compatible(pod)  # without the allowance it is denied
+
+    def test_compatible_notin_undefined_allowed(self):
+        node = Requirements()
+        pod = Requirements([R("team", NOT_IN, "infra")])
+        assert node.compatible(pod) == []
+
+    def test_labels_representative(self):
+        reqs = Requirements([R("zone", IN, "z1"), R("kubernetes.io/hostname", IN, "h1")])
+        labels = reqs.labels()
+        assert labels["zone"] == "z1"
+        assert "kubernetes.io/hostname" not in labels  # restricted
+
+
+class TestPodRequirements:
+    def _pod(self, selector=None, required=None, preferred=None):
+        na = None
+        if required or preferred:
+            na = NodeAffinity(
+                required_terms=[NodeSelectorTerm(match_expressions=tuple(required))] if required else [],
+                preferred=preferred or [],
+            )
+        return Pod(spec=PodSpec(
+            node_selector=selector or {},
+            affinity=Affinity(node_affinity=na) if na else None,
+        ))
+
+    def test_node_selector(self):
+        pod = self._pod(selector={"zone": "z1"})
+        reqs = pod_requirements(pod)
+        assert reqs.get("zone").values == {"z1"}
+
+    def test_first_required_term_only(self):
+        pod = self._pod(required=[NodeSelectorRequirement("zone", IN, ("z1",))])
+        assert pod_requirements(pod).get("zone").values == {"z1"}
+
+    def test_heaviest_preference_treated_required(self):
+        pod = self._pod(preferred=[
+            PreferredSchedulingTerm(1, NodeSelectorTerm(
+                match_expressions=(NodeSelectorRequirement("zone", IN, ("z1",)),))),
+            PreferredSchedulingTerm(10, NodeSelectorTerm(
+                match_expressions=(NodeSelectorRequirement("zone", IN, ("z2",)),))),
+        ])
+        assert pod_requirements(pod).get("zone").values == {"z2"}
+        # strict requirements exclude preferences entirely
+        assert "zone" not in strict_pod_requirements(pod)

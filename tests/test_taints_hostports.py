@@ -1,0 +1,64 @@
+from karpenter_tpu.api.objects import HostPort, Pod, PodSpec, Taint, Toleration
+from karpenter_tpu.scheduling import taints as st
+from karpenter_tpu.scheduling.hostports import HostPortUsage, get_host_ports
+
+
+def test_tolerates_exact():
+    taint = Taint(key="team", value="infra", effect="NoSchedule")
+    pod = Pod(spec=PodSpec(tolerations=[Toleration(key="team", operator="Equal", value="infra", effect="NoSchedule")]))
+    assert st.tolerates([taint], pod) == []
+
+
+def test_tolerates_exists_operator():
+    taint = Taint(key="team", value="infra", effect="NoSchedule")
+    pod = Pod(spec=PodSpec(tolerations=[Toleration(key="team", operator="Exists")]))
+    assert st.tolerates([taint], pod) == []
+
+
+def test_tolerates_empty_key_exists_tolerates_all():
+    pod = Pod(spec=PodSpec(tolerations=[Toleration(operator="Exists")]))
+    assert st.tolerates([Taint(key="a"), Taint(key="b", effect="NoExecute")], pod) == []
+
+
+def test_not_tolerated():
+    pod = Pod()
+    assert len(st.tolerates([Taint(key="team", value="infra")], pod)) == 1
+
+
+def test_effect_mismatch():
+    taint = Taint(key="k", effect="NoExecute")
+    pod = Pod(spec=PodSpec(tolerations=[Toleration(key="k", operator="Exists", effect="NoSchedule")]))
+    assert st.tolerates([taint], pod)
+
+
+def test_merge_dedups_by_key_effect():
+    merged = st.merge([Taint(key="a")], [Taint(key="a", value="different"), Taint(key="b")])
+    assert len(merged) == 2
+
+
+def test_hostport_conflict_wildcard():
+    usage = HostPortUsage()
+    p1 = Pod(spec=PodSpec(host_ports=[HostPort(port=8080)]))
+    ports1 = get_host_ports(p1)
+    assert usage.conflicts(p1, ports1) == []
+    usage.add(p1, ports1)
+    p2 = Pod(spec=PodSpec(host_ports=[HostPort(port=8080, host_ip="10.0.0.1")]))
+    assert usage.conflicts(p2, get_host_ports(p2))  # wildcard vs specific ip conflicts
+
+
+def test_hostport_distinct_ips_no_conflict():
+    usage = HostPortUsage()
+    p1 = Pod(spec=PodSpec(host_ports=[HostPort(port=8080, host_ip="10.0.0.1")]))
+    usage.add(p1, get_host_ports(p1))
+    p2 = Pod(spec=PodSpec(host_ports=[HostPort(port=8080, host_ip="10.0.0.2")]))
+    assert usage.conflicts(p2, get_host_ports(p2)) == []
+    p3 = Pod(spec=PodSpec(host_ports=[HostPort(port=8080, host_ip="10.0.0.1")]))
+    assert usage.conflicts(p3, get_host_ports(p3))
+
+
+def test_hostport_protocol_disambiguates():
+    usage = HostPortUsage()
+    p1 = Pod(spec=PodSpec(host_ports=[HostPort(port=53, protocol="TCP")]))
+    usage.add(p1, get_host_ports(p1))
+    p2 = Pod(spec=PodSpec(host_ports=[HostPort(port=53, protocol="UDP")]))
+    assert usage.conflicts(p2, get_host_ports(p2)) == []
