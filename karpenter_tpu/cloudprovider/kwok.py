@@ -1,0 +1,167 @@
+"""kwok-style synthetic instance-type catalog and simulated cloud provider.
+
+Catalog mirrors /root/reference/kwok/tools/gen_instance_types.go:52-113:
+144 instance types (12 cpu sizes x 3 memory factors x 2 OS x 2 arch), each with
+8 offerings (4 zones x {spot, on-demand}); price = 0.025/vCPU + 0.001/GiB,
+spot = 0.7x. The provider fabricates Node objects directly, the way the kwok
+provider does (kwok/cloudprovider/cloudprovider.go:53-64,143-191).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim
+from ..api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, Taint
+from ..scheduling.requirement import IN, Requirement
+from ..scheduling.requirements import Requirements, node_selector_requirements
+from ..scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
+from ..utils import resources as res
+from .types import (CloudProvider, InstanceType, InstanceTypeOverhead, NodeClaimNotFoundError,
+                    Offering, Offerings, order_by_price)
+
+KWOK_ZONES = ["test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d"]
+KWOK_REGION = "test-region"
+_CPU_SIZES = [1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256]
+_MEM_FACTORS = [2, 4, 8]
+_OSES = ["linux", "windows"]
+_ARCHES = [api_labels.ARCHITECTURE_AMD64, api_labels.ARCHITECTURE_ARM64]
+_FAMILY = {2: "c", 4: "s", 8: "m"}
+
+GROUP_INSTANCE_SIZE = "karpenter.kwok.sh/instance-size"
+GROUP_INSTANCE_FAMILY = "karpenter.kwok.sh/instance-family"
+
+
+def price_for(cpu: int, mem_gib: int) -> float:
+    return 0.025 * cpu + 0.001 * mem_gib
+
+
+def instance_type_name(cpu: int, mem_factor: int, arch: str, os: str) -> str:
+    return f"{_FAMILY.get(mem_factor, 'e')}-{cpu}x-{arch}-{os}"
+
+
+def make_instance_type(cpu: int, mem_factor: int, arch: str, os: str,
+                       zones: Optional[List[str]] = None) -> InstanceType:
+    zones = zones if zones is not None else KWOK_ZONES
+    name = instance_type_name(cpu, mem_factor, arch, os)
+    mem_gib = cpu * mem_factor
+    pods = min(cpu * 16, 1024)
+    capacity = res.parse_list({
+        res.CPU: str(cpu),
+        res.MEMORY: f"{mem_gib}Gi",
+        res.PODS: str(pods),
+        res.EPHEMERAL_STORAGE: "20Gi",
+    })
+    price = price_for(cpu, mem_gib)
+    offerings = Offerings()
+    for zone in zones:
+        for ct in (api_labels.CAPACITY_TYPE_SPOT, api_labels.CAPACITY_TYPE_ON_DEMAND):
+            offerings.append(Offering(
+                requirements=Requirements([
+                    Requirement(api_labels.CAPACITY_TYPE_LABEL_KEY, IN, [ct]),
+                    Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [zone]),
+                ]),
+                price=price * 0.7 if ct == api_labels.CAPACITY_TYPE_SPOT else price,
+                available=True,
+            ))
+    # Requirements must be defined for every well-known label (types.go:89-91).
+    requirements = Requirements([
+        Requirement(api_labels.LABEL_INSTANCE_TYPE, IN, [name]),
+        Requirement(api_labels.LABEL_ARCH, IN, [arch]),
+        Requirement(api_labels.LABEL_OS, IN, [os]),
+        Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, zones),
+        Requirement(api_labels.LABEL_TOPOLOGY_REGION, IN, [KWOK_REGION]),
+        Requirement(api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+                    [api_labels.CAPACITY_TYPE_SPOT, api_labels.CAPACITY_TYPE_ON_DEMAND]),
+        Requirement(GROUP_INSTANCE_SIZE, IN, [f"{cpu}x"]),
+        Requirement(GROUP_INSTANCE_FAMILY, IN, [_FAMILY.get(mem_factor, "e")]),
+    ])
+    return InstanceType(
+        name=name, requirements=requirements, offerings=offerings, capacity=capacity,
+        overhead=InstanceTypeOverhead(
+            kube_reserved=res.parse_list({res.CPU: "100m", res.MEMORY: "120Mi"})),
+    )
+
+
+def construct_instance_types(zones: Optional[List[str]] = None) -> "list[InstanceType]":
+    return [make_instance_type(cpu, mf, arch, os, zones)
+            for cpu in _CPU_SIZES for mf in _MEM_FACTORS for os in _OSES for arch in _ARCHES]
+
+
+class KwokCloudProvider(CloudProvider):
+    """Simulated fleet: Create() fabricates a Node with the unregistered taint;
+    a store (if attached) receives the Node so informers/kubelet-sim can see it."""
+
+    def __init__(self, instance_types: Optional[List[InstanceType]] = None, store=None):
+        self._instance_types = instance_types if instance_types is not None else construct_instance_types()
+        self._seq = itertools.count(1)
+        self.store = store  # optional in-memory kube store
+        self.created: dict = {}  # provider_id -> (NodeClaim, Node)
+
+    @property
+    def name(self) -> str:
+        return "kwok"
+
+    def create(self, nodeclaim: NodeClaim) -> NodeClaim:
+        reqs = node_selector_requirements(nodeclaim.spec.requirements)
+        compatible = [it for it in self._instance_types
+                      if not it.requirements.intersects(reqs)
+                      and res.fits(nodeclaim.spec.resources_requests, it.allocatable())
+                      and it.offerings.available().has_compatible(reqs)]
+        if not compatible:
+            raise NodeClaimNotFoundError(f"no instance type satisfied {nodeclaim.name}")
+        it = order_by_price(compatible, reqs)[0]
+        offering = it.offerings.available().compatible(reqs).cheapest()
+        n = next(self._seq)
+        provider_id = f"kwok://node-{n:05d}"
+        node_name = f"kwok-node-{n:05d}"
+        labels = dict(nodeclaim.metadata.labels)
+        labels.update(reqs.labels())
+        labels[api_labels.LABEL_INSTANCE_TYPE] = it.name
+        labels[api_labels.LABEL_TOPOLOGY_ZONE] = offering.zone
+        labels[api_labels.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type
+        labels[api_labels.LABEL_HOSTNAME] = node_name
+        node = Node(
+            metadata=ObjectMeta(name=node_name, labels=labels,
+                                annotations=dict(nodeclaim.metadata.annotations)),
+            spec=NodeSpec(
+                provider_id=provider_id,
+                taints=list(nodeclaim.spec.taints) + list(nodeclaim.spec.startup_taints)
+                + [UNREGISTERED_NO_EXECUTE_TAINT],
+            ),
+            status=NodeStatus(capacity=dict(it.capacity), allocatable=dict(it.allocatable())),
+        )
+        nodeclaim.status.provider_id = provider_id
+        nodeclaim.status.capacity = dict(it.capacity)
+        nodeclaim.status.allocatable = dict(it.allocatable())
+        nodeclaim.status.image_id = "kwok-image"
+        self.created[provider_id] = (nodeclaim, node)
+        if self.store is not None:
+            self.store.create(node)
+        return nodeclaim
+
+    def delete(self, nodeclaim: NodeClaim) -> None:
+        pid = nodeclaim.status.provider_id
+        if pid not in self.created:
+            raise NodeClaimNotFoundError(pid or nodeclaim.name)
+        del self.created[pid]
+        if self.store is not None:
+            node = self.store.get(Node, nodeclaim.status.node_name)
+            if node is not None:
+                self.store.delete(node)
+
+    def get(self, provider_id: str) -> NodeClaim:
+        if provider_id not in self.created:
+            raise NodeClaimNotFoundError(provider_id)
+        return self.created[provider_id][0]
+
+    def list(self) -> "list[NodeClaim]":
+        return [nc for nc, _ in self.created.values()]
+
+    def get_instance_types(self, nodepool) -> "list[InstanceType]":
+        return list(self._instance_types)
+
+    def is_drifted(self, nodeclaim) -> str:
+        return ""

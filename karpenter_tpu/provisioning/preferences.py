@@ -1,0 +1,79 @@
+"""Preference relaxation ladder.
+
+Mirrors /root/reference/pkg/controllers/provisioning/scheduling/preferences.go:38-57:
+drop one rung per failed attempt, in order: required node-affinity term (when >1,
+OR semantics) -> heaviest preferred pod-affinity -> heaviest preferred pod-anti-
+affinity -> heaviest preferred node-affinity -> a ScheduleAnyway spread ->
+tolerate PreferNoSchedule taints (only when some pool carries such a taint).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.objects import PREFER_NO_SCHEDULE, Pod, SCHEDULE_ANYWAY, Toleration
+
+
+class Preferences:
+    def __init__(self, tolerate_prefer_no_schedule: bool = False):
+        self.tolerate_prefer_no_schedule = tolerate_prefer_no_schedule
+
+    def relax(self, pod: Pod) -> bool:
+        relaxations = [
+            self._remove_required_node_affinity_term,
+            self._remove_preferred_pod_affinity_term,
+            self._remove_preferred_pod_anti_affinity_term,
+            self._remove_preferred_node_affinity_term,
+            self._remove_schedule_anyway_spread,
+        ]
+        if self.tolerate_prefer_no_schedule:
+            relaxations.append(self._tolerate_prefer_no_schedule_taints)
+        for fn in relaxations:
+            if fn(pod) is not None:
+                return True
+        return False
+
+    def _remove_required_node_affinity_term(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity
+        if aff is None or aff.node_affinity is None or len(aff.node_affinity.required_terms) <= 1:
+            return None
+        removed = aff.node_affinity.required_terms.pop(0)
+        return f"removed required node affinity term {removed}"
+
+    def _remove_preferred_node_affinity_term(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity
+        if aff is None or aff.node_affinity is None or not aff.node_affinity.preferred:
+            return None
+        aff.node_affinity.preferred.sort(key=lambda t: -t.weight)
+        removed = aff.node_affinity.preferred.pop(0)
+        return f"removed preferred node affinity term {removed}"
+
+    def _remove_preferred_pod_affinity_term(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity
+        if aff is None or aff.pod_affinity is None or not aff.pod_affinity.preferred:
+            return None
+        aff.pod_affinity.preferred.sort(key=lambda t: -t.weight)
+        removed = aff.pod_affinity.preferred.pop(0)
+        return f"removed preferred pod affinity term {removed}"
+
+    def _remove_preferred_pod_anti_affinity_term(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity
+        if aff is None or aff.pod_anti_affinity is None or not aff.pod_anti_affinity.preferred:
+            return None
+        aff.pod_anti_affinity.preferred.sort(key=lambda t: -t.weight)
+        removed = aff.pod_anti_affinity.preferred.pop(0)
+        return f"removed preferred pod anti-affinity term {removed}"
+
+    def _remove_schedule_anyway_spread(self, pod: Pod) -> Optional[str]:
+        for i, tsc in enumerate(pod.spec.topology_spread_constraints):
+            if tsc.when_unsatisfiable == SCHEDULE_ANYWAY:
+                pod.spec.topology_spread_constraints.pop(i)
+                return f"removed ScheduleAnyway spread on {tsc.topology_key}"
+        return None
+
+    def _tolerate_prefer_no_schedule_taints(self, pod: Pod) -> Optional[str]:
+        tol = Toleration(operator="Exists", effect=PREFER_NO_SCHEDULE)
+        if tol in pod.spec.tolerations:
+            return None
+        pod.spec.tolerations = list(pod.spec.tolerations) + [tol]
+        return "added toleration for PreferNoSchedule taints"

@@ -1,0 +1,103 @@
+"""Object factories for tests, in the spirit of /root/reference/pkg/test
+(test.Pod(test.PodOptions{...}) etc.)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodepool import (NodeClaimTemplate, NodeClaimTemplateSpec, NodePool,
+                                        NodePoolSpec)
+from karpenter_tpu.api.objects import (Affinity, LabelSelector, NodeAffinity,
+                                       NodeSelectorRequirement, NodeSelectorTerm, ObjectMeta,
+                                       Pod, PodAffinity, PodAffinityTerm, PodSpec,
+                                       PreferredSchedulingTerm, TopologySpreadConstraint,
+                                       WeightedPodAffinityTerm)
+from karpenter_tpu.provisioning.domains import build_topology_domains
+from karpenter_tpu.provisioning.scheduler import Scheduler
+from karpenter_tpu.provisioning.topology import ClusterView, Topology
+from karpenter_tpu.utils import resources as res
+
+_seq = itertools.count(1)
+
+
+def make_pod(cpu="100m", memory="128Mi", labels=None, node_selector=None,
+             tolerations=None, spread=None, required_affinity=None,
+             preferred_affinity=None, pod_affinity=None, pod_anti_affinity=None,
+             preferred_pod_affinity=None, preferred_pod_anti_affinity=None,
+             namespace="default", name=None, host_ports=None) -> Pod:
+    affinity = None
+    na = None
+    if required_affinity or preferred_affinity:
+        na = NodeAffinity(
+            required_terms=[NodeSelectorTerm(match_expressions=tuple(term))
+                            for term in (required_affinity or [])],
+            preferred=[PreferredSchedulingTerm(w, NodeSelectorTerm(match_expressions=tuple(t)))
+                       for w, t in (preferred_affinity or [])])
+    pa = None
+    if pod_affinity or preferred_pod_affinity:
+        pa = PodAffinity(required=list(pod_affinity or []),
+                         preferred=[WeightedPodAffinityTerm(w, t)
+                                    for w, t in (preferred_pod_affinity or [])])
+    paa = None
+    if pod_anti_affinity or preferred_pod_anti_affinity:
+        paa = PodAffinity(required=list(pod_anti_affinity or []),
+                          preferred=[WeightedPodAffinityTerm(w, t)
+                                     for w, t in (preferred_pod_anti_affinity or [])])
+    if na or pa or paa:
+        affinity = Affinity(node_affinity=na, pod_affinity=pa, pod_anti_affinity=paa)
+    return Pod(
+        metadata=ObjectMeta(name=name or f"pod-{next(_seq):04d}", namespace=namespace,
+                            labels=dict(labels or {})),
+        spec=PodSpec(node_selector=dict(node_selector or {}),
+                     tolerations=list(tolerations or []),
+                     topology_spread_constraints=list(spread or []),
+                     affinity=affinity,
+                     host_ports=list(host_ports or [])),
+        container_requests=[res.parse_list({"cpu": cpu, "memory": memory})])
+
+
+def make_pods(n, **kw):
+    return [make_pod(**kw) for _ in range(n)]
+
+
+def make_nodepool(name="default", requirements=(), taints=(), startup_taints=(),
+                  labels=None, limits=None, weight=None) -> NodePool:
+    return NodePool(
+        metadata=ObjectMeta(name=name),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplate(
+                metadata_labels=dict(labels or {}),
+                spec=NodeClaimTemplateSpec(
+                    requirements=list(requirements), taints=list(taints),
+                    startup_taints=list(startup_taints))),
+            limits=res.parse_list(limits) if limits else {},
+            weight=weight))
+
+
+def spread_zone(max_skew=1, key="app", value="demo"):
+    return TopologySpreadConstraint(
+        topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=max_skew,
+        label_selector=LabelSelector(match_labels={key: value}))
+
+
+def spread_hostname(max_skew=1, key="app", value="demo"):
+    return TopologySpreadConstraint(
+        topology_key=api_labels.LABEL_HOSTNAME, max_skew=max_skew,
+        label_selector=LabelSelector(match_labels={key: value}))
+
+
+def affinity_term(topology_key, key="app", value="demo"):
+    return PodAffinityTerm(topology_key=topology_key,
+                           label_selector=LabelSelector(match_labels={key: value}))
+
+
+def make_scheduler(nodepools, instance_types, pods, state_nodes=(), daemonset_pods=(),
+                   cluster: Optional[ClusterView] = None) -> Scheduler:
+    if not isinstance(instance_types, dict):
+        instance_types = {np.name: list(instance_types) for np in nodepools}
+    domains = build_topology_domains(nodepools, instance_types)
+    topo = Topology(cluster or ClusterView(), domains, pods)
+    return Scheduler(nodepools, instance_types, topo,
+                     state_nodes=state_nodes, daemonset_pods=daemonset_pods)

@@ -1,0 +1,108 @@
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim, NodeClaimSpec
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, fake_instance_types
+from karpenter_tpu.cloudprovider.types import (
+    InsufficientCapacityError, NodeClaimNotFoundError, order_by_price,
+    satisfies_min_values, truncate)
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.utils import resources as res
+
+
+def test_kwok_catalog_shape():
+    its = kwok.construct_instance_types()
+    assert len(its) == 144
+    for it in its:
+        assert len(it.offerings) == 8
+        assert it.capacity[res.CPU] > 0
+    # price formula: 1 cpu, factor 2 => 0.025 + 0.002
+    it = next(i for i in its if i.name == "c-1x-amd64-linux")
+    od = [o for o in it.offerings if o.capacity_type == api_labels.CAPACITY_TYPE_ON_DEMAND][0]
+    spot = [o for o in it.offerings if o.capacity_type == api_labels.CAPACITY_TYPE_SPOT][0]
+    assert abs(od.price - 0.027) < 1e-9
+    assert abs(spot.price - 0.027 * 0.7) < 1e-9
+
+
+def test_order_by_price_and_truncate():
+    its = kwok.construct_instance_types()
+    reqs = Requirements()
+    ordered = order_by_price(its, reqs)
+    prices = [it.offerings.available().compatible(reqs).cheapest().price for it in ordered]
+    assert prices == sorted(prices)
+    truncated, err = truncate(its, reqs, 60)
+    assert err is None and len(truncated) == 60
+
+
+def test_min_values_satisfied():
+    its = fake_instance_types(6)
+    reqs = Requirements([Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
+                                     [it.name for it in its], min_values=3)])
+    needed, err = satisfies_min_values(its, reqs)
+    assert err is None and needed == 3
+
+
+def test_min_values_unsatisfied():
+    its = fake_instance_types(2)
+    reqs = Requirements([Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
+                                     [it.name for it in its], min_values=5)])
+    needed, err = satisfies_min_values(its, reqs)
+    assert err is not None and needed == 2
+
+
+def _claim(cpu="1", zone=None):
+    reqs = []
+    if zone:
+        reqs.append(NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, (zone,)))
+    return NodeClaim(spec=NodeClaimSpec(
+        requirements=reqs, resources_requests=res.parse_list({"cpu": cpu})))
+
+
+def test_fake_create_cheapest_and_records():
+    cp = FakeCloudProvider()
+    nc = cp.create(_claim())
+    assert nc.status.provider_id.startswith("fake://")
+    assert len(cp.create_calls) == 1
+    # cheapest compatible = 1-cpu spot
+    assert nc.metadata.labels[api_labels.CAPACITY_TYPE_LABEL_KEY] == api_labels.CAPACITY_TYPE_SPOT
+
+
+def test_fake_injectable_errors_and_caps():
+    cp = FakeCloudProvider()
+    cp.next_create_err = InsufficientCapacityError("boom")
+    try:
+        cp.create(_claim())
+        assert False
+    except InsufficientCapacityError:
+        pass
+    cp.reset()
+    cp.allowed_create_calls = 1
+    cp.create(_claim())
+    try:
+        cp.create(_claim())
+        assert False
+    except InsufficientCapacityError:
+        pass
+
+
+def test_fake_delete_and_get():
+    cp = FakeCloudProvider()
+    nc = cp.create(_claim())
+    assert cp.get(nc.status.provider_id) is nc
+    cp.delete(nc)
+    try:
+        cp.get(nc.status.provider_id)
+        assert False
+    except NodeClaimNotFoundError:
+        pass
+
+
+def test_kwok_provider_fabricates_node():
+    cp = kwok.KwokCloudProvider()
+    nc = cp.create(_claim(cpu="3", zone="test-zone-b"))
+    assert nc.status.provider_id.startswith("kwok://")
+    _, node = cp.created[nc.status.provider_id]
+    assert node.labels[api_labels.LABEL_TOPOLOGY_ZONE] == "test-zone-b"
+    assert any(t.key == api_labels.UNREGISTERED_TAINT_KEY for t in node.spec.taints)
+    assert node.status.allocatable[res.CPU] >= 3000

@@ -1,0 +1,265 @@
+"""Host scheduler behavior suite.
+
+Scenarios mirror behaviors from the reference suites
+(pkg/controllers/provisioning/scheduling/{suite,topology,instance_selection}_test.go),
+re-expressed against this framework's API.
+"""
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Taint, Toleration
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_tpu.utils import resources as res
+
+from factories import (affinity_term, make_nodepool, make_pod, make_pods,
+                       make_scheduler, spread_hostname, spread_zone)
+
+
+def kwok_its():
+    return kwok.construct_instance_types()
+
+
+class TestBasicScheduling:
+    def test_single_pod_single_node(self):
+        pods = [make_pod()]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 1
+        assert results.new_nodeclaims[0].pods == pods
+
+    def test_pods_pack_one_node(self):
+        pods = make_pods(10, cpu="100m", memory="64Mi")
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 1
+
+    def test_large_pods_split_nodes(self):
+        # 4 pods x 150 cpu only fit on 192/256-cpu instance types, one each
+        pods = make_pods(4, cpu="150", memory="1Gi")
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 4
+
+    def test_unsatisfiable_pod_errors(self):
+        pods = [make_pod(cpu="1000")]  # larger than any instance type
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert len(results.pod_errors) == 1
+        assert not results.new_nodeclaims
+
+    def test_daemonset_overhead_reserved(self):
+        pods = [make_pod(cpu="700m")]
+        daemon = make_pod(cpu="400m")
+        daemon.is_daemonset_pod = True
+        s = make_scheduler([make_nodepool()], kwok_its(), pods, daemonset_pods=[daemon])
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        nc = results.new_nodeclaims[0]
+        # 700m pod + 400m daemon exceeds a 1-cpu node's 900m allocatable
+        # (100m kube-reserved overhead), so only >=2-cpu instance types remain
+        assert all(it.capacity[res.CPU] >= 2000 for it in nc.instance_type_options)
+
+
+class TestInstanceSelection:
+    def test_node_selector_restricts_zone(self):
+        pods = [make_pod(node_selector={api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-b"})]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        nc = results.new_nodeclaims[0]
+        assert nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values == {"test-zone-b"}
+
+    def test_arch_selector_filters_instance_types(self):
+        pods = [make_pod(node_selector={api_labels.LABEL_ARCH: "arm64"})]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        for it in results.new_nodeclaims[0].instance_type_options:
+            assert it.requirements.get(api_labels.LABEL_ARCH).has("arm64")
+
+    def test_nodepool_requirements_apply(self):
+        np = make_nodepool(requirements=[NodeSelectorRequirement(
+            api_labels.CAPACITY_TYPE_LABEL_KEY, "In", (api_labels.CAPACITY_TYPE_ON_DEMAND,))])
+        pods = [make_pod()]
+        s = make_scheduler([np], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        ct = results.new_nodeclaims[0].requirements.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        assert ct.values == {api_labels.CAPACITY_TYPE_ON_DEMAND}
+
+    def test_incompatible_node_selector_fails(self):
+        pods = [make_pod(node_selector={api_labels.LABEL_TOPOLOGY_ZONE: "nonexistent-zone"})]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert len(results.pod_errors) == 1
+
+    def test_custom_label_requires_nodepool_definition(self):
+        # custom label not defined by any nodepool -> unschedulable
+        pods = [make_pod(node_selector={"example.com/team": "infra"})]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        assert len(s.solve(pods).pod_errors) == 1
+        # nodepool defining the label makes it schedulable
+        np = make_nodepool(labels={"example.com/team": "infra"})
+        pods2 = [make_pod(node_selector={"example.com/team": "infra"})]
+        s2 = make_scheduler([np], kwok_its(), pods2)
+        assert s2.solve(pods2).pod_errors == {}
+
+
+class TestTaints:
+    def test_tainted_pool_requires_toleration(self):
+        np = make_nodepool(taints=[Taint(key="dedicated", value="infra")])
+        pods = [make_pod()]
+        s = make_scheduler([np], kwok_its(), pods)
+        assert len(s.solve(pods).pod_errors) == 1
+
+    def test_toleration_allows_tainted_pool(self):
+        np = make_nodepool(taints=[Taint(key="dedicated", value="infra")])
+        pods = [make_pod(tolerations=[Toleration(key="dedicated", operator="Exists")])]
+        s = make_scheduler([np], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+
+    def test_weighted_pools_ordered(self):
+        heavy = make_nodepool(name="heavy", weight=50, labels={"pool": "heavy"})
+        light = make_nodepool(name="light", weight=1, labels={"pool": "light"})
+        from karpenter_tpu.api.nodepool import order_by_weight
+        pools = order_by_weight([light, heavy])
+        pods = [make_pod()]
+        s = make_scheduler(pools, kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.new_nodeclaims[0].template.nodepool_name == "heavy"
+
+
+class TestTopologySpread:
+    def test_zonal_spread_even(self):
+        pods = make_pods(8, labels={"app": "demo"}, spread=[spread_zone()])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        zones = {}
+        for nc in results.new_nodeclaims:
+            zone_req = nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE)
+            assert zone_req.length() == 1
+            z = zone_req.values_list()[0]
+            zones[z] = zones.get(z, 0) + len(nc.pods)
+        assert len(zones) == 4  # kwok has 4 zones
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_hostname_spread_max_skew(self):
+        pods = make_pods(6, labels={"app": "demo"}, spread=[spread_hostname(max_skew=1)])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        # maxSkew=1 with hostname topology: min count is always 0 -> 1 pod/node
+        assert len(results.new_nodeclaims) == 6
+        assert all(len(nc.pods) == 1 for nc in results.new_nodeclaims)
+
+    def test_zonal_spread_restricted_zones(self):
+        pods = make_pods(
+            4, labels={"app": "demo"}, spread=[spread_zone()],
+            node_selector=None,
+            required_affinity=[[NodeSelectorRequirement(
+                api_labels.LABEL_TOPOLOGY_ZONE, "In", ("test-zone-a", "test-zone-b"))]])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        zones = {}
+        for nc in results.new_nodeclaims:
+            z = nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0]
+            zones[z] = zones.get(z, 0) + len(nc.pods)
+        assert set(zones) == {"test-zone-a", "test-zone-b"}
+        assert zones["test-zone-a"] == 2 and zones["test-zone-b"] == 2
+
+
+class TestPodAffinity:
+    def test_anti_affinity_hostname_one_per_node(self):
+        pods = make_pods(5, labels={"app": "demo"},
+                         pod_anti_affinity=[affinity_term(api_labels.LABEL_HOSTNAME)])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 5
+
+    def test_zonal_affinity_colocates(self):
+        pods = make_pods(6, labels={"app": "demo"},
+                         pod_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE)])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        zones = set()
+        for nc in results.new_nodeclaims:
+            zones.add(nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0])
+        assert len(zones) == 1
+
+    def test_zonal_anti_affinity_late_committal(self):
+        # Reference semantics (topology_test.go:2132-2176): with late committal,
+        # a single batch schedules only ONE zonal anti-affinity pod — its zone
+        # isn't collapsed, so all candidate domains get blocked for the rest.
+        pods = make_pods(3, labels={"app": "demo"},
+                         pod_anti_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE)])
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert len(results.pod_errors) == 2
+        assert len(results.new_nodeclaims) == 1
+
+    def test_zonal_anti_affinity_across_batches(self):
+        # When each pod is constrained to a distinct zone, anti-affinity is
+        # satisfiable within one batch: domains collapse to one zone per pod.
+        zones = ["test-zone-a", "test-zone-b", "test-zone-c"]
+        pods = [make_pod(labels={"app": "demo"},
+                         node_selector={api_labels.LABEL_TOPOLOGY_ZONE: z},
+                         pod_anti_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE)])
+                for z in zones]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 3
+        got = sorted(nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0]
+                     for nc in results.new_nodeclaims)
+        assert got == zones
+
+
+class TestRelaxation:
+    def test_impossible_preference_dropped(self):
+        pods = [make_pod(preferred_affinity=[
+            (10, [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE, "In", ("no-such-zone",))])])]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        assert len(results.new_nodeclaims) == 1
+
+    def test_multiple_required_terms_or_semantics(self):
+        pods = [make_pod(required_affinity=[
+            [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE, "In", ("no-such-zone",))],
+            [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE, "In", ("test-zone-c",))],
+        ])]
+        s = make_scheduler([make_nodepool()], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        nc = results.new_nodeclaims[0]
+        assert nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values == {"test-zone-c"}
+
+
+class TestLimits:
+    def test_nodepool_limits_cap_nodes(self):
+        np = make_nodepool(limits={"cpu": "2"})
+        pods = make_pods(10, cpu="900m")
+        s = make_scheduler([np], kwok_its(), pods)
+        results = s.solve(pods)
+        # with a 2-cpu limit and subtractMax pessimism, most pods can't get nodes
+        assert len(results.pod_errors) > 0
+        assert len(results.new_nodeclaims) <= 2
+
+    def test_fallback_pool_when_limited(self):
+        limited = make_nodepool(name="limited", weight=10, limits={"cpu": "1"},
+                                labels={"pool": "limited"})
+        fallback = make_nodepool(name="fallback", labels={"pool": "fallback"})
+        pods = make_pods(4, cpu="2")
+        s = make_scheduler([limited, fallback], kwok_its(), pods)
+        results = s.solve(pods)
+        assert results.pod_errors == {}
+        pools = {nc.template.nodepool_name for nc in results.new_nodeclaims}
+        assert "fallback" in pools
