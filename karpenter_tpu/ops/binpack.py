@@ -1,0 +1,681 @@
+"""Grouped first-fit-decreasing bin-pack solver with TPU-resident feasibility.
+
+Replaces the reference's per-pod greedy loop (scheduler.go:207-315, O(pods x
+instance-types) with full refiltering per pod) by:
+
+1. ``precompute`` — ONE jit-compiled device program computing every pairwise
+   feasibility quantity the greedy needs, over all (group, template, instance
+   type, zone, existing node) combinations at once: requirement compatibility
+   (bitpacked mask algebra), offering availability per zone, int32 pods-per-node
+   via broadcast division. This is the O(G*M*T*Z + G*N) hot math.
+2. ``pack`` — a host-side greedy over *groups* (dozens, not tens of thousands)
+   in first-fit-decreasing order, making the same decisions the reference
+   makes per pod but in closed form per group: zone water-fill for topology
+   spreads, per-node caps for hostname spread/anti-affinity, cohort tracking
+   for cross-group node mixing, subtractMax limit pessimism per opened node.
+
+Node-count parity with the reference greedy is validated against the host
+oracle scheduler in tests/test_binpack_parity.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import labels as api_labels
+from . import encode as enc
+from . import feasibility as feas
+from .encode import EncodedRequirements
+
+INT32_MAX = 2**31 - 1
+
+
+# --------------------------------------------------------------------------
+# numpy mini-algebra over EncodedRequirements rows (host-side cohort updates;
+# same rules as feasibility.py kernels, scalar-shaped)
+# --------------------------------------------------------------------------
+
+def np_compatible(a: EncodedRequirements, b: EncodedRequirements,
+                  allow_undefined: np.ndarray) -> bool:
+    gt = np.maximum(a.gt, b.gt)
+    lt = np.minimum(a.lt, b.lt)
+    crossed = (gt > -2**31) & (lt < 2**31 - 1) & (gt >= lt)
+    nonempty = np.any(a.mask & b.mask, axis=-1) & ~crossed
+    checked = a.defined & b.defined
+    exempt = a.exempt & b.exempt
+    bad = checked & ~nonempty & ~exempt
+    undef_bad = b.defined & ~a.defined & ~allow_undefined & ~b.exempt
+    return not np.any(bad | undef_bad)
+
+
+def np_combine(a: EncodedRequirements, b: EncodedRequirements) -> EncodedRequirements:
+    gt = np.maximum(a.gt, b.gt)
+    lt = np.minimum(a.lt, b.lt)
+    crossed = (gt > -2**31) & (lt < 2**31 - 1) & (gt >= lt)
+    mask = np.where(crossed[..., None], np.uint32(0), a.mask & b.mask)
+    complement = a.complement & b.complement & ~crossed
+    empty = ~np.any(mask != 0, axis=-1)
+    exempt = np.where(complement, a.exempt | b.exempt, empty)
+    gt = np.where(complement, gt, -2**31)
+    lt = np.where(complement, lt, 2**31 - 1)
+    return EncodedRequirements(mask=mask, defined=a.defined | b.defined,
+                               complement=complement, exempt=exempt, gt=gt, lt=lt)
+
+
+# --------------------------------------------------------------------------
+# problem + device precompute
+# --------------------------------------------------------------------------
+
+@dataclass
+class PackProblem:
+    """Fully encoded solve input. Build via provisioning.tensor_scheduler."""
+    vocab: enc.Vocab
+    # groups
+    group_enc: EncodedRequirements        # stacked [G, ...]
+    group_req: np.ndarray                 # int64 [G, R] scaled requests
+    group_count: np.ndarray               # int64 [G]
+    # templates
+    template_enc: EncodedRequirements     # [M, ...]
+    daemon_overhead: np.ndarray           # int64 [M, R]
+    tol_template: np.ndarray              # bool [G, M] pod tolerates template taints
+    # instance types (union catalog)
+    it_enc: EncodedRequirements           # [T, ...]
+    it_alloc: np.ndarray                  # int64 [T, R]
+    it_capacity: np.ndarray               # int64 [T, R]
+    it_price: np.ndarray                  # float32 [T] cheapest available offering
+    template_its: np.ndarray              # bool [M, T]
+    off_zone: np.ndarray                  # int32 [T, O] zone value idx or -1
+    off_captype: np.ndarray               # int32 [T, O]
+    off_available: np.ndarray             # bool [T, O]
+    # zones
+    zone_key: int                         # key index of topology zone
+    captype_key: int
+    zone_values: np.ndarray               # int32 [Z] value indices
+    # existing nodes (may be empty)
+    exist_enc: Optional[EncodedRequirements] = None  # [N, ...]
+    exist_avail: Optional[np.ndarray] = None         # int64 [N, R]
+    exist_zone: Optional[np.ndarray] = None          # int32 [N] zone idx or -1
+    tol_exist: Optional[np.ndarray] = None           # bool [G, N]
+    allow_undefined: Optional[np.ndarray] = None     # bool [K] well-known keys
+
+
+@dataclass
+class PackTensors:
+    """Fetched results of the device precompute."""
+    compat_tm: np.ndarray      # bool [M, G] template x group requirement compat
+    it_ok: np.ndarray          # bool [G, M, T]
+    ppn: np.ndarray            # int32 [G, M, T] pods-per-fresh-node
+    it_ok_z: np.ndarray        # bool [G, M, T, Z]
+    zone_adm: np.ndarray       # bool [G, M, Z] combined reqs admit zone
+    exist_ok: np.ndarray       # bool [G, N]
+    exist_cap: np.ndarray      # int32 [G, N]
+
+
+@partial(jax.jit, static_argnames=("zone_key", "captype_key", "has_exist"))
+def _precompute_device(group, template, it, group_req, daemon, alloc,
+                       template_its, off_zone, off_captype, off_available,
+                       zone_values, allow_undefined, tol_template,
+                       exist, exist_avail, tol_exist,
+                       *, zone_key: int, captype_key: int, has_exist: bool):
+    G = group.mask.shape[0]
+    M = template.mask.shape[0]
+    T = it.mask.shape[0]
+    Z = zone_values.shape[0]
+
+    # template x group compatibility + combined requirement sets [M*G]
+    compat_tm = feas.compatible_matrix(template, group, allow_undefined)  # [M, G]
+    cmb = feas.combine(
+        jax.tree.map(lambda x: x[:, None], template),
+        jax.tree.map(lambda x: x[None, :], group))          # [M, G, K, ...]
+    cmb_flat = jax.tree.map(lambda x: x.reshape((M * G,) + x.shape[2:]), cmb)
+
+    # instance-type requirement compat: existing side = IT (nodeclaim.go:295-297)
+    it_compat = feas.intersects_matrix(it, cmb_flat)         # [T, M*G]
+    it_compat = it_compat.T.reshape(M, G, T).transpose(1, 0, 2)  # [G, M, T]
+
+    # offerings: per zone and any-zone
+    zone_bit_words = zone_values // 32
+    zone_bits = zone_values % 32
+    zmask = cmb_flat.mask[:, zone_key, :]                    # [MG, W]
+    zone_adm = ((jnp.take(zmask, zone_bit_words, axis=1)
+                 >> zone_bits[None, :].astype(jnp.uint32)) & 1) == 1  # [MG, Z]
+    cap_ok = feas.offering_compat(cmb_flat.mask, zone_key, captype_key,
+                                  jnp.full_like(off_zone, -1), off_captype,
+                                  off_available)             # [MG, T] captype-only
+    # offering availability per zone: [T, Z]
+    off_in_zone = jnp.any(
+        (off_zone[:, :, None] == zone_values[None, None, :])
+        & off_available[:, :, None], axis=1)                 # [T, Z]
+    # captype admission must pair with the actual offering; recompute jointly:
+    # offering o passes for (mg, t, z) iff available, zone==z, captype admitted
+    cap_bit_ok = _offering_value_ok(cmb_flat.mask, captype_key, off_captype)  # [MG,T,O]
+    zmatch = off_zone[None, :, :, None] == zone_values[None, None, None, :]   # [1,T,O,Z]
+    off_ok_z = jnp.any(off_available[None, :, :, None] & zmatch
+                       & cap_bit_ok[:, :, :, None], axis=2)  # [MG, T, Z]
+    off_ok_z = off_ok_z & zone_adm[:, None, :]
+    off_ok_any = jnp.any(off_ok_z, axis=-1)                  # [MG, T]
+
+    # pods per node
+    ppn = feas.pods_per_node(alloc, daemon, group_req)       # [G, M, T]
+
+    ok_base = (it_compat
+               & template_its[None, :, :]
+               & tol_template[:, :, None]
+               & compat_tm.T[:, :, None]
+               & (ppn >= 1))
+    it_ok_any = ok_base & off_ok_any.reshape(M, G, T).transpose(1, 0, 2)
+    it_ok_z = (ok_base[:, :, :, None]
+               & off_ok_z.reshape(M, G, T, Z).transpose(1, 0, 2, 3))
+    zone_adm_gmz = zone_adm.reshape(M, G, Z).transpose(1, 0, 2)
+
+    if has_exist:
+        exist_compat = feas.compatible_matrix(exist, group,
+                                              jnp.zeros_like(allow_undefined))  # [N, G]
+        exist_ok = exist_compat.T & tol_exist                # [G, N]
+        per = jnp.where(group_req[:, None, :] > 0,
+                        exist_avail[None, :, :] // jnp.maximum(group_req[:, None, :], 1),
+                        jnp.int32(INT32_MAX))
+        exist_cap = jnp.clip(jnp.min(per, axis=-1), 0, INT32_MAX).astype(jnp.int32)
+        exist_ok = exist_ok & (exist_cap >= 1)
+    else:
+        exist_ok = jnp.zeros((G, 1), dtype=bool)
+        exist_cap = jnp.zeros((G, 1), dtype=jnp.int32)
+
+    return (compat_tm, it_ok_any, ppn.astype(jnp.int32), it_ok_z,
+            zone_adm_gmz, exist_ok, exist_cap)
+
+
+def _offering_value_ok(mask_b, key: int, off_val):
+    """[B,T,O]: does mask_b admit each offering's single value at `key`
+    (-1 == unconstrained)."""
+    masks = mask_b[:, key, :]                                # [B, W]
+    word = jnp.where(off_val >= 0, off_val // 32, 0)
+    bit = jnp.where(off_val >= 0, off_val % 32, 0)
+    w = masks[:, word]                                       # [B, T, O]
+    has = (w >> bit[None, :, :].astype(jnp.uint32)) & jnp.uint32(1)
+    return jnp.where(off_val[None, :, :] >= 0, has == 1, True)
+
+
+def precompute(p: PackProblem) -> PackTensors:
+    has_exist = p.exist_enc is not None and p.exist_enc.mask.shape[0] > 0
+    dev = lambda e: feas.to_device(e)
+    i32 = lambda a: jnp.asarray(np.clip(a, -INT32_MAX - 1, INT32_MAX).astype(np.int32))
+    if has_exist:
+        exist, exist_avail, tol_exist = (dev(p.exist_enc),
+                                         i32(p.exist_avail),
+                                         jnp.asarray(p.tol_exist))
+    else:
+        K, W = p.group_enc.mask.shape[1:]
+        exist = feas.Enc(mask=jnp.zeros((1, K, W), jnp.uint32),
+                         defined=jnp.zeros((1, K), bool),
+                         complement=jnp.zeros((1, K), bool),
+                         exempt=jnp.zeros((1, K), bool),
+                         gt=jnp.zeros((1, K), jnp.int32),
+                         lt=jnp.zeros((1, K), jnp.int32))
+        exist_avail = jnp.zeros((1, p.group_req.shape[1]), jnp.int32)
+        tol_exist = jnp.zeros((p.group_req.shape[0], 1), bool)
+    out = _precompute_device(
+        dev(p.group_enc), dev(p.template_enc), dev(p.it_enc),
+        i32(p.group_req), i32(p.daemon_overhead),
+        i32(p.it_alloc), jnp.asarray(p.template_its),
+        jnp.asarray(p.off_zone), jnp.asarray(p.off_captype),
+        jnp.asarray(p.off_available), jnp.asarray(p.zone_values),
+        jnp.asarray(p.allow_undefined), jnp.asarray(p.tol_template),
+        exist, exist_avail, tol_exist,
+        zone_key=p.zone_key, captype_key=p.captype_key, has_exist=has_exist)
+    return PackTensors(*(np.asarray(x) for x in out))
+
+
+# --------------------------------------------------------------------------
+# host greedy over groups
+# --------------------------------------------------------------------------
+
+@dataclass
+class Cohort:
+    """n identical in-flight nodes: same template, zone restriction, cumulative
+    requests and surviving instance-type set."""
+    m: int
+    zone: Optional[int]
+    it_set: np.ndarray               # bool [T]
+    requests: np.ndarray             # int64 [R] per node
+    n: int
+    enc: EncodedRequirements         # accumulated requirement row
+    pods_by_group: Dict[int, int] = field(default_factory=dict)  # per-node fill
+
+
+@dataclass
+class PackResult:
+    # (template m, zone idx or None, it_set bool [T], [pod,...]) per new node
+    nodes: List[tuple] = field(default_factory=list)
+    existing: Dict[int, list] = field(default_factory=dict)  # node idx -> pods
+    errors: Dict[str, str] = field(default_factory=dict)     # pod uid -> error
+    cohorts: List[Cohort] = field(default_factory=list)
+
+
+def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
+              c: int, max_skew: int) -> np.ndarray:
+    """Distribute c pods over zones the way the reference's min-count domain
+    selection does (topologygroup.go:181-227): each pod goes to the lowest-count
+    admitted+viable zone subject to count+1-min <= maxSkew, min taken over all
+    admitted zones. Returns per-zone allocation (pods that can't place anywhere
+    are simply not allocated; caller errors them)."""
+    counts = counts.astype(np.int64).copy()
+    alloc = np.zeros_like(counts)
+    remaining = c
+    # fast path: every admitted zone viable -> sequential min-fill equals a
+    # closed-form water-fill (skew never binds when always filling the min)
+    if admitted.any() and (viable | ~admitted).all():
+        idx = np.where(admitted)[0]
+        cz = counts[idx]
+        # largest level L with sum(max(0, L - cz)) <= remaining
+        lo, hi = int(cz.min()), int(cz.max()) + remaining
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if int(np.maximum(0, mid - cz).sum()) <= remaining:
+                lo = mid
+            else:
+                hi = mid - 1
+        add = np.maximum(0, lo - cz)
+        rem = remaining - int(add.sum())
+        at_level = np.where(cz + add == lo)[0]  # lex order == index order
+        for pos in at_level[:rem]:
+            add[pos] += 1
+        alloc[idx] = add
+        return alloc
+    while remaining > 0:
+        m0 = counts[admitted].min() if admitted.any() else 0
+        eligible = viable & admitted & (counts + 1 - m0 <= max_skew)
+        if not eligible.any():
+            break
+        cand = np.where(eligible)[0]
+        zi = cand[np.lexsort((cand, counts[cand]))[0]]
+        alloc[zi] += 1
+        counts[zi] += 1
+        remaining -= 1
+    return alloc
+
+
+class Packer:
+    """Greedy group packer consuming PackTensors."""
+
+    def __init__(self, p: PackProblem, t: PackTensors, groups,
+                 template_limits: List[Optional[dict]],
+                 limit_resources: List[str],
+                 initial_zone_counts: Optional[np.ndarray] = None,
+                 exist_order: Optional[List[int]] = None):
+        self.p = p
+        self.t = t
+        self.groups = groups
+        self.G = len(groups)
+        self.Z = len(p.zone_values)
+        self.T = p.it_alloc.shape[0]
+        self.M = p.daemon_overhead.shape[0]
+        self.template_limits = template_limits  # remaining ResourceList (scaled) or None
+        self.limit_resources = limit_resources
+        self.zone_counts = (initial_zone_counts.copy() if initial_zone_counts is not None
+                            else np.zeros((self.G, self.Z), dtype=np.int64))
+        self.exist_order = exist_order if exist_order is not None else (
+            list(range(p.exist_avail.shape[0])) if p.exist_avail is not None else [])
+        self.exist_avail = (p.exist_avail.copy() if p.exist_avail is not None
+                            else np.zeros((0, p.group_req.shape[1]), dtype=np.int64))
+        self.result = PackResult()
+
+    # -- helpers ------------------------------------------------------------
+
+    def _viable_templates(self, g: int) -> List[int]:
+        return [m for m in range(self.M) if self.t.it_ok[g, m].any()]
+
+    def _open_nodes(self, g: int, m: int, zone: Optional[int], n_pods: int,
+                    per_node: int) -> int:
+        """Open as many nodes as limits allow for n_pods; returns pods placed."""
+        if per_node <= 0:
+            return 0
+        it_ok = (self.t.it_ok_z[g, m, :, zone] if zone is not None
+                 else self.t.it_ok[g, m])
+        it_set = it_ok & (self.t.ppn[g, m] >= 1)
+        if not it_set.any():
+            return 0
+        limits = self.template_limits[m]
+        cohort_enc = self._node_enc(g, m, zone)
+        if limits is None:
+            full_nodes, rem = divmod(n_pods, per_node)
+            if full_nodes:
+                self._append_cohort(g, m, zone, it_set, per_node, cohort_enc,
+                                    n=full_nodes)
+            if rem:
+                self._append_cohort(g, m, zone, it_set, rem, cohort_enc, n=1)
+            return n_pods
+        placed = 0
+        while placed < n_pods:
+            fill = min(per_node, n_pods - placed)
+            it_fit = it_set & self._under_limits(m, it_set)
+            if not it_fit.any():
+                break
+            self._subtract_max(m, it_fit)
+            self._append_cohort(g, m, zone, it_fit, fill, cohort_enc, n=1)
+            placed += fill
+        return placed
+
+    def _under_limits(self, m: int, it_set: np.ndarray) -> np.ndarray:
+        limits = self.template_limits[m]
+        ok = np.ones(self.T, dtype=bool)
+        for i, rname in enumerate(self.limit_resources):
+            ridx = self.p.vocab.resource_idx.get(rname)
+            if ridx is None:
+                continue
+            ok &= self.p.it_capacity[:, ridx] <= limits.get(rname, 0)
+        return ok
+
+    def _subtract_max(self, m: int, it_set: np.ndarray) -> None:
+        """subtractMax pessimism (scheduler.go:388-405)."""
+        limits = self.template_limits[m]
+        for rname in list(limits):
+            ridx = self.p.vocab.resource_idx.get(rname)
+            if ridx is None:
+                continue
+            limits[rname] = limits[rname] - int(self.p.it_capacity[it_set, ridx].max())
+
+    def _node_enc(self, g: int, m: int, zone: Optional[int]) -> EncodedRequirements:
+        e = np_combine(_row(self.p.template_enc, m), _row(self.p.group_enc, g))
+        if zone is not None:
+            e = np_combine(e, self._zone_enc(zone))
+        return e
+
+    def _zone_enc(self, zone: int) -> EncodedRequirements:
+        K, W = self.p.group_enc.mask.shape[1:]
+        mask = np.full((K, W), 0xFFFFFFFF, dtype=np.uint32)
+        defined = np.zeros(K, dtype=bool)
+        complement = np.ones(K, dtype=bool)
+        exempt = np.zeros(K, dtype=bool)
+        zk = self.p.zone_key
+        row = np.zeros(W, dtype=np.uint32)
+        vi = int(self.p.zone_values[zone])
+        row[vi // 32] |= np.uint32(1 << (vi % 32))
+        mask[zk] = row
+        defined[zk] = True
+        complement[zk] = False
+        return EncodedRequirements(mask=mask, defined=defined, complement=complement,
+                                   exempt=exempt,
+                                   gt=np.full(K, -2**31, dtype=np.int64),
+                                   lt=np.full(K, 2**31 - 1, dtype=np.int64))
+
+    def _append_cohort(self, g: int, m: int, zone: Optional[int],
+                       it_set: np.ndarray, fill: int,
+                       cohort_enc: EncodedRequirements, n: int = 1) -> None:
+        req = self.p.group_req[g] * fill
+        self.result.cohorts.append(Cohort(
+            m=m, zone=zone, it_set=it_set.copy(), requests=req.copy(), n=n,
+            enc=cohort_enc, pods_by_group={g: fill}))
+
+    def _cohort_capacity(self, g: int, cohort: Cohort) -> Tuple[int, np.ndarray]:
+        """Max additional pods of group g per cohort node + surviving it set."""
+        it_ok = (self.t.it_ok_z[g, cohort.m, :, cohort.zone] if cohort.zone is not None
+                 else self.t.it_ok[g, cohort.m])
+        ts = cohort.it_set & it_ok
+        if not ts.any():
+            return 0, ts
+        req = self.p.group_req[g]
+        free = self.p.it_alloc[ts] - self.p.daemon_overhead[cohort.m] - cohort.requests
+        free = np.maximum(free, 0)
+        with np.errstate(divide="ignore"):
+            per = np.where(req[None, :] > 0, free // np.maximum(req[None, :], 1),
+                           INT32_MAX)
+        cap = int(per.min(axis=1).max()) if per.size else 0
+        return cap, ts
+
+    def _fill_cohorts(self, g: int, remaining: int, zone: Optional[int],
+                      per_node_cap: int) -> int:
+        """Mix pods of g into compatible existing cohorts (the reference's
+        fewest-pods-first in-flight node pass, scheduler.go:276-283)."""
+        if remaining <= 0:
+            return 0
+        allow = self.p.allow_undefined
+        order = sorted(range(len(self.result.cohorts)),
+                       key=lambda i: sum(self.result.cohorts[i].pods_by_group.values()))
+        placed_total = 0
+        for ci in order:
+            if remaining <= 0:
+                break
+            cohort = self.result.cohorts[ci]
+            if zone is not None and cohort.zone != zone:
+                continue
+            if zone is None and cohort.zone is not None:
+                # group must admit the cohort's zone; np_compatible handles it
+                pass
+            if not self.t.compat_tm[cohort.m, g] or not self.p.tol_template[g, cohort.m]:
+                continue
+            if not np_compatible(cohort.enc, _row(self.p.group_enc, g), allow):
+                continue
+            cap, ts = self._cohort_capacity(g, cohort)
+            if per_node_cap:
+                existing_fill = cohort.pods_by_group.get(g, 0)
+                cap = min(cap, max(0, per_node_cap - existing_fill))
+            if cap <= 0:
+                continue
+            # fill each node of the cohort up to cap; split if not all consumed
+            fill_nodes = min(cohort.n, -(-remaining // cap))
+            if fill_nodes < cohort.n:
+                rest = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
+                              requests=cohort.requests.copy(), n=cohort.n - fill_nodes,
+                              enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
+                cohort.n = fill_nodes
+                self.result.cohorts.append(rest)
+            per_last = remaining - cap * (fill_nodes - 1)
+            if per_last != cap and fill_nodes > 1:
+                # last node takes the remainder; split it off
+                last = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
+                              requests=cohort.requests.copy(), n=1,
+                              enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
+                cohort.n = fill_nodes - 1
+                self.result.cohorts.append(last)
+                self._commit_to_cohort(last, g, per_last, ts)
+                self._commit_to_cohort(cohort, g, cap, ts)
+                placed = cap * (fill_nodes - 1) + per_last
+            else:
+                fill = min(cap, remaining if fill_nodes == 1 else cap)
+                self._commit_to_cohort(cohort, g, fill, ts)
+                placed = fill * fill_nodes
+            placed_total += placed
+            remaining -= placed
+        return placed_total
+
+    def _commit_to_cohort(self, cohort: Cohort, g: int, fill: int, ts: np.ndarray):
+        cohort.it_set = ts.copy()
+        cohort.requests = cohort.requests + self.p.group_req[g] * fill
+        cohort.pods_by_group[g] = cohort.pods_by_group.get(g, 0) + fill
+        cohort.enc = np_combine(cohort.enc, _row(self.p.group_enc, g))
+
+    def _fill_existing(self, g: int, remaining: int, zone: Optional[int],
+                       per_node_cap: int) -> int:
+        placed_total = 0
+        for n in self.exist_order:
+            if remaining <= 0:
+                break
+            if not self.t.exist_ok[g, n]:
+                continue
+            if zone is not None and (self.p.exist_zone is None
+                                     or self.p.exist_zone[n] != zone):
+                continue
+            req = self.p.group_req[g]
+            with np.errstate(divide="ignore"):
+                per = np.where(req > 0, self.exist_avail[n] // np.maximum(req, 1),
+                               INT32_MAX)
+            cap = int(per.min()) if per.size else 0
+            if per_node_cap:
+                cap = min(cap, per_node_cap)
+            fill = min(cap, remaining)
+            if fill <= 0:
+                continue
+            self.exist_avail[n] = self.exist_avail[n] - req * fill
+            self.result.existing.setdefault(n, []).append((g, fill))
+            placed_total += fill
+            remaining -= fill
+        return placed_total
+
+    # -- main ---------------------------------------------------------------
+
+    def pack(self) -> PackResult:
+        cpu_idx = self.p.vocab.resource_idx.get("cpu", 0)
+        mem_idx = self.p.vocab.resource_idx.get("memory", 0)
+        order = sorted(range(self.G), key=lambda g: (
+            -self.p.group_req[g][cpu_idx], -self.p.group_req[g][mem_idx]))
+        for g in order:
+            self._pack_group(g)
+        return self.result
+
+    def _error_group(self, g: int, count: int, msg: str) -> None:
+        pods = self.groups[g].pods
+        start = len(pods) - count
+        for pod in pods[start:]:
+            self.result.errors[pod.uid] = msg
+
+    def _pack_group(self, g: int) -> None:
+        group = self.groups[g]
+        c = group.count
+        topo = group.topo[0] if group.topo else None
+        kind = topo.kind if topo else "none"
+
+        if kind == "none":
+            placed = self._fill_existing(g, c, None, 0)
+            placed += self._fill_cohorts(g, c - placed, None, 0)
+            placed += self._place_new(g, c - placed, None, 0)
+            if placed < c:
+                self._error_group(g, c - placed, "no instance type satisfied the pod")
+        elif kind == "spread-zone":
+            self._pack_spread_zone(g, c, topo.max_skew)
+        elif kind == "spread-host":
+            per = topo.max_skew
+            placed = self._fill_existing(g, c, None, per)
+            placed += self._fill_cohorts(g, c - placed, None, per)
+            placed += self._place_new(g, c - placed, None, per)
+            if placed < c:
+                self._error_group(g, c - placed, "unsatisfiable hostname topology spread")
+        elif kind == "anti-host":
+            placed = self._fill_existing(g, c, None, 1)
+            placed += self._fill_cohorts(g, c - placed, None, 1)
+            placed += self._place_new(g, c - placed, None, 1)
+            if placed < c:
+                self._error_group(g, c - placed, "unsatisfiable hostname anti-affinity")
+        elif kind == "affinity-host":
+            # all pods onto one node; overflow is unschedulable (reference
+            # late-committal: the hostname domain is fixed by the first pod)
+            placed = 0
+            for n in self.exist_order:
+                if self.t.exist_ok[g, n]:
+                    placed = self._fill_existing(g, c, None, 0)
+                    break
+            if placed == 0:
+                placed = self._place_one_node(g, c)
+            if placed < c:
+                self._error_group(g, c - placed,
+                                  "hostname pod affinity: node capacity exhausted")
+        elif kind == "affinity-zone":
+            self._pack_affinity_zone(g, c)
+        elif kind == "anti-zone":
+            # late committal (topology_test.go:2150-2176): one pod per batch
+            placed = self._fill_existing(g, 1, None, 0)
+            if placed == 0:
+                placed += self._fill_cohorts(g, 1, None, 0)
+            if placed == 0:
+                placed += self._place_new(g, 1, None, 0)
+            if placed < 1:
+                self._error_group(g, c, "unsatisfiable zonal anti-affinity")
+            elif c > 1:
+                self._error_group(
+                    g, c - 1, "zonal anti-affinity: domain undetermined until next batch")
+        else:
+            self._error_group(g, c, f"unsupported topology kind {kind}")
+
+    def _place_new(self, g: int, remaining: int, zone: Optional[int],
+                   per_node_cap: int) -> int:
+        if remaining <= 0:
+            return 0
+        placed = 0
+        for m in range(self.M):
+            if remaining - placed <= 0:
+                break
+            ppn_all = self.t.ppn[g, m]
+            it_ok = (self.t.it_ok_z[g, m, :, zone] if zone is not None
+                     else self.t.it_ok[g, m])
+            if not it_ok.any():
+                continue
+            per = int(ppn_all[it_ok].max())
+            if per_node_cap:
+                per = min(per, per_node_cap)
+            placed += self._open_nodes(g, m, zone, remaining - placed, per)
+        return placed
+
+    def _place_one_node(self, g: int, c: int) -> int:
+        for m in range(self.M):
+            it_ok = self.t.it_ok[g, m]
+            if not it_ok.any():
+                continue
+            per = int(self.t.ppn[g, m][it_ok].max())
+            fill = min(per, c)
+            if fill <= 0:
+                continue
+            limits = self.template_limits[m]
+            if limits is not None:
+                it_fit = it_ok & self._under_limits(m, it_ok)
+                if not it_fit.any():
+                    continue
+                self._subtract_max(m, it_fit)
+                it_ok = it_fit
+            self._append_cohort(g, m, None, it_ok, fill, self._node_enc(g, m, None))
+            return fill
+        return 0
+
+    def _pack_spread_zone(self, g: int, c: int, max_skew: int) -> None:
+        # admitted zones: group+any template admits; viable: some IT offering
+        admitted = np.zeros(self.Z, dtype=bool)
+        viable = np.zeros(self.Z, dtype=bool)
+        for m in self._viable_templates(g):
+            admitted |= self.t.zone_adm[g, m]
+            viable |= self.t.it_ok_z[g, m].any(axis=0)
+        if not admitted.any():
+            self._error_group(g, c, "no zone admitted for topology spread")
+            return
+        alloc = waterfill(self.zone_counts[g], viable, admitted, c, max_skew)
+        placed_total = 0
+        for z in np.argsort(-alloc):
+            a = int(alloc[z])
+            if a <= 0:
+                continue
+            placed = self._fill_existing(g, a, int(z), 0)
+            placed += self._fill_cohorts(g, a - placed, int(z), 0)
+            placed += self._place_new(g, a - placed, int(z), 0)
+            self.zone_counts[g, z] += placed
+            placed_total += placed
+        if placed_total < c:
+            self._error_group(g, c - placed_total, "unsatisfiable zonal topology spread")
+
+    def _pack_affinity_zone(self, g: int, c: int) -> None:
+        viable = np.zeros(self.Z, dtype=bool)
+        for m in self._viable_templates(g):
+            viable |= self.t.it_ok_z[g, m].any(axis=0)
+        counts = self.zone_counts[g]
+        occupied = (counts > 0) & viable
+        candidates = np.where(occupied)[0] if occupied.any() else np.where(viable)[0]
+        if len(candidates) == 0:
+            self._error_group(g, c, "no viable zone for zonal pod affinity")
+            return
+        z = int(candidates[0])
+        placed = self._fill_existing(g, c, z, 0)
+        placed += self._fill_cohorts(g, c - placed, z, 0)
+        placed += self._place_new(g, c - placed, z, 0)
+        self.zone_counts[g, z] += placed
+        if placed < c:
+            self._error_group(g, c - placed, "zonal pod affinity: zone capacity exhausted")
+
+
+def _row(e: EncodedRequirements, i: int) -> EncodedRequirements:
+    return EncodedRequirements(mask=e.mask[i], defined=e.defined[i],
+                               complement=e.complement[i], exempt=e.exempt[i],
+                               gt=e.gt[i], lt=e.lt[i])

@@ -1,0 +1,243 @@
+"""Tensor encoding of the constraint algebra.
+
+The host-side ``scheduling.Requirement`` set-or-complement algebra (reference:
+pkg/scheduling/requirement.go) is lowered onto fixed-shape arrays:
+
+- A label-key vocabulary of K keys; per key, a value vocabulary of up to D
+  values plus one OTHER slot standing for "any value outside the vocab".
+  Complement sets (NotIn/Exists/Gt/Lt) include the OTHER bit, which makes
+  mask-AND an *exact* implementation of Requirement.Intersection emptiness
+  because every concrete value ever compared appears in the vocab.
+- Masks are bitpacked into uint32 words: mask[K, W] with W = ceil((D+1)/32).
+  Intersection = bitwise AND; emptiness = all words zero.
+- Gt/Lt integer bounds ride along as per-key int32 columns; the joint-bound
+  crossing rule (requirement.go:163-165: max(gt) >= min(lt) collapses the
+  intersection to DoesNotExist) is applied on top of the mask AND, which makes
+  bound handling exact as well (known in-vocab values are pre-filtered per side).
+- Per key we track defined / complement / exempt (operator in {NotIn,
+  DoesNotExist}) flags to reproduce Requirements.Intersects/Compatible corner
+  cases (requirements.go:283-304,175-187).
+
+Resources are scaled to int32: cpu -> millicores, memory/storage -> MiB
+(requests rounded up, capacity rounded down — conservative in the fit
+direction), everything else -> whole units rounded the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..api import labels as api_labels
+from ..scheduling.requirement import Requirement
+from ..scheduling.requirements import Requirements
+
+INT_MIN = -(2**31)
+INT_MAX = 2**31 - 1
+
+MIB = 1024 * 1024
+
+# Per-resource int32 scaling: milli stays for cpu-like, MiB for byte-like.
+_BYTE_RESOURCES = ("memory", "ephemeral-storage", "storage")
+
+
+def scale_request(name: str, milli: int) -> int:
+    """Round UP: a request must not shrink when quantized."""
+    if name in _BYTE_RESOURCES:
+        return -((-milli) // (MIB * 1000))  # milli-bytes -> MiB, ceil
+    return milli  # already integer milli
+
+
+def scale_capacity(name: str, milli: int) -> int:
+    """Round DOWN: capacity must not grow when quantized."""
+    if name in _BYTE_RESOURCES:
+        return milli // (MIB * 1000)
+    return milli
+
+
+class Vocab:
+    """Label-key/value vocabulary shared by all encoded entities in one solve."""
+
+    def __init__(self):
+        self.keys: List[str] = []
+        self.key_idx: Dict[str, int] = {}
+        self.values: List[List[str]] = []
+        self.value_idx: List[Dict[str, int]] = []
+        self.resources: List[str] = []
+        self.resource_idx: Dict[str, int] = {}
+        self._frozen = False
+
+    def add_key(self, key: str) -> int:
+        key = api_labels.NORMALIZED_LABELS.get(key, key)
+        if key in self.key_idx:
+            return self.key_idx[key]
+        assert not self._frozen, f"vocab frozen; unknown key {key}"
+        idx = len(self.keys)
+        self.keys.append(key)
+        self.key_idx[key] = idx
+        self.values.append([])
+        self.value_idx.append({})
+        return idx
+
+    def add_value(self, key: str, value: str) -> int:
+        k = self.add_key(key)
+        vi = self.value_idx[k]
+        if value in vi:
+            return vi[value]
+        assert not self._frozen, f"vocab frozen; unknown value {key}={value}"
+        idx = len(self.values[k])
+        self.values[k].append(value)
+        vi[value] = idx
+        return idx
+
+    def add_resource(self, name: str) -> int:
+        if name in self.resource_idx:
+            return self.resource_idx[name]
+        assert not self._frozen
+        idx = len(self.resources)
+        self.resources.append(name)
+        self.resource_idx[name] = idx
+        return idx
+
+    def observe_requirements(self, reqs: Requirements) -> None:
+        for key in reqs:
+            r = reqs.get(key)
+            self.add_key(key)
+            for v in r.values:
+                self.add_value(key, v)
+
+    def observe_resources(self, rl: dict) -> None:
+        for name in rl:
+            self.add_resource(name)
+
+    def freeze(self) -> None:
+        self._frozen = True
+
+    @property
+    def K(self) -> int:
+        return len(self.keys)
+
+    @property
+    def D(self) -> int:
+        """Padded per-key domain width including the OTHER slot."""
+        return (max((len(v) for v in self.values), default=0)) + 1
+
+    @property
+    def W(self) -> int:
+        return (self.D + 31) // 32
+
+    @property
+    def R(self) -> int:
+        return len(self.resources)
+
+    def other_bit(self, k: int) -> int:
+        """The OTHER slot index for key k (just past its concrete values)."""
+        return len(self.values[k])
+
+
+@dataclass
+class EncodedRequirements:
+    """One entity's requirement set in tensor form. Rows stack into batches."""
+    mask: np.ndarray        # uint32 [K, W]
+    defined: np.ndarray     # bool [K]
+    complement: np.ndarray  # bool [K]
+    exempt: np.ndarray      # bool [K]  (operator in {NotIn, DoesNotExist})
+    gt: np.ndarray          # int32 [K] (INT_MIN when unset)
+    lt: np.ndarray          # int32 [K] (INT_MAX when unset)
+
+
+def _int_or_none(s: str):
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def encode_requirements(vocab: Vocab, reqs: Requirements) -> EncodedRequirements:
+    K, W = vocab.K, vocab.W
+    mask = np.zeros((K, W), dtype=np.uint32)
+    defined = np.zeros(K, dtype=bool)
+    complement = np.ones(K, dtype=bool)  # undefined == Exists
+    exempt = np.zeros(K, dtype=bool)
+    gt = np.full(K, INT_MIN, dtype=np.int64)
+    lt = np.full(K, INT_MAX, dtype=np.int64)
+
+    # undefined keys behave as Exists: every bit set (incl. OTHER)
+    mask[:, :] = 0xFFFFFFFF
+    _trim_tail_bits(vocab, mask)
+
+    for key in reqs:
+        r = reqs.get(key)
+        k = vocab.key_idx[api_labels.NORMALIZED_LABELS.get(key, key)]
+        defined[k] = True
+        complement[k] = r.complement
+        op = r.operator()
+        exempt[k] = op in ("NotIn", "DoesNotExist")
+        if r.greater_than is not None:
+            gt[k] = r.greater_than
+        if r.less_than is not None:
+            lt[k] = r.less_than
+        row = np.zeros(W, dtype=np.uint32)
+        if r.complement:
+            # all known values except excluded, filtered by bounds; OTHER set
+            # unless individually crossed (it never is at construction)
+            for i, v in enumerate(vocab.values[k]):
+                if v in r.values:
+                    continue
+                iv = _int_or_none(v)
+                if r.greater_than is not None or r.less_than is not None:
+                    if iv is None:
+                        continue
+                    if r.greater_than is not None and iv <= r.greater_than:
+                        continue
+                    if r.less_than is not None and iv >= r.less_than:
+                        continue
+                row[i // 32] |= np.uint32(1 << (i % 32))
+            ob = vocab.other_bit(k)
+            row[ob // 32] |= np.uint32(1 << (ob % 32))
+        else:
+            for v in r.values:
+                i = vocab.value_idx[k].get(v)
+                if i is not None:
+                    row[i // 32] |= np.uint32(1 << (i % 32))
+                # In-values outside the vocab can never match any other entity;
+                # dropping them is exact because the vocab covers all entities
+                # in the solve.
+        mask[k] = row
+    return EncodedRequirements(mask=mask, defined=defined, complement=complement,
+                               exempt=exempt, gt=gt.astype(np.int64), lt=lt.astype(np.int64))
+
+
+def _trim_tail_bits(vocab: Vocab, mask: np.ndarray) -> None:
+    """Zero bits beyond each key's OTHER slot so popcounts stay meaningful."""
+    for k in range(vocab.K):
+        ob = vocab.other_bit(k)
+        for w in range(vocab.W):
+            lo_bit = w * 32
+            hi_bit = lo_bit + 32
+            if hi_bit <= ob:
+                continue
+            keep = max(0, ob + 1 - lo_bit)
+            mask[k, w] &= np.uint32((1 << keep) - 1) if keep < 32 else np.uint32(0xFFFFFFFF)
+
+
+def stack_encoded(items: Sequence[EncodedRequirements]) -> EncodedRequirements:
+    return EncodedRequirements(
+        mask=np.stack([e.mask for e in items]),
+        defined=np.stack([e.defined for e in items]),
+        complement=np.stack([e.complement for e in items]),
+        exempt=np.stack([e.exempt for e in items]),
+        gt=np.stack([e.gt for e in items]),
+        lt=np.stack([e.lt for e in items]))
+
+
+def encode_resource_vector(vocab: Vocab, rl: dict, *, capacity: bool) -> np.ndarray:
+    out = np.zeros(vocab.R, dtype=np.int64)
+    for name, milli in rl.items():
+        idx = vocab.resource_idx.get(name)
+        if idx is None:
+            continue
+        out[idx] = scale_capacity(name, milli) if capacity else scale_request(name, milli)
+    return out

@@ -1,0 +1,151 @@
+"""JAX feasibility kernels over encoded requirement tensors.
+
+These reproduce, as dense vector ops, exactly the checks the host scheduler
+runs per pod x instance-type (reference: scheduling/nodeclaim.go:248-301 —
+compatible() = Requirements.Intersects, fits() = resources.Fits, offering
+compatibility = Offerings.Available().HasCompatible):
+
+- ``intersects_matrix``  [A,B]: pairwise Requirements.Intersects emptiness rule
+  incl. the both-sides-{NotIn,DoesNotExist} exemption and Gt/Lt joint-bound
+  collapse (requirements.go:283-304, requirement.go:155-188).
+- ``compatible_matrix``  [A,B]: Intersects plus the undefined-key rule with an
+  allow-undefined key set (requirements.go:175-187).
+- ``fits_matrix``        [A,B]: int32 resource fit.
+- ``offering_compat``    [B,T]: any available offering whose (zone, capacity
+  type) values are admitted by the B-side masks.
+- ``combine``: requirement-set intersection of two encoded batches — the tensor
+  analogue of Requirements.Add over all keys at once.
+
+All kernels are shape-polymorphic pure functions; jit/vmap/shard_map friendly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Enc(NamedTuple):
+    """Device-side batch of encoded requirement sets ([..., K, W] / [..., K])."""
+    mask: jax.Array        # uint32 [..., K, W]
+    defined: jax.Array     # bool [..., K]
+    complement: jax.Array  # bool [..., K]
+    exempt: jax.Array      # bool [..., K]
+    gt: jax.Array          # int32 [..., K]
+    lt: jax.Array          # int32 [..., K]
+
+
+def to_device(e) -> Enc:
+    return Enc(mask=jnp.asarray(e.mask.astype(np.uint32)),
+               defined=jnp.asarray(e.defined),
+               complement=jnp.asarray(e.complement),
+               exempt=jnp.asarray(e.exempt),
+               gt=jnp.asarray(np.clip(e.gt, -2**31, 2**31 - 1).astype(np.int32)),
+               lt=jnp.asarray(np.clip(e.lt, -2**31, 2**31 - 1).astype(np.int32)))
+
+
+def _pairwise_nonempty(a: Enc, b: Enc):
+    """[A,B,K] mask-AND emptiness + joint bound collapse."""
+    # accumulate over words to keep peak memory at [A,B,K]
+    W = a.mask.shape[-1]
+    nonempty = None
+    for w in range(W):
+        inter = a.mask[:, None, :, w] & b.mask[None, :, :, w]
+        nz = inter != 0
+        nonempty = nz if nonempty is None else (nonempty | nz)
+    gt = jnp.maximum(a.gt[:, None, :], b.gt[None, :, :])
+    lt = jnp.minimum(a.lt[:, None, :], b.lt[None, :, :])
+    both_bounded = (gt > jnp.int32(-2**31)) & (lt < jnp.int32(2**31 - 1))
+    crossed = both_bounded & (gt >= lt)
+    return nonempty & ~crossed
+
+
+def intersects_matrix(a: Enc, b: Enc) -> jax.Array:
+    """[A,B] True where a.Intersects(b) passes (requirements.go:283-304)."""
+    nonempty = _pairwise_nonempty(a, b)
+    checked = a.defined[:, None, :] & b.defined[None, :, :]
+    exempt = a.exempt[:, None, :] & b.exempt[None, :, :]
+    bad = checked & ~nonempty & ~exempt
+    return ~jnp.any(bad, axis=-1)
+
+
+def compatible_matrix(a: Enc, b: Enc, allow_undefined: jax.Array) -> jax.Array:
+    """[A,B] True where a.Compatible(b, allow_undefined) passes
+    (requirements.go:175-187). allow_undefined: bool [K]."""
+    nonempty = _pairwise_nonempty(a, b)
+    checked = a.defined[:, None, :] & b.defined[None, :, :]
+    exempt = a.exempt[:, None, :] & b.exempt[None, :, :]
+    bad = checked & ~nonempty & ~exempt
+    undef_bad = (b.defined[None, :, :] & ~a.defined[:, None, :]
+                 & ~allow_undefined[None, None, :] & ~b.exempt[None, :, :])
+    return ~jnp.any(bad | undef_bad, axis=-1)
+
+
+def combine(a: Enc, b: Enc) -> Enc:
+    """Per-key intersection of two aligned batches (shapes must broadcast) —
+    the tensor analogue of Requirements.Add(...) over every key at once
+    (requirement.go:155-188 semantics)."""
+    gt = jnp.maximum(a.gt, b.gt)
+    lt = jnp.minimum(a.lt, b.lt)
+    both_bounded = (gt > jnp.int32(-2**31)) & (lt < jnp.int32(2**31 - 1))
+    crossed = both_bounded & (gt >= lt)
+    mask = jnp.where(crossed[..., None], jnp.uint32(0), a.mask & b.mask)
+    complement = a.complement & b.complement & ~crossed
+    empty = ~jnp.any(mask != 0, axis=-1)
+    exempt = jnp.where(complement, a.exempt | b.exempt, empty)
+    # concrete results drop bounds (requirement.go:183-186)
+    gt = jnp.where(complement, gt, jnp.int32(-2**31))
+    lt = jnp.where(complement, lt, jnp.int32(2**31 - 1))
+    return Enc(mask=mask, defined=a.defined | b.defined, complement=complement,
+               exempt=exempt, gt=gt, lt=lt)
+
+
+def fits_matrix(requests: jax.Array, available: jax.Array) -> jax.Array:
+    """requests [B,R] x available [A,R] -> [A,B] bool (resources.Fits:
+    zero-valued requests always fit; missing resources encode as 0)."""
+    req = requests[None, :, :]
+    avail = available[:, None, :]
+    ok = (req <= 0) | (req <= avail)
+    return jnp.all(ok, axis=-1)
+
+
+def offering_compat(mask_b: jax.Array, zone_key: int, captype_key: int,
+                    off_zone: jax.Array, off_captype: jax.Array,
+                    off_available: jax.Array) -> jax.Array:
+    """[B,T]: does any available offering of instance type t satisfy entity b's
+    zone/capacity-type masks? (Offerings.Available().HasCompatible — an
+    offering passes when the entity's mask at the key admits its single value.)
+
+    mask_b: uint32 [B,K,W]; off_zone/off_captype: int32 [T,O] value indices
+    (-1 == offering doesn't constrain that key); off_available: bool [T,O].
+    """
+    def bit_ok(masks, key, idx):
+        # masks [B,W'] for the key; idx [T,O]
+        word = jnp.where(idx >= 0, idx // 32, 0)
+        bit = jnp.where(idx >= 0, idx % 32, 0)
+        m = masks[:, None, None, :]            # [B,1,1,W]
+        w = jnp.take_along_axis(
+            jnp.broadcast_to(m, m.shape[:1] + idx.shape + m.shape[-1:]),
+            jnp.broadcast_to(word[None, :, :, None], (masks.shape[0],) + idx.shape + (1,)),
+            axis=-1)[..., 0]                   # [B,T,O]
+        has = (w >> bit[None, :, :].astype(jnp.uint32)) & jnp.uint32(1)
+        return jnp.where(idx[None, :, :] >= 0, has == 1, True)
+
+    zone_ok = bit_ok(mask_b[:, zone_key, :], zone_key, off_zone)
+    cap_ok = bit_ok(mask_b[:, captype_key, :], captype_key, off_captype)
+    ok = off_available[None, :, :] & zone_ok & cap_ok
+    return jnp.any(ok, axis=-1)
+
+
+def pods_per_node(alloc: jax.Array, overhead: jax.Array, req: jax.Array) -> jax.Array:
+    """alloc [T,R], overhead [M,R] (daemon), req [G,R] -> [G,M,T] int32: how many
+    identical pods fit a fresh node of type t under template m. Zero-request
+    resources don't constrain."""
+    free = alloc[None, :, :] - overhead[:, None, :]      # [M,T,R]
+    free = jnp.maximum(free, 0)
+    r = req[:, None, None, :]                            # [G,1,1,R]
+    per = jnp.where(r > 0, free[None] // jnp.maximum(r, 1), jnp.int32(2**30))
+    return jnp.min(per, axis=-1).astype(jnp.int32)       # [G,M,T]

@@ -1,0 +1,171 @@
+"""Pod equivalence-class extraction for the tensor solver.
+
+The reference scheduler loops pod-by-pod (scheduler.go:218-254), refiltering
+instance types per pod — O(pods x ITs). Pods stamped from the same deployment
+are interchangeable: identical requests, requirements, tolerations, labels and
+topology constraints. Grouping collapses the loop to O(groups), which is the
+main algorithmic win of the TPU design (SURVEY.md §7 layer 3).
+
+A batch is *tensor-eligible* when every group's topology constraints fall in
+the kernel-supported forms below and no constraint selects pods of another
+group (cross-group count coupling). Otherwise the scheduler transparently
+falls back to the host solver, whose semantics are always authoritative.
+
+Supported per-group topology forms (self-selecting only):
+- zonal topology spread        (topologygroup.go nextDomainTopologySpread)
+- hostname topology spread
+- zonal pod affinity           (all pods collapse to one zone)
+- hostname pod affinity        (all pods onto one node, overflow unschedulable)
+- zonal pod anti-affinity      (late committal: one pod per batch schedules)
+- hostname pod anti-affinity   (one pod per node)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import labels as api_labels
+from ..api.objects import DO_NOT_SCHEDULE, Pod
+from ..scheduling.requirements import (Requirements, has_preferred_node_affinity,
+                                       pod_requirements)
+
+# topology kinds
+TOPO_NONE = "none"
+SPREAD_ZONE = "spread-zone"
+SPREAD_HOST = "spread-host"
+AFFINITY_ZONE = "affinity-zone"
+AFFINITY_HOST = "affinity-host"
+ANTI_ZONE = "anti-zone"
+ANTI_HOST = "anti-host"
+
+
+@dataclass
+class TopoSpec:
+    kind: str
+    max_skew: int = 1
+    schedule_anyway: bool = False  # relaxable on failure
+
+
+@dataclass
+class PodGroup:
+    pods: List[Pod]
+    requirements: Requirements        # NewPodRequirements view (preferred folded in)
+    requests: dict                    # milliunit ResourceList (per pod)
+    tolerations: tuple
+    labels: dict
+    topo: List[TopoSpec] = field(default_factory=list)
+    has_relaxable: bool = False       # preferred affinities / ScheduleAnyway present
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def _req_signature(reqs: Requirements):
+    return tuple(sorted(
+        (k, reqs.get(k).complement, frozenset(reqs.get(k).values),
+         reqs.get(k).greater_than, reqs.get(k).less_than, reqs.get(k).min_values)
+        for k in reqs))
+
+
+def _selector_is_self(selector, labels: dict) -> bool:
+    return selector is not None and selector.matches(labels)
+
+
+def _classify_topology(pod: Pod) -> "Tuple[Optional[List[TopoSpec]], bool]":
+    """Returns (specs, relaxable) or (None, _) when unsupported by the kernel."""
+    specs: List[TopoSpec] = []
+    relaxable = False
+    for tsc in pod.spec.topology_spread_constraints:
+        if tsc.min_domains is not None:
+            return None, relaxable
+        if not _selector_is_self(tsc.label_selector, pod.labels):
+            return None, relaxable
+        anyway = tsc.when_unsatisfiable != DO_NOT_SCHEDULE
+        relaxable |= anyway
+        if tsc.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
+            specs.append(TopoSpec(SPREAD_ZONE, tsc.max_skew, anyway))
+        elif tsc.topology_key == api_labels.LABEL_HOSTNAME:
+            specs.append(TopoSpec(SPREAD_HOST, tsc.max_skew, anyway))
+        else:
+            return None, relaxable
+    aff = pod.spec.affinity
+    if aff is not None:
+        if aff.pod_affinity is not None:
+            relaxable |= bool(aff.pod_affinity.preferred)
+            for term in aff.pod_affinity.required:
+                if not _selector_is_self(term.label_selector, pod.labels):
+                    return None, relaxable
+                if term.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
+                    specs.append(TopoSpec(AFFINITY_ZONE))
+                elif term.topology_key == api_labels.LABEL_HOSTNAME:
+                    specs.append(TopoSpec(AFFINITY_HOST))
+                else:
+                    return None, relaxable
+        if aff.pod_anti_affinity is not None:
+            relaxable |= bool(aff.pod_anti_affinity.preferred)
+            for term in aff.pod_anti_affinity.required:
+                if not _selector_is_self(term.label_selector, pod.labels):
+                    return None, relaxable
+                if term.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
+                    specs.append(TopoSpec(ANTI_ZONE))
+                elif term.topology_key == api_labels.LABEL_HOSTNAME:
+                    specs.append(TopoSpec(ANTI_HOST))
+                else:
+                    return None, relaxable
+    if len(specs) > 1:
+        return None, relaxable  # multi-constraint groups: host path for now
+    return specs, relaxable
+
+
+def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
+    """Returns (groups, "") or (None, reason-for-host-fallback)."""
+    groups: Dict = {}
+    order: List = []
+    for pod in pods:
+        specs, relaxable = _classify_topology(pod)
+        if specs is None:
+            return None, "unsupported topology constraint shape"
+        if pod.spec.host_ports:
+            return None, "host ports require per-pod conflict tracking"
+        reqs = pod_requirements(pod)
+        sig = (
+            _req_signature(reqs),
+            tuple(sorted(pod.requests().items())),
+            tuple(sorted(pod.spec.tolerations, key=repr)),
+            tuple(sorted(pod.labels.items())),
+            tuple((s.kind, s.max_skew, s.schedule_anyway) for s in specs),
+        )
+        g = groups.get(sig)
+        if g is None:
+            g = PodGroup(pods=[], requirements=reqs, requests=pod.requests(),
+                         tolerations=tuple(pod.spec.tolerations),
+                         labels=dict(pod.labels), topo=specs,
+                         has_relaxable=relaxable or has_preferred_node_affinity(pod))
+            groups[sig] = g
+            order.append(g)
+        g.pods.append(pod)
+
+    # cross-group selector coupling: any group's topology selector matching
+    # another group's labels means shared domain counts -> host path
+    for gi in order:
+        if not gi.topo:
+            continue
+        sel_sources = []
+        for p in (gi.pods[0],):
+            for tsc in p.spec.topology_spread_constraints:
+                sel_sources.append(tsc.label_selector)
+            aff = p.spec.affinity
+            if aff is not None:
+                for term in (aff.pod_affinity.required if aff.pod_affinity else []):
+                    sel_sources.append(term.label_selector)
+                for term in (aff.pod_anti_affinity.required if aff.pod_anti_affinity else []):
+                    sel_sources.append(term.label_selector)
+        for gj in order:
+            if gi is gj:
+                continue
+            for sel in sel_sources:
+                if sel is not None and sel.matches(gj.labels):
+                    return None, "topology selector couples multiple pod groups"
+    return order, ""

@@ -1,0 +1,339 @@
+"""TPU-accelerated scheduler front end.
+
+Builds the encoded PackProblem from the same inputs the host Scheduler takes,
+runs the device feasibility precompute + grouped packer (ops/binpack.py), and
+materializes results in the host Results shape. Falls back to the host oracle
+scheduler (provisioning/scheduler.py) whenever the batch isn't expressible in
+the tensor kernel or when packing left relaxable pods unscheduled — so observable
+semantics always match the reference (scheduler.go) either way.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim as APINodeClaim, NodeClaimSpec
+from ..api.objects import ObjectMeta, OwnerReference, Pod
+from ..cloudprovider.types import InstanceType, order_by_price
+from ..ops import binpack
+from ..ops import encode as enc
+from ..scheduling import taints as scheduling_taints
+from ..scheduling.requirement import IN, Requirement
+from ..scheduling.requirements import (ALLOW_UNDEFINED_WELL_KNOWN, Requirements,
+                                       label_requirements)
+from ..utils import resources as res
+from .grouping import PodGroup, group_pods
+from .scheduler import (MAX_INSTANCE_TYPES, NodeClaimTemplate, Results, Scheduler,
+                        _daemon_overhead, _req_to_selector)
+from .topology import ClusterView, Topology
+
+_name_seq = itertools.count(1)
+
+
+class TensorNodeClaim:
+    """A launch decision produced by the tensor packer; interface-compatible
+    with provisioning.scheduler.InFlightNodeClaim for downstream consumers."""
+
+    def __init__(self, template: NodeClaimTemplate, requirements: Requirements,
+                 instance_types: List[InstanceType], pods: List[Pod], requests: dict):
+        self.template = template
+        self.requirements = requirements
+        self.instance_type_options = instance_types
+        self.pods = pods
+        self.requests = requests
+
+    def finalize(self) -> None:
+        self.requirements.delete(api_labels.LABEL_HOSTNAME)
+
+    def to_nodeclaim(self) -> APINodeClaim:
+        t = self.template
+        reqs = Requirements(self.requirements.values())
+        instance_types = self.instance_type_options[:MAX_INSTANCE_TYPES]
+        mv = reqs.get(api_labels.LABEL_INSTANCE_TYPE).min_values
+        reqs.add(Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
+                             [it.name for it in instance_types], min_values=mv))
+        return APINodeClaim(
+            metadata=ObjectMeta(
+                name=f"{t.nodepool_name}-{next(_name_seq):05d}",
+                labels=dict(t.labels), annotations=dict(t.annotations),
+                owner_refs=[OwnerReference(kind="NodePool", name=t.nodepool_name,
+                                           uid=t.nodepool_uid, block_owner_deletion=True)]),
+            spec=NodeClaimSpec(
+                requirements=[_req_to_selector(r) for r in reqs.values()],
+                resources_requests=dict(self.requests),
+                taints=list(t.taints), startup_taints=list(t.startup_taints),
+                node_class_ref=t.node_class_ref, expire_after=t.expire_after,
+                termination_grace_period=t.termination_grace_period))
+
+
+@dataclass
+class TensorExistingNode:
+    state_node: object
+    pods: List[Pod]
+
+    @property
+    def name(self):
+        return self.state_node.name()
+
+
+class TensorScheduler:
+    def __init__(self, nodepools, instance_types: Dict[str, List[InstanceType]],
+                 state_nodes=(), daemonset_pods: List[Pod] = (),
+                 cluster: Optional[ClusterView] = None,
+                 initial_zone_counts=None, force_tensor: bool = False):
+        self.nodepools = list(nodepools)
+        self.instance_types = instance_types
+        self.state_nodes = list(state_nodes)
+        self.daemonset_pods = list(daemonset_pods)
+        self.cluster = cluster or ClusterView()
+        self.initial_zone_counts = initial_zone_counts  # callable (group, zones)->counts
+        self.force_tensor = force_tensor
+        self.fallback_reason: str = ""
+
+    # -- public -------------------------------------------------------------
+
+    def solve(self, pods: List[Pod]) -> Results:
+        groups, reason = group_pods(pods)
+        if groups is None:
+            return self._host_solve(pods, reason)
+        try:
+            results = self._tensor_solve(groups, pods)
+        except _FallbackError as e:
+            return self._host_solve(pods, str(e))
+        if results.pod_errors and not self.force_tensor and any(
+                g.has_relaxable for g in groups):
+            return self._host_solve(pods, "unscheduled pods with relaxable preferences")
+        return results
+
+    def _host_solve(self, pods: List[Pod], reason: str) -> Results:
+        self.fallback_reason = reason
+        from .domains import build_topology_domains
+        domains = build_topology_domains(self.nodepools, self.instance_types)
+        topo = Topology(self.cluster, domains, pods)
+        host = Scheduler(self.nodepools, self.instance_types, topo,
+                         state_nodes=self.state_nodes,
+                         daemonset_pods=self.daemonset_pods)
+        return host.solve(pods)
+
+    # -- tensor path ----------------------------------------------------------
+
+    def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
+        self.fallback_reason = ""
+        templates: List[NodeClaimTemplate] = []
+        for np_ in self.nodepools:
+            nct = NodeClaimTemplate(np_)
+            nct.instance_type_options = self.instance_types.get(np_.name, [])
+            if nct.instance_type_options:
+                templates.append(nct)
+        if not templates:
+            raise _FallbackError("no nodepools with instance types")
+
+        # union instance-type catalog
+        catalog: List[InstanceType] = []
+        it_index: Dict[str, int] = {}
+        for nct in templates:
+            for it in nct.instance_type_options:
+                if it.name not in it_index:
+                    it_index[it.name] = len(catalog)
+                    catalog.append(it)
+        T = len(catalog)
+        M = len(templates)
+        G = len(groups)
+
+        vocab = enc.Vocab()
+        zone_key = vocab.add_key(api_labels.LABEL_TOPOLOGY_ZONE)
+        captype_key = vocab.add_key(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        for it in catalog:
+            vocab.observe_requirements(it.requirements)
+            vocab.observe_resources(it.capacity)
+            for off in it.offerings:
+                vocab.observe_requirements(off.requirements)
+        for nct in templates:
+            vocab.observe_requirements(nct.requirements)
+        for g in groups:
+            vocab.observe_requirements(g.requirements)
+            vocab.observe_resources(g.requests)
+        for sn in self.state_nodes:
+            vocab.observe_requirements(label_requirements(sn.labels()))
+            vocab.observe_resources(sn.allocatable())
+        vocab.freeze()
+
+        group_enc = enc.stack_encoded(
+            [enc.encode_requirements(vocab, g.requirements) for g in groups])
+        template_enc = enc.stack_encoded(
+            [enc.encode_requirements(vocab, t.requirements) for t in templates])
+        it_enc = enc.stack_encoded(
+            [enc.encode_requirements(vocab, it.requirements) for it in catalog])
+
+        group_req = np.stack([enc.encode_resource_vector(vocab, g.requests, capacity=False)
+                              for g in groups])
+        daemon = np.stack([
+            enc.encode_resource_vector(vocab, _daemon_overhead(t, self.daemonset_pods),
+                                       capacity=False)
+            for t in templates])
+        it_alloc = np.stack([enc.encode_resource_vector(vocab, it.allocatable(), capacity=True)
+                             for it in catalog])
+        it_capacity = np.stack([enc.encode_resource_vector(vocab, it.capacity, capacity=True)
+                                for it in catalog])
+        template_its = np.zeros((M, T), dtype=bool)
+        for m, nct in enumerate(templates):
+            for it in nct.instance_type_options:
+                template_its[m, it_index[it.name]] = True
+
+        # offerings
+        O = max((len(it.offerings) for it in catalog), default=1)
+        off_zone = np.full((T, O), -1, dtype=np.int32)
+        off_captype = np.full((T, O), -1, dtype=np.int32)
+        off_available = np.zeros((T, O), dtype=bool)
+        it_price = np.full(T, np.inf, dtype=np.float32)
+        for t, it in enumerate(catalog):
+            for o, off in enumerate(it.offerings):
+                if not off.available:
+                    continue
+                off_available[t, o] = True
+                z = off.zone
+                ct = off.capacity_type
+                if z:
+                    off_zone[t, o] = vocab.value_idx[zone_key].get(z, -1)
+                if ct:
+                    off_captype[t, o] = vocab.value_idx[captype_key].get(ct, -1)
+                it_price[t] = min(it_price[t], off.price)
+        zone_values = np.arange(len(vocab.values[zone_key]), dtype=np.int32)
+        allow_undefined = np.array([k in ALLOW_UNDEFINED_WELL_KNOWN for k in vocab.keys])
+
+        # taints: host-checked per (group, template) and (group, existing node)
+        tol_template = np.zeros((G, M), dtype=bool)
+        for gi, g in enumerate(groups):
+            probe = g.pods[0]
+            for m, nct in enumerate(templates):
+                tol_template[gi, m] = not scheduling_taints.tolerates(nct.taints, probe)
+
+        # existing nodes: initialized-first name order (scheduler.go:344-352)
+        sn_order = sorted(range(len(self.state_nodes)),
+                          key=lambda i: (not self.state_nodes[i].initialized(),
+                                         self.state_nodes[i].name()))
+        exist_enc = exist_avail = exist_zone = tol_exist = None
+        if self.state_nodes:
+            encs, avails, zones = [], [], []
+            tol_exist = np.zeros((G, len(self.state_nodes)), dtype=bool)
+            for i, sn in enumerate(self.state_nodes):
+                reqs = label_requirements(sn.labels())
+                encs.append(enc.encode_requirements(vocab, reqs))
+                node_daemons = _node_remaining_daemons(sn, templates, self.daemonset_pods)
+                avail = res.subtract(sn.available(), node_daemons)
+                avails.append(enc.encode_resource_vector(vocab, avail, capacity=True))
+                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
+                zones.append(vocab.value_idx[zone_key].get(z, -1))
+                nt = sn.taints()
+                for gi, g in enumerate(groups):
+                    tol_exist[gi, i] = not scheduling_taints.tolerates(nt, g.pods[0])
+            exist_enc = enc.stack_encoded(encs)
+            exist_avail = np.stack(avails)
+            exist_zone = np.array(zones, dtype=np.int32)
+
+        problem = binpack.PackProblem(
+            vocab=vocab, group_enc=group_enc, group_req=group_req,
+            group_count=np.array([g.count for g in groups], dtype=np.int64),
+            template_enc=template_enc, daemon_overhead=daemon,
+            tol_template=tol_template, it_enc=it_enc, it_alloc=it_alloc,
+            it_capacity=it_capacity, it_price=it_price, template_its=template_its,
+            off_zone=off_zone, off_captype=off_captype, off_available=off_available,
+            zone_key=zone_key, captype_key=captype_key, zone_values=zone_values,
+            exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
+            tol_exist=tol_exist, allow_undefined=allow_undefined)
+
+        tensors = binpack.precompute(problem)
+
+        # nodepool limits (scaled), minus existing node capacity per pool
+        limits: List[Optional[dict]] = []
+        for nct in templates:
+            np_obj = next(p for p in self.nodepools if p.name == nct.nodepool_name)
+            if not np_obj.spec.limits:
+                limits.append(None)
+                continue
+            rem = dict(np_obj.spec.limits)
+            for sn in self.state_nodes:
+                if sn.labels().get(api_labels.NODEPOOL_LABEL_KEY) == nct.nodepool_name:
+                    rem = res.subtract(rem, sn.capacity())
+            limits.append({k: enc.scale_capacity(k, v) for k, v in rem.items()})
+        limit_resources = sorted({k for lm in limits if lm for k in lm})
+
+        Z = len(zone_values)
+        izc = np.zeros((G, Z), dtype=np.int64)
+        if self.initial_zone_counts is not None:
+            zone_names = vocab.values[zone_key]
+            for gi, g in enumerate(groups):
+                counts = self.initial_zone_counts(g, zone_names)
+                for z, cnt in enumerate(counts):
+                    izc[gi, z] = cnt
+
+        packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
+                                initial_zone_counts=izc, exist_order=sn_order)
+        pr = packer.pack()
+        return self._materialize(pr, groups, templates, catalog, vocab, zone_key)
+
+    def _materialize(self, pr: binpack.PackResult, groups, templates, catalog,
+                     vocab, zone_key) -> Results:
+        # hand out pod objects per group in order
+        cursors = [0] * len(groups)
+
+        def take(g: int, n: int) -> List[Pod]:
+            out = groups[g].pods[cursors[g]:cursors[g] + n]
+            cursors[g] += n
+            return out
+
+        new_claims: List[TensorNodeClaim] = []
+        for cohort in pr.cohorts:
+            its = [catalog[t] for t in np.where(cohort.it_set)[0]]
+            for _ in range(cohort.n):
+                reqs = Requirements(templates[cohort.m].requirements.values())
+                requests: dict = {}
+                pods: List[Pod] = []
+                for g, fill in cohort.pods_by_group.items():
+                    reqs.add(*groups[g].requirements.values())
+                    node_pods = take(g, fill)
+                    pods.extend(node_pods)
+                    requests = res.merge(requests,
+                                         *(p.requests() for p in node_pods))
+                if cohort.zone is not None:
+                    zone_name = vocab.values[zone_key][cohort.zone]
+                    reqs.add(Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [zone_name]))
+                ordered = order_by_price(its, reqs)
+                new_claims.append(TensorNodeClaim(
+                    templates[cohort.m], reqs, ordered, pods, requests))
+        existing: List[TensorExistingNode] = []
+        for n, fills in pr.existing.items():
+            pods = []
+            for g, fill in fills:
+                pods.extend(take(g, fill))
+            existing.append(TensorExistingNode(self.state_nodes[n], pods))
+        errors = dict(pr.errors)
+        return Results(new_nodeclaims=new_claims, existing_nodes=existing,
+                       pod_errors=errors)
+
+
+class _FallbackError(Exception):
+    pass
+
+
+def _node_remaining_daemons(sn, templates, daemonset_pods) -> dict:
+    """Remaining daemonset overhead a node must still absorb
+    (existingnode.go:44-54)."""
+    from ..scheduling.requirements import pod_requirements as preqs
+    daemons = []
+    node_taints = sn.taints()
+    node_reqs = label_requirements(sn.labels())
+    for p in daemonset_pods:
+        if scheduling_taints.tolerates(node_taints, p):
+            continue
+        if node_reqs.compatible(preqs(p)):
+            continue
+        daemons.append(p)
+    total = res.merge(*(p.requests() for p in daemons)) if daemons else {}
+    remaining = res.subtract(total, sn.daemonset_requests())
+    return {k: max(v, 0) for k, v in remaining.items()}

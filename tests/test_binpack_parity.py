@@ -1,0 +1,180 @@
+"""Tensor packer vs host oracle scheduler: node-count parity on scenario
+batteries including the reference benchmark's diverse pod mix
+(scheduling_benchmark_test.go:233-247)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Taint, Toleration
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+from factories import (affinity_term, make_nodepool, make_pod, make_pods,
+                       make_scheduler, spread_hostname, spread_zone)
+
+
+def tensor_solve(nodepools, its, pods, **kw):
+    if not isinstance(its, dict):
+        its = {np.name: list(its) for np in nodepools}
+    ts = TensorScheduler(nodepools, its, force_tensor=True, **kw)
+    results = ts.solve(pods)
+    assert ts.fallback_reason == "", f"unexpected fallback: {ts.fallback_reason}"
+    return results
+
+
+def host_solve(nodepools, its, pods, **kw):
+    s = make_scheduler(nodepools, its, pods, **kw)
+    return s.solve(pods)
+
+
+def both(pods_fn, nodepools=None, its=None):
+    nodepools = nodepools or [make_nodepool()]
+    its = its if its is not None else kwok.construct_instance_types()
+    t = tensor_solve(nodepools, its, pods_fn())
+    h = host_solve(nodepools, its, pods_fn())
+    return t, h
+
+
+class TestPlainParity:
+    def test_single_group(self):
+        t, h = both(lambda: make_pods(50, cpu="500m", memory="512Mi"))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims)
+
+    def test_mixed_sizes(self):
+        def pods():
+            return (make_pods(20, cpu="2", memory="4Gi")
+                    + make_pods(30, cpu="500m", memory="1Gi")
+                    + make_pods(10, cpu="100m", memory="128Mi"))
+        t, h = both(pods)
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        th, hh = len(t.new_nodeclaims), len(h.new_nodeclaims)
+        assert abs(th - hh) <= max(1, round(0.02 * hh)), (th, hh)
+
+    def test_unschedulable(self):
+        t, h = both(lambda: make_pods(3, cpu="1000"))
+        assert len(t.pod_errors) == len(h.pod_errors) == 3
+
+    def test_tainted_pool_toleration(self):
+        np_ = make_nodepool(taints=[Taint(key="dedicated", value="x")])
+        tol = [Toleration(key="dedicated", operator="Exists")]
+        t, h = both(lambda: make_pods(10, tolerations=tol), nodepools=[np_])
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims)
+
+    def test_zone_selector(self):
+        def pods():
+            return make_pods(12, cpu="1",
+                             node_selector={api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-c"})
+        t, h = both(pods)
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims)
+        for nc in t.new_nodeclaims:
+            assert nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values == {"test-zone-c"}
+
+
+class TestTopologyParity:
+    def test_zonal_spread(self):
+        t, h = both(lambda: make_pods(16, labels={"app": "demo"}, spread=[spread_zone()]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        t_zones = sorted(nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0]
+                         for nc in t.new_nodeclaims)
+        h_zones = sorted(nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0]
+                         for nc in h.new_nodeclaims)
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims)
+        assert t_zones == h_zones
+
+    def test_hostname_spread(self):
+        t, h = both(lambda: make_pods(6, labels={"app": "demo"},
+                                      spread=[spread_hostname(max_skew=1)]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims) == 6
+
+    def test_hostname_anti_affinity(self):
+        t, h = both(lambda: make_pods(
+            7, labels={"app": "demo"},
+            pod_anti_affinity=[affinity_term(api_labels.LABEL_HOSTNAME)]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims) == 7
+
+    def test_zonal_affinity(self):
+        t, h = both(lambda: make_pods(
+            9, labels={"app": "demo"},
+            pod_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE)]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims)
+        t_zones = {nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE).values_list()[0]
+                   for nc in t.new_nodeclaims}
+        assert len(t_zones) == 1
+
+    def test_zonal_anti_affinity_late_committal(self):
+        t, h = both(lambda: make_pods(
+            3, labels={"app": "demo"},
+            pod_anti_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE)]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 2
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims) == 1
+
+    def test_hostname_affinity_single_node(self):
+        t, h = both(lambda: make_pods(
+            5, cpu="100m", labels={"app": "demo"},
+            pod_affinity=[affinity_term(api_labels.LABEL_HOSTNAME)]))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        assert len(t.new_nodeclaims) == len(h.new_nodeclaims) == 1
+
+
+class TestBenchmarkMixParity:
+    """The reference benchmark's diverse mix: 1/6 each generic, zone-spread,
+    host-spread, host-affinity, zone-affinity, host-anti-affinity."""
+
+    def _mix(self, n_per_kind):
+        pods = []
+        pods += make_pods(n_per_kind, cpu="1", memory="2Gi")
+        pods += make_pods(n_per_kind, cpu="500m", memory="1Gi",
+                          labels={"app": "spread-z"}, spread=[spread_zone(value="spread-z")])
+        pods += make_pods(n_per_kind, cpu="500m", memory="1Gi",
+                          labels={"app": "spread-h"}, spread=[spread_hostname(value="spread-h")])
+        pods += make_pods(n_per_kind, cpu="250m", memory="512Mi",
+                          labels={"app": "aff-h"},
+                          pod_affinity=[affinity_term(api_labels.LABEL_HOSTNAME,
+                                                      value="aff-h")])
+        pods += make_pods(n_per_kind, cpu="250m", memory="512Mi",
+                          labels={"app": "aff-z"},
+                          pod_affinity=[affinity_term(api_labels.LABEL_TOPOLOGY_ZONE,
+                                                      value="aff-z")])
+        pods += make_pods(n_per_kind, cpu="250m", memory="512Mi",
+                          labels={"app": "anti-h"},
+                          pod_anti_affinity=[affinity_term(api_labels.LABEL_HOSTNAME,
+                                                           value="anti-h")])
+        return pods
+
+    @pytest.mark.parametrize("n", [6, 18])
+    def test_mix_parity(self, n):
+        its = kwok.construct_instance_types()
+        np_ = [make_nodepool()]
+        t = tensor_solve(np_, its, self._mix(n))
+        h = host_solve(np_, its, self._mix(n))
+        assert len(t.pod_errors) == len(h.pod_errors), (t.pod_errors, h.pod_errors)
+        th, hh = len(t.new_nodeclaims), len(h.new_nodeclaims)
+        assert abs(th - hh) <= max(1, round(0.05 * hh)), (th, hh)
+
+
+class TestFallback:
+    def test_unsupported_topology_falls_back(self):
+        # region-key spread isn't kernel-supported -> host path
+        from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+        pods = [make_pod(labels={"app": "x"}, spread=[TopologySpreadConstraint(
+            topology_key=api_labels.LABEL_TOPOLOGY_REGION,
+            label_selector=LabelSelector(match_labels={"app": "x"}))])]
+        its = {"default": kwok.construct_instance_types()}
+        ts = TensorScheduler([make_nodepool()], its)
+        results = ts.solve(pods)
+        assert ts.fallback_reason != ""
+        assert results.pod_errors == {}
+
+    def test_cross_group_selector_falls_back(self):
+        pods = (make_pods(2, labels={"app": "x"}, spread=[spread_zone(key="app", value="x")])
+                + make_pods(2, cpu="200m", labels={"app": "x", "extra": "y"}))
+        its = {"default": kwok.construct_instance_types()}
+        ts = TensorScheduler([make_nodepool()], its)
+        ts.solve(pods)
+        assert ts.fallback_reason != ""

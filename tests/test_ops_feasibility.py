@@ -1,0 +1,138 @@
+"""Tensor-kernel vs host-algebra parity: the encoded mask/bound arithmetic must
+reproduce Requirements.Intersects/Compatible and Requirement.Intersection
+exactly, including complement/NotIn/Gt/Lt corner cases."""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops import encode as enc
+from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.scheduling.requirement import Requirement
+from karpenter_tpu.scheduling.requirements import (ALLOW_UNDEFINED_WELL_KNOWN,
+                                                   Requirements)
+
+KEYS = ["topology.kubernetes.io/zone", "kubernetes.io/arch", "example.com/team",
+        "example.com/tier", "example.com/gen"]
+VALUES = {
+    "topology.kubernetes.io/zone": ["z1", "z2", "z3", "z4"],
+    "kubernetes.io/arch": ["amd64", "arm64"],
+    "example.com/team": ["a", "b", "c"],
+    "example.com/tier": ["1", "2", "7", "12"],
+    "example.com/gen": ["1", "3", "5", "9", "x"],
+}
+INT_KEYS = ["example.com/tier", "example.com/gen"]
+
+
+def random_requirements(rng: random.Random) -> Requirements:
+    reqs = Requirements()
+    for key in KEYS:
+        roll = rng.random()
+        if roll < 0.35:
+            continue  # undefined
+        vals = VALUES[key]
+        if roll < 0.55:
+            reqs.add(Requirement(key, "In", rng.sample(vals, rng.randint(1, len(vals)))))
+        elif roll < 0.7:
+            reqs.add(Requirement(key, "NotIn", rng.sample(vals, rng.randint(1, len(vals)))))
+        elif roll < 0.78:
+            reqs.add(Requirement(key, "Exists"))
+        elif roll < 0.84:
+            reqs.add(Requirement(key, "DoesNotExist"))
+        elif key in INT_KEYS:
+            op = "Gt" if rng.random() < 0.5 else "Lt"
+            reqs.add(Requirement(key, op, [str(rng.randint(0, 13))]))
+        else:
+            reqs.add(Requirement(key, "In", rng.sample(vals, 1)))
+    return reqs
+
+
+def build_vocab(all_reqs):
+    v = enc.Vocab()
+    for key in KEYS:
+        v.add_key(key)
+        for val in VALUES[key]:
+            v.add_value(key, val)
+    for r in all_reqs:
+        v.observe_requirements(r)
+    v.freeze()
+    return v
+
+
+@pytest.fixture(scope="module")
+def random_pairs():
+    rng = random.Random(42)
+    a_sets = [random_requirements(rng) for _ in range(40)]
+    b_sets = [random_requirements(rng) for _ in range(40)]
+    return a_sets, b_sets
+
+
+def test_intersects_parity(random_pairs):
+    a_sets, b_sets = random_pairs
+    vocab = build_vocab(a_sets + b_sets)
+    a = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in a_sets]))
+    b = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in b_sets]))
+    got = np.asarray(feas.intersects_matrix(a, b))
+    for i, ra in enumerate(a_sets):
+        for j, rb in enumerate(b_sets):
+            want = not ra.intersects(rb)
+            assert got[i, j] == want, (
+                f"intersects mismatch a={ra!r} b={rb!r} got={got[i, j]} want={want}")
+
+
+def test_compatible_parity(random_pairs):
+    a_sets, b_sets = random_pairs
+    vocab = build_vocab(a_sets + b_sets)
+    a = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in a_sets]))
+    b = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in b_sets]))
+    allow = np.array([k in ALLOW_UNDEFINED_WELL_KNOWN for k in vocab.keys])
+    got = np.asarray(feas.compatible_matrix(a, b, allow))
+    for i, ra in enumerate(a_sets):
+        for j, rb in enumerate(b_sets):
+            want = ra.is_compatible(rb, ALLOW_UNDEFINED_WELL_KNOWN)
+            assert got[i, j] == want, (
+                f"compatible mismatch a={ra!r} b={rb!r} got={got[i, j]} want={want}")
+
+
+def test_combine_parity(random_pairs):
+    """combine(a,b).has(v) must equal host intersection membership for every
+    vocab value, and emptiness/exemption flags must line up."""
+    a_sets, b_sets = random_pairs
+    vocab = build_vocab(a_sets + b_sets)
+    a = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in a_sets]))
+    b = feas.to_device(enc.stack_encoded([enc.encode_requirements(vocab, r) for r in b_sets]))
+    # align pairwise (i with i)
+    merged = feas.combine(a, b)
+    mask = np.asarray(merged.mask)
+    for i, (ra, rb) in enumerate(zip(a_sets, b_sets)):
+        for key in KEYS:
+            k = vocab.key_idx[key]
+            inter = ra.get(key).intersection(rb.get(key))
+            for vi, val in enumerate(vocab.values[k]):
+                got_bit = bool((mask[i, k, vi // 32] >> (vi % 32)) & 1)
+                assert got_bit == inter.has(val), (
+                    f"combine bit mismatch key={key} val={val} a={ra.get(key)!r} "
+                    f"b={rb.get(key)!r} got={got_bit}")
+            # OTHER bit == complement-ness of the host intersection
+            ob = vocab.other_bit(k)
+            got_other = bool((mask[i, k, ob // 32] >> (ob % 32)) & 1)
+            assert got_other == inter.complement
+
+
+def test_fits_matrix():
+    requests = np.array([[100, 200, 1], [50, 800, 1], [0, 0, 0]], dtype=np.int32)
+    avail = np.array([[100, 500, 10], [40, 900, 10]], dtype=np.int32)
+    got = np.asarray(feas.fits_matrix(requests, avail))  # [A=2 avail, B=3 requests]
+    assert got.tolist() == [[True, False, True], [False, False, True]]
+
+
+def test_pods_per_node():
+    alloc = np.array([[1000, 4096, 16], [4000, 16384, 64]], dtype=np.int32)
+    overhead = np.array([[100, 0, 0]], dtype=np.int32)
+    req = np.array([[250, 512, 1], [5000, 512, 1]], dtype=np.int32)
+    got = np.asarray(feas.pods_per_node(alloc, overhead, req))
+    # group 0: t0 -> min(900//250=3, 8, 16)=3 ; t1 -> min(15, 32, 64)=15
+    assert got[0, 0].tolist() == [3, 15]
+    # group 1 never fits
+    assert got[1, 0].tolist() == [0, 0]
