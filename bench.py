@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Headline benchmark: provisioning Solve() throughput on the TPU tensor path.
+
+Workload mirrors the reference's scheduling benchmark mix
+(pkg/controllers/provisioning/scheduling/scheduling_benchmark_test.go:233-247):
+1/6 each generic, zonal topology spread, hostname topology spread, hostname
+pod affinity, zonal pod affinity, hostname pod anti-affinity — against the
+kwok 144-instance-type catalog (kwok/tools/gen_instance_types.go:52-113).
+
+Baseline: the reference's only published performance number is its hard
+benchmark gate of >= 100 pods/sec for batches > 100 pods
+(scheduling_benchmark_test.go:53,226-230). vs_baseline = pods_per_sec / 100.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodepool import (NodeClaimTemplate, NodeClaimTemplateSpec,
+                                        NodePool, NodePoolSpec)
+from karpenter_tpu.api.objects import (Affinity, LabelSelector, ObjectMeta, Pod,
+                                       PodAffinity, PodAffinityTerm, PodSpec,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.utils import resources as res
+
+N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
+N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+_CPUS = ["50m", "100m", "250m", "500m", "1000m"]
+_MEMS = ["64Mi", "128Mi", "256Mi", "512Mi", "1Gi"]
+
+
+def _pods():
+    pods = []
+    n_deploys = min(N_DEPLOYS, max(1, N_PODS))
+    per = max(1, N_PODS // n_deploys)
+    for d in range(n_deploys):
+        labels = {"app": f"deploy-{d}"}
+        sel = LabelSelector(match_labels=dict(labels))
+        spread, affinity = [], None
+        kind = d % 6
+        if kind == 1:
+            spread = [TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                label_selector=sel)]
+        elif kind == 2:
+            spread = [TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_HOSTNAME, max_skew=1,
+                label_selector=sel)]
+        elif kind == 3:
+            affinity = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
+                                label_selector=sel)]))
+        elif kind == 4:
+            affinity = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=api_labels.LABEL_TOPOLOGY_ZONE,
+                                label_selector=sel)]))
+        elif kind == 5:
+            affinity = Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
+                                label_selector=sel)]))
+        requests = res.parse_list({"cpu": _CPUS[d % 5], "memory": _MEMS[d % 5]})
+        for i in range(per):
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"p-{d}-{i}", namespace="default",
+                                    labels=dict(labels)),
+                spec=PodSpec(topology_spread_constraints=list(spread),
+                             affinity=affinity),
+                container_requests=[requests]))
+    return pods
+
+
+def _scheduler():
+    nodepool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplate(
+            spec=NodeClaimTemplateSpec())))
+    return TensorScheduler([nodepool], {"default": construct_instance_types()})
+
+
+def main():
+    pods = _pods()
+    # warmup: populate the jit cache at the exact shapes of the timed run
+    ts = _scheduler()
+    r = ts.solve(pods)
+    assert ts.fallback_reason == "", f"tensor path fell back: {ts.fallback_reason}"
+    scheduled = len(pods) - len(r.pod_errors)
+    assert scheduled > 0, "nothing scheduled"
+
+    best = float("inf")
+    for _ in range(REPEATS):
+        ts = _scheduler()
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        best = min(best, time.perf_counter() - t0)
+
+    pods_per_sec = len(pods) / best
+    print(json.dumps({
+        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x 144 "
+                   "instance types, reference benchmark pod mix"),
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(pods_per_sec / 100.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,12 +118,11 @@ class PackTensors:
     exist_cap: np.ndarray      # int32 [G, N]
 
 
-@partial(jax.jit, static_argnames=("zone_key", "captype_key", "has_exist"))
-def _precompute_device(group, template, it, group_req, daemon, alloc,
-                       template_its, off_zone, off_captype, off_available,
-                       zone_values, allow_undefined, tol_template,
-                       exist, exist_avail, tol_exist,
-                       *, zone_key: int, captype_key: int, has_exist: bool):
+def precompute_kernel(group, template, it, group_req, daemon, alloc,
+                      template_its, off_zone, off_captype, off_available,
+                      zone_values, allow_undefined, tol_template,
+                      exist, exist_avail, tol_exist,
+                      *, zone_key: int, captype_key: int, has_exist: bool):
     G = group.mask.shape[0]
     M = template.mask.shape[0]
     T = it.mask.shape[0]
@@ -146,14 +145,6 @@ def _precompute_device(group, template, it, group_req, daemon, alloc,
     zmask = cmb_flat.mask[:, zone_key, :]                    # [MG, W]
     zone_adm = ((jnp.take(zmask, zone_bit_words, axis=1)
                  >> zone_bits[None, :].astype(jnp.uint32)) & 1) == 1  # [MG, Z]
-    cap_ok = feas.offering_compat(cmb_flat.mask, zone_key, captype_key,
-                                  jnp.full_like(off_zone, -1), off_captype,
-                                  off_available)             # [MG, T] captype-only
-    # offering availability per zone: [T, Z]
-    off_in_zone = jnp.any(
-        (off_zone[:, :, None] == zone_values[None, None, :])
-        & off_available[:, :, None], axis=1)                 # [T, Z]
-    # captype admission must pair with the actual offering; recompute jointly:
     # offering o passes for (mg, t, z) iff available, zone==z, captype admitted
     cap_bit_ok = _offering_value_ok(cmb_flat.mask, captype_key, off_captype)  # [MG,T,O]
     zmatch = off_zone[None, :, :, None] == zone_values[None, None, None, :]   # [1,T,O,Z]
@@ -192,6 +183,10 @@ def _precompute_device(group, template, it, group_req, daemon, alloc,
             zone_adm_gmz, exist_ok, exist_cap)
 
 
+_precompute_device = partial(jax.jit, static_argnames=(
+    "zone_key", "captype_key", "has_exist"))(precompute_kernel)
+
+
 def _offering_value_ok(mask_b, key: int, off_val):
     """[B,T,O]: does mask_b admit each offering's single value at `key`
     (-1 == unconstrained)."""
@@ -203,7 +198,8 @@ def _offering_value_ok(mask_b, key: int, off_val):
     return jnp.where(off_val[None, :, :] >= 0, has == 1, True)
 
 
-def precompute(p: PackProblem) -> PackTensors:
+def device_args(p: PackProblem):
+    """Build the positional-array / static-kwarg split for precompute_kernel."""
     has_exist = p.exist_enc is not None and p.exist_enc.mask.shape[0] > 0
     dev = lambda e: feas.to_device(e)
     i32 = lambda a: jnp.asarray(np.clip(a, -INT32_MAX - 1, INT32_MAX).astype(np.int32))
@@ -221,15 +217,21 @@ def precompute(p: PackProblem) -> PackTensors:
                          lt=jnp.zeros((1, K), jnp.int32))
         exist_avail = jnp.zeros((1, p.group_req.shape[1]), jnp.int32)
         tol_exist = jnp.zeros((p.group_req.shape[0], 1), bool)
-    out = _precompute_device(
-        dev(p.group_enc), dev(p.template_enc), dev(p.it_enc),
-        i32(p.group_req), i32(p.daemon_overhead),
-        i32(p.it_alloc), jnp.asarray(p.template_its),
-        jnp.asarray(p.off_zone), jnp.asarray(p.off_captype),
-        jnp.asarray(p.off_available), jnp.asarray(p.zone_values),
-        jnp.asarray(p.allow_undefined), jnp.asarray(p.tol_template),
-        exist, exist_avail, tol_exist,
-        zone_key=p.zone_key, captype_key=p.captype_key, has_exist=has_exist)
+    args = (dev(p.group_enc), dev(p.template_enc), dev(p.it_enc),
+            i32(p.group_req), i32(p.daemon_overhead),
+            i32(p.it_alloc), jnp.asarray(p.template_its),
+            jnp.asarray(p.off_zone), jnp.asarray(p.off_captype),
+            jnp.asarray(p.off_available), jnp.asarray(p.zone_values),
+            jnp.asarray(p.allow_undefined), jnp.asarray(p.tol_template),
+            exist, exist_avail, tol_exist)
+    statics = dict(zone_key=p.zone_key, captype_key=p.captype_key,
+                   has_exist=has_exist)
+    return args, statics
+
+
+def precompute(p: PackProblem) -> PackTensors:
+    args, statics = device_args(p)
+    out = _precompute_device(*args, **statics)
     return PackTensors(*(np.asarray(x) for x in out))
 
 
@@ -366,11 +368,13 @@ class Packer:
     def _under_limits(self, m: int, it_set: np.ndarray) -> np.ndarray:
         limits = self.template_limits[m]
         ok = np.ones(self.T, dtype=bool)
-        for i, rname in enumerate(self.limit_resources):
+        for rname in self.limit_resources:
+            if rname not in limits:
+                continue  # this pool doesn't limit rname (limits.ExceededBy)
             ridx = self.p.vocab.resource_idx.get(rname)
             if ridx is None:
                 continue
-            ok &= self.p.it_capacity[:, ridx] <= limits.get(rname, 0)
+            ok &= self.p.it_capacity[:, ridx] <= limits[rname]
         return ok
 
     def _subtract_max(self, m: int, it_set: np.ndarray) -> None:

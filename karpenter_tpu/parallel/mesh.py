@@ -1,0 +1,184 @@
+"""Multi-chip sharded execution of the feasibility precompute.
+
+The solve's device program (ops/binpack.py precompute_kernel) is an outer
+product over (pod groups x templates x instance types x zones): every axis is
+embarrassingly shardable. We map it over a 2-D ``jax.sharding.Mesh``:
+
+- ``groups``  axis — data parallelism over pod equivalence classes (the
+  workload dimension; 50k pods collapse to O(100) groups but adversarial
+  batches can be group-heavy, e.g. every pod distinct);
+- ``catalog`` axis — model parallelism over the instance-type catalog (2k+
+  instance types at the north-star scale).
+
+The kernel has no contractions over sharded axes, so XLA/GSPMD lowers it with
+zero collectives on the forward pass; the only communication is the implicit
+all-gather when the host fetches the packed result tensors. Multi-host scale
+(DCN) therefore costs one result gather per solve.
+
+Reference analog: none — the Go scheduler is single-threaded per solve
+(scheduler.go:207-265); sharding the feasibility precompute is the TPU-native
+scale-out replacing the reference's pre-filter/truncate/timeout coping
+strategies (SURVEY.md §5 long-context note).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import binpack
+from ..ops import feasibility as feas
+
+GROUPS_AXIS = "groups"
+CATALOG_AXIS = "catalog"
+
+
+def make_solver_mesh(n_devices: Optional[int] = None,
+                     devices=None) -> Mesh:
+    """A (groups, catalog) mesh over the available devices. The groups axis
+    gets the larger factor: group count dominates at scale."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    catalog = 1
+    for f in (2, 3):
+        if n % f == 0 and n // f > 1:
+            catalog = f
+            break
+    grid = mesh_utils.create_device_mesh((n // catalog, catalog),
+                                         devices=np.array(devices))
+    return Mesh(grid, (GROUPS_AXIS, CATALOG_AXIS))
+
+
+def _pad_to(a: np.ndarray, axis: int, size: int, fill=0) -> np.ndarray:
+    cur = a.shape[axis]
+    if cur >= size:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, size - cur)
+    return np.pad(a, pad, constant_values=fill)
+
+
+def _pad_enc(e, axis: int, size: int):
+    from ..ops.encode import EncodedRequirements
+    return EncodedRequirements(
+        mask=_pad_to(e.mask, axis, size),
+        defined=_pad_to(e.defined, axis, size),
+        complement=_pad_to(e.complement, axis, size),
+        exempt=_pad_to(e.exempt, axis, size),
+        gt=_pad_to(e.gt, axis, size),
+        lt=_pad_to(e.lt, axis, size))
+
+
+def pad_problem(p: binpack.PackProblem, g_mult: int, t_mult: int
+                ) -> Tuple[binpack.PackProblem, int, int]:
+    """Pad the group and catalog axes up to multiples of the mesh dims.
+    Padded groups have empty masks (never compatible); padded instance types
+    are excluded via template_its=False. Returns (padded, G, T) with the
+    original sizes for un-padding results."""
+    G = p.group_req.shape[0]
+    T = p.it_alloc.shape[0]
+    Gp = math.ceil(G / g_mult) * g_mult
+    Tp = math.ceil(T / t_mult) * t_mult
+    if Gp == G and Tp == T:
+        return p, G, T
+    q = binpack.PackProblem(
+        vocab=p.vocab,
+        group_enc=_pad_enc(p.group_enc, 0, Gp),
+        group_req=_pad_to(p.group_req, 0, Gp),
+        group_count=_pad_to(p.group_count, 0, Gp),
+        template_enc=p.template_enc,
+        daemon_overhead=p.daemon_overhead,
+        tol_template=_pad_to(p.tol_template, 0, Gp),
+        it_enc=_pad_enc(p.it_enc, 0, Tp),
+        it_alloc=_pad_to(p.it_alloc, 0, Tp),
+        it_capacity=_pad_to(p.it_capacity, 0, Tp),
+        it_price=_pad_to(p.it_price, 0, Tp, fill=np.inf),
+        template_its=_pad_to(p.template_its, 1, Tp),
+        off_zone=_pad_to(p.off_zone, 0, Tp, fill=-1),
+        off_captype=_pad_to(p.off_captype, 0, Tp, fill=-1),
+        off_available=_pad_to(p.off_available, 0, Tp),
+        zone_key=p.zone_key, captype_key=p.captype_key,
+        zone_values=p.zone_values,
+        exist_enc=p.exist_enc, exist_avail=p.exist_avail,
+        exist_zone=p.exist_zone,
+        tol_exist=(_pad_to(p.tol_exist, 0, Gp)
+                   if p.tol_exist is not None else None),
+        allow_undefined=p.allow_undefined)
+    return q, G, T
+
+
+def _arg_shardings(mesh: Mesh):
+    """PartitionSpecs matching precompute_kernel's positional args."""
+    g = P(GROUPS_AXIS)
+    t = P(CATALOG_AXIS)
+    rep = P()
+    enc_g = feas.Enc(mask=g, defined=g, complement=g, exempt=g, gt=g, lt=g)
+    enc_t = feas.Enc(mask=t, defined=t, complement=t, exempt=t, gt=t, lt=t)
+    enc_rep = feas.Enc(*([rep] * 6))
+    specs = (enc_g,        # group
+             enc_rep,      # template
+             enc_t,        # it
+             g,            # group_req
+             rep,          # daemon
+             t,            # alloc
+             P(None, CATALOG_AXIS),  # template_its [M,T]
+             t, t, t,      # off_zone/off_captype/off_available [T,O]
+             rep,          # zone_values
+             rep,          # allow_undefined
+             g,            # tol_template [G,M]
+             enc_rep,      # exist
+             rep,          # exist_avail
+             g)            # tol_exist [G,N]
+    to_ns = lambda s: NamedSharding(mesh, s)
+    return jax.tree.map(to_ns, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _out_shardings(mesh: Mesh):
+    g0 = NamedSharding(mesh, P(GROUPS_AXIS))
+    mg = NamedSharding(mesh, P(None, GROUPS_AXIS))
+    gmt = NamedSharding(mesh, P(GROUPS_AXIS, None, CATALOG_AXIS))
+    gmtz = NamedSharding(mesh, P(GROUPS_AXIS, None, CATALOG_AXIS, None))
+    # (compat_tm, it_ok_any, ppn, it_ok_z, zone_adm, exist_ok, exist_cap)
+    return (mg, gmt, gmt, gmtz, g0, g0, g0)
+
+
+_sharded_cache = {}
+
+
+def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensors:
+    """precompute() over a device mesh: pads to the mesh grid, shards inputs,
+    runs the same kernel under GSPMD, gathers + un-pads the result."""
+    g_mult, t_mult = mesh.shape[GROUPS_AXIS], mesh.shape[CATALOG_AXIS]
+    padded, G, T = pad_problem(p, g_mult, t_mult)
+    args, statics = binpack.device_args(padded)
+    key = (mesh, tuple(sorted(statics.items())))
+    fn = _sharded_cache.get(key)
+    if fn is None:
+        if len(_sharded_cache) >= 16:
+            _sharded_cache.clear()
+        fn = jax.jit(
+            lambda *a: binpack.precompute_kernel(*a, **statics),
+            in_shardings=_arg_shardings(mesh),
+            out_shardings=_out_shardings(mesh))
+        _sharded_cache[key] = fn
+    out = fn(*args)
+    compat_tm, it_ok, ppn, it_ok_z, zone_adm, exist_ok, exist_cap = (
+        np.asarray(x) for x in out)
+    return binpack.PackTensors(
+        compat_tm=compat_tm[:, :G],
+        it_ok=it_ok[:G, :, :T],
+        ppn=ppn[:G, :, :T],
+        it_ok_z=it_ok_z[:G, :, :T],
+        zone_adm=zone_adm[:G],
+        exist_ok=exist_ok[:G],
+        exist_cap=exist_cap[:G])

@@ -122,8 +122,10 @@ class TensorScheduler:
 
     # -- tensor path ----------------------------------------------------------
 
-    def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
-        self.fallback_reason = ""
+    def build_problem(self, groups: List[PodGroup]):
+        """Encode groups + catalog + state into a PackProblem; returns
+        (problem, templates, catalog). Raises _FallbackError when the batch
+        isn't expressible."""
         templates: List[NodeClaimTemplate] = []
         for np_ in self.nodepools:
             nct = NodeClaimTemplate(np_)
@@ -213,10 +215,6 @@ class TensorScheduler:
             for m, nct in enumerate(templates):
                 tol_template[gi, m] = not scheduling_taints.tolerates(nct.taints, probe)
 
-        # existing nodes: initialized-first name order (scheduler.go:344-352)
-        sn_order = sorted(range(len(self.state_nodes)),
-                          key=lambda i: (not self.state_nodes[i].initialized(),
-                                         self.state_nodes[i].name()))
         exist_enc = exist_avail = exist_zone = tol_exist = None
         if self.state_nodes:
             encs, avails, zones = [], [], []
@@ -246,6 +244,13 @@ class TensorScheduler:
             zone_key=zone_key, captype_key=captype_key, zone_values=zone_values,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined)
+        return problem, templates, catalog
+
+    def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
+        self.fallback_reason = ""
+        problem, templates, catalog = self.build_problem(groups)
+        vocab = problem.vocab
+        zone_key = problem.zone_key
 
         tensors = binpack.precompute(problem)
 
@@ -263,8 +268,8 @@ class TensorScheduler:
             limits.append({k: enc.scale_capacity(k, v) for k, v in rem.items()})
         limit_resources = sorted({k for lm in limits if lm for k in lm})
 
-        Z = len(zone_values)
-        izc = np.zeros((G, Z), dtype=np.int64)
+        Z = len(problem.zone_values)
+        izc = np.zeros((len(groups), Z), dtype=np.int64)
         if self.initial_zone_counts is not None:
             zone_names = vocab.values[zone_key]
             for gi, g in enumerate(groups):
@@ -272,6 +277,9 @@ class TensorScheduler:
                 for z, cnt in enumerate(counts):
                     izc[gi, z] = cnt
 
+        sn_order = sorted(range(len(self.state_nodes)),
+                          key=lambda i: (not self.state_nodes[i].initialized(),
+                                         self.state_nodes[i].name()))
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
                                 initial_zone_counts=izc, exist_order=sn_order)
         pr = packer.pack()

@@ -1,0 +1,75 @@
+"""Sharded-mesh precompute parity + nodepool-limit regression tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.ops import binpack
+from karpenter_tpu.parallel.mesh import make_solver_mesh, sharded_precompute
+from karpenter_tpu.provisioning.grouping import group_pods
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+from factories import make_nodepool, make_pods, spread_zone
+
+
+def _problem(n_groups=5, n_its=30):
+    its = construct_instance_types()[:n_its]
+    pool = make_nodepool(name="default")
+    pods = []
+    for d in range(n_groups):
+        labels = {"app": f"d{d}"}
+        spread = [spread_zone(key="app", value=f"d{d}")] if d % 2 else None
+        pods += make_pods(7, cpu=f"{(d + 1) * 100}m", memory=f"{(d + 1) * 64}Mi",
+                          labels=labels, spread=spread)
+    ts = TensorScheduler([pool], {"default": its})
+    groups, reason = group_pods(pods)
+    assert groups is not None, reason
+    problem, _, _ = ts.build_problem(groups)
+    return problem
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+def test_sharded_precompute_matches_single_chip(n_devices):
+    if len(jax.devices()) < n_devices:
+        pytest.skip("not enough devices")
+    problem = _problem()
+    mesh = make_solver_mesh(n_devices)
+    sharded = sharded_precompute(problem, mesh)
+    ref = binpack.precompute(problem)
+    np.testing.assert_array_equal(sharded.compat_tm, ref.compat_tm)
+    np.testing.assert_array_equal(sharded.it_ok, ref.it_ok)
+    np.testing.assert_array_equal(sharded.ppn, ref.ppn)
+    np.testing.assert_array_equal(sharded.it_ok_z, ref.it_ok_z)
+    np.testing.assert_array_equal(sharded.zone_adm, ref.zone_adm)
+
+
+def test_sharded_precompute_nondivisible_padding():
+    """G=5 groups, T=30 ITs on an 8-device mesh: both axes need padding."""
+    if len(jax.devices()) < 8:
+        pytest.skip("not enough devices")
+    problem = _problem(n_groups=5, n_its=30)
+    mesh = make_solver_mesh(8)
+    assert mesh.shape["groups"] * mesh.shape["catalog"] == 8
+    sharded = sharded_precompute(problem, mesh)
+    ref = binpack.precompute(problem)
+    np.testing.assert_array_equal(sharded.it_ok, ref.it_ok)
+
+
+def test_disjoint_limit_resources_across_pools():
+    """Regression: pool A limits only cpu, pool B limits only memory. A's
+    absent memory limit must NOT be treated as 0 (nodepool.go Limits
+    semantics: only named resources are limited)."""
+    its = construct_instance_types()[:24]
+    pool_a = make_nodepool(name="pool-a", limits={"cpu": "100"})
+    pool_b = make_nodepool(name="pool-b", limits={"memory": "1000Gi"})
+    pods = make_pods(10, cpu="500m", memory="256Mi")
+    ts = TensorScheduler([pool_a, pool_b],
+                         {"pool-a": its, "pool-b": its})
+    results = ts.solve(pods)
+    assert ts.fallback_reason == ""
+    assert not results.pod_errors, results.pod_errors
+    # pool-a is first in weight order and has plenty of cpu limit left
+    pools = {nc.template.nodepool_name for nc in results.new_nodeclaims}
+    assert "pool-a" in pools
