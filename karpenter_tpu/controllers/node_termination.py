@@ -1,0 +1,96 @@
+"""Node termination: finalizer-driven graceful drain.
+
+Mirrors /root/reference/pkg/controllers/node/termination/: on
+deletionTimestamp, delete owning NodeClaims (controller.go:178-188), taint
+disrupted:NoSchedule (terminator.go:55-92), drain pods in priority groups —
+noncritical non-daemonset first (terminator.go:119-138) — then remove the
+finalizer (controller.go:242-270).
+
+Standalone-runtime deviation: the reference evicts via the Eviction API and
+relies on workload controllers (Deployments) to recreate pods, with the
+kube-scheduler re-binding them. Here eviction of a reschedulable pod *unbinds*
+it (clears spec.node_name), returning it to the provisionable pool the
+Provisioner watches; non-reschedulable pods are deleted. Net behavior matches:
+disrupted pods land on replacement capacity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim
+from ..api.objects import Node, Pod
+from ..kube.store import Store
+from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from ..state.cluster import Cluster
+from ..utils import pod as pod_utils
+from ..utils.clock import Clock
+from .manager import Controller, Result
+
+CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical floor
+
+
+class NodeTermination(Controller):
+    name = "node.termination"
+    kinds = (Node,)
+
+    def __init__(self, store: Store, cluster: Cluster,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock or store.clock
+
+    def reconcile(self, node: Node) -> Optional[Result]:
+        if node.metadata.deletion_timestamp is None:
+            return None
+        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return None
+        # delete owning NodeClaims so instance teardown starts in parallel
+        for nc in self.store.list(NodeClaim):
+            if nc.status.node_name == node.name and \
+                    nc.metadata.deletion_timestamp is None:
+                self.store.delete(nc)
+        self._taint(node)
+        remaining = self._drain(node)
+        if remaining:
+            return Result(requeue_after=1.0)
+        self.store.remove_finalizer(node, api_labels.TERMINATION_FINALIZER)
+        return None
+
+    def _taint(self, node: Node) -> None:
+        if not any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
+            node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+            self.store.update(node)
+
+    def _pods_on(self, node: Node) -> List[Pod]:
+        return self.store.list(Pod, predicate=lambda p: p.spec.node_name == node.name)
+
+    def _drain(self, node: Node) -> int:
+        """Evict in priority groups; returns evictable pods still bound."""
+        pods = [p for p in self._pods_on(node) if pod_utils.is_evictable(p)]
+        groups = ([p for p in pods if not self._critical(p) and not p.is_daemonset_pod],
+                  [p for p in pods if not self._critical(p) and p.is_daemonset_pod],
+                  [p for p in pods if self._critical(p) and not p.is_daemonset_pod],
+                  [p for p in pods if self._critical(p) and p.is_daemonset_pod])
+        for group in groups:
+            if not group:
+                continue
+            for p in group:
+                self._evict(p)
+            # one priority group per pass (terminator.go:119-138)
+            break
+        return len([p for p in self._pods_on(node) if pod_utils.is_evictable(p)])
+
+    def _critical(self, pod: Pod) -> bool:
+        return (pod.spec.priority or 0) >= CRITICAL_PRIORITY or \
+            pod.spec.priority_class_name in ("system-cluster-critical",
+                                             "system-node-critical")
+
+    def _evict(self, pod: Pod) -> None:
+        if pod_utils.is_reschedulable(pod):
+            pod.spec.node_name = ""
+            pod.status.nominated_node_name = ""
+            self.store.update(pod)
+        else:
+            self.store.delete(pod)

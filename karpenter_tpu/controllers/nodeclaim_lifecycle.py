@@ -1,0 +1,167 @@
+"""NodeClaim lifecycle state machine: launch -> register -> initialize, with
+liveness TTL and the termination finalizer flow.
+
+Mirrors /root/reference/pkg/controllers/nodeclaim/lifecycle/:
+- Launch (launch.go:45-121): cloudProvider.Create, Launched condition, status
+  capacity/allocatable; insufficient-capacity errors delete the claim.
+- Registration (registration.go:43-114): match the Node by providerID, sync
+  labels/taints, drop the unregistered:NoExecute taint, stamp the registered
+  label, record status.node_name.
+- Initialization (initialization.go:47-136): node present with ephemeral +
+  startup taints cleared and capacity registered -> initialized label +
+  condition.
+- Liveness (liveness.go:41-66): claims not registered within the TTL are
+  deleted.
+- Termination (controller.go:171-285): on deletionTimestamp, delete the cloud
+  instance, delete the Node, then drop the finalizer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import (COND_INITIALIZED, COND_LAUNCHED, COND_REGISTERED,
+                             NodeClaim)
+from ..api.objects import Node
+from ..cloudprovider.types import (CloudProviderError, InsufficientCapacityError,
+                                   NodeClaimNotFoundError)
+from ..kube.store import NotFoundError, Store
+from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+from ..state.cluster import Cluster
+from ..utils.clock import Clock
+from .manager import Controller, Result
+
+REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go registrationTTL
+LAUNCH_RETRY_SECONDS = 15.0
+
+
+class NodeClaimLifecycle(Controller):
+    name = "nodeclaim.lifecycle"
+    kinds = (NodeClaim,)
+
+    def __init__(self, store: Store, cluster: Cluster, cloud_provider,
+                 clock: Optional[Clock] = None,
+                 registration_ttl: float = REGISTRATION_TTL_SECONDS):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or store.clock
+        self.registration_ttl = registration_ttl
+
+    def reconcile(self, nc: NodeClaim) -> Optional[Result]:
+        if nc.metadata.deletion_timestamp is not None:
+            return self._finalize(nc)
+        if api_labels.TERMINATION_FINALIZER not in nc.metadata.finalizers:
+            nc.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+            self.store.update(nc)
+        if not nc.launched():
+            r = self._launch(nc)
+            if r is not None:
+                return r
+        if not nc.registered():
+            self._register(nc)
+        if not nc.registered():
+            return self._liveness(nc)
+        if not nc.initialized():
+            self._initialize(nc)
+            if not nc.initialized():
+                return Result(requeue_after=5.0)
+        return None
+
+    # -- launch -------------------------------------------------------------
+
+    def _launch(self, nc: NodeClaim) -> Optional[Result]:
+        try:
+            self.cloud_provider.create(nc)
+        except InsufficientCapacityError:
+            # launch.go:78-86: ICE deletes the claim so the provisioner retries
+            self.store.delete(nc)
+            return Result()
+        except CloudProviderError as e:
+            nc.conditions.set_false(COND_LAUNCHED, reason="LaunchFailed",
+                                    message=str(e), now=self.clock.now())
+            self.store.update(nc)
+            return Result(requeue_after=LAUNCH_RETRY_SECONDS)
+        nc.conditions.set_true(COND_LAUNCHED, reason="Launched",
+                               now=self.clock.now())
+        self.store.update(nc)
+        self.cluster.update_nodeclaim(nc)
+        return None
+
+    # -- registration -------------------------------------------------------
+
+    def _node_for(self, nc: NodeClaim) -> Optional[Node]:
+        pid = nc.status.provider_id
+        if not pid:
+            return None
+        for node in self.store.list(Node):
+            if node.spec.provider_id == pid:
+                return node
+        return None
+
+    def _register(self, nc: NodeClaim) -> None:
+        node = self._node_for(nc)
+        if node is None:
+            return
+        # sync: claim labels/annotations win (registration.go:74-101)
+        node.metadata.labels.update(nc.metadata.labels)
+        node.metadata.labels[api_labels.NODE_REGISTERED_LABEL_KEY] = "true"
+        node.metadata.annotations.update(nc.metadata.annotations)
+        node.spec.taints = [t for t in node.spec.taints
+                            if t.key != api_labels.UNREGISTERED_TAINT_KEY]
+        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+        self.store.update(node)
+        nc.status.node_name = node.name
+        nc.conditions.set_true(COND_REGISTERED, reason="Registered",
+                               now=self.clock.now())
+        self.store.update(nc)
+
+    # -- initialization -----------------------------------------------------
+
+    def _initialize(self, nc: NodeClaim) -> None:
+        node = self._node_for(nc)
+        if node is None:
+            return
+        startup = list(nc.spec.startup_taints)
+        for t in node.spec.taints:
+            if any(t.matches(e) for e in KNOWN_EPHEMERAL_TAINTS):
+                return  # still starting up
+            if any(t.matches(s) for s in startup):
+                return
+        # resources registered (initialization.go:103-121)
+        for rname, req in nc.status.allocatable.items():
+            if node.status.allocatable.get(rname, 0) <= 0 < req:
+                return
+        node.metadata.labels[api_labels.NODE_INITIALIZED_LABEL_KEY] = "true"
+        self.store.update(node)
+        nc.conditions.set_true(COND_INITIALIZED, reason="Initialized",
+                               now=self.clock.now())
+        self.store.update(nc)
+
+    # -- liveness -----------------------------------------------------------
+
+    def _liveness(self, nc: NodeClaim) -> Optional[Result]:
+        age = self.clock.now() - nc.metadata.creation_timestamp
+        if age >= self.registration_ttl:
+            self.store.delete(nc)  # liveness.go:55-62
+            return Result()
+        return Result(requeue_after=self.registration_ttl - age)
+
+    # -- termination --------------------------------------------------------
+
+    def _finalize(self, nc: NodeClaim) -> Optional[Result]:
+        node = self._node_for(nc)
+        if node is not None and node.metadata.deletion_timestamp is None:
+            self.store.delete(node)
+            return Result(requeue_after=1.0)
+        if node is not None:
+            # node termination controller is still draining
+            return Result(requeue_after=1.0)
+        try:
+            self.cloud_provider.delete(nc)
+        except NodeClaimNotFoundError:
+            pass
+        self.store.remove_finalizer(nc, api_labels.TERMINATION_FINALIZER)
+        return None

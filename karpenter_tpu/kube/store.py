@@ -1,0 +1,157 @@
+"""In-memory Kubernetes-shaped object store: the framework's state substrate.
+
+The reference delegates durable state to the Kubernetes API server and
+rebuilds everything else from watch streams (SURVEY.md §5 checkpoint note:
+"restart = resync"). This store plays that role for the standalone framework:
+typed collections with create/get/update/delete, resourceVersion stamping,
+watch fan-out, and the API server's finalizer-aware two-phase delete
+(deletionTimestamp first, object removal only after the last finalizer is
+gone) that the termination controllers depend on
+(node/termination/controller.go:87-176).
+
+Single-writer semantics: controllers run on one dispatch loop (see
+controllers/manager.py), so no locking here. Objects handed out are the live
+instances — callers follow the reference's convention of mutating then calling
+update()/status-patch helpers, which bump resourceVersion and notify watchers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
+
+from ..utils.clock import Clock
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str              # ADDED | MODIFIED | DELETED
+    kind: type             # python class of the object
+    obj: object
+
+
+class ConflictError(Exception):
+    """Object already exists on create / vanished on update."""
+
+
+class NotFoundError(Exception):
+    pass
+
+
+# Cluster-scoped kinds: namespace ignored in keys, the way the API server
+# treats Node/NodeClaim/NodePool.
+CLUSTER_SCOPED_KINDS = frozenset({"Node", "NodeClaim", "NodePool", "NodeClass"})
+
+
+def _ns(kind: type, namespace: str) -> str:
+    return "" if kind.__name__ in CLUSTER_SCOPED_KINDS else (namespace or "")
+
+
+def _key(obj) -> Tuple[str, str]:
+    return (_ns(type(obj), obj.metadata.namespace), obj.metadata.name)
+
+
+class Store:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._objs: Dict[type, Dict[Tuple[str, str], object]] = {}
+        self._watchers: List[Callable[[Event], None]] = []
+        self._rv = 0
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, cb: Callable[[Event], None]) -> None:
+        self._watchers.append(cb)
+
+    def _notify(self, etype: str, obj) -> None:
+        ev = Event(type=etype, kind=type(obj), obj=obj)
+        for cb in list(self._watchers):
+            cb(ev)
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind = type(obj)
+        coll = self._objs.setdefault(kind, {})
+        k = _key(obj)
+        if k in coll:
+            raise ConflictError(f"{kind.__name__} {k} already exists")
+        if not obj.metadata.creation_timestamp:
+            obj.metadata.creation_timestamp = self.clock.now()
+        self._bump(obj)
+        coll[k] = obj
+        self._notify(ADDED, obj)
+        return obj
+
+    def get(self, kind: type, name: str, namespace: str = "") -> Optional[object]:
+        return self._objs.get(kind, {}).get((_ns(kind, namespace), name))
+
+    def list(self, kind: type, namespace: Optional[str] = None,
+             predicate: Optional[Callable] = None) -> List[object]:
+        out = []
+        if namespace is not None:
+            namespace = _ns(kind, namespace)
+        for (ns, _), obj in self._objs.get(kind, {}).items():
+            if namespace is not None and ns != namespace:
+                continue
+            if predicate is not None and not predicate(obj):
+                continue
+            out.append(obj)
+        return out
+
+    def update(self, obj) -> object:
+        kind = type(obj)
+        coll = self._objs.setdefault(kind, {})
+        k = _key(obj)
+        if k not in coll:
+            raise NotFoundError(f"{kind.__name__} {k} not found")
+        self._bump(obj)
+        coll[k] = obj
+        self._notify(MODIFIED, obj)
+        return obj
+
+    def apply(self, obj) -> object:
+        """Create-or-update."""
+        try:
+            return self.create(obj)
+        except ConflictError:
+            return self.update(obj)
+
+    def delete(self, obj) -> None:
+        """API-server delete semantics: with finalizers present, only stamps
+        deletionTimestamp; the object disappears when the last finalizer is
+        removed (via remove_finalizer/update)."""
+        kind = type(obj)
+        coll = self._objs.get(kind, {})
+        k = _key(obj)
+        if k not in coll:
+            raise NotFoundError(f"{kind.__name__} {k} not found")
+        live = coll[k]
+        if live.metadata.finalizers:
+            if live.metadata.deletion_timestamp is None:
+                live.metadata.deletion_timestamp = self.clock.now()
+                self._bump(live)
+                self._notify(MODIFIED, live)
+            return
+        del coll[k]
+        self._notify(DELETED, live)
+
+    def remove_finalizer(self, obj, finalizer: str) -> None:
+        if finalizer in obj.metadata.finalizers:
+            obj.metadata.finalizers.remove(finalizer)
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            coll = self._objs.get(type(obj), {})
+            k = _key(obj)
+            if k in coll:
+                del coll[k]
+                self._notify(DELETED, obj)
+            return
+        self.update(obj)

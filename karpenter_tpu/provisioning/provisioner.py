@@ -1,0 +1,245 @@
+"""Provisioner: the singleton loop that turns pending pods into NodeClaims.
+
+Mirrors /root/reference/pkg/controllers/provisioning/provisioner.go:
+batching window (batcher.go:33-110), pending-pod collection (:159-176),
+deleting-node pod carryover (:316-320), scheduler construction per solve
+(:215-299), NodeClaim creation (:354-392), and pod->node nomination recording
+(scheduling/scheduler.go:117-151). The solve itself runs on the TPU tensor
+path (provisioning/tensor_scheduler.py) with the host oracle as semantic
+authority.
+
+The Binder controller closes the loop the kube-scheduler closes in the
+reference: once a nominated NodeClaim's node is initialized, bind the pods.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim
+from ..api.nodepool import NodePool, order_by_weight
+from ..api.objects import Node, Pod
+from ..controllers.manager import Controller, Result, SingletonController
+from ..kube.store import Store
+from ..state.cluster import Cluster
+from ..utils import pod as pod_utils
+from ..utils.clock import Clock
+from .domains import build_topology_domains
+from .tensor_scheduler import TensorScheduler
+from .topology import ClusterView
+
+BATCH_IDLE_SECONDS = 1.0   # options.go:99 batchIdleDuration
+BATCH_MAX_SECONDS = 10.0   # options.go:100 batchMaxDuration
+
+
+class Batcher:
+    """Batching window (batcher.go:33-110): the solve fires once pod arrivals
+    go idle for BATCH_IDLE_SECONDS, or BATCH_MAX_SECONDS after the first
+    arrival, whichever comes first."""
+
+    def __init__(self, clock: Clock, idle: float = BATCH_IDLE_SECONDS,
+                 max_duration: float = BATCH_MAX_SECONDS):
+        self.clock = clock
+        self.idle = idle
+        self.max_duration = max_duration
+        self._first: Optional[float] = None
+        self._last: Optional[float] = None
+
+    def trigger(self) -> None:
+        now = self.clock.now()
+        if self._first is None:
+            self._first = now
+        self._last = now
+
+    def ready(self) -> bool:
+        if self._first is None:
+            return False
+        now = self.clock.now()
+        return (now - self._last >= self.idle
+                or now - self._first >= self.max_duration)
+
+    def time_until_ready(self) -> float:
+        if self._first is None:
+            return self.idle
+        now = self.clock.now()
+        return max(0.0, min(self._last + self.idle - now,
+                            self._first + self.max_duration - now))
+
+    def reset(self) -> None:
+        self._first = self._last = None
+
+
+class StateClusterView(ClusterView):
+    """Topology's view of scheduled pods / node labels, backed by the store +
+    cluster state (topology.go countDomains inputs)."""
+
+    def __init__(self, store: Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+
+    def list_pods(self, namespace: str, selector) -> List[Pod]:
+        return self.store.list(
+            Pod, namespace=namespace,
+            predicate=lambda p: selector.matches(p.labels)
+            and pod_utils.is_active(p) and pod_utils.is_scheduled(p))
+
+    def node_labels(self, node_name: str) -> Optional[dict]:
+        sn = self.cluster._node_by_name(node_name)
+        return sn.labels() if sn is not None else None
+
+    def for_pods_with_anti_affinity(self):
+        for p in self.cluster.anti_affinity_pods():
+            if pod_utils.is_scheduled(p):
+                labels = self.node_labels(p.spec.node_name)
+                if labels is not None:
+                    yield p, labels
+
+
+class PodTrigger(Controller):
+    """Pod watch -> batcher trigger (provisioning/controller.go:38-76)."""
+
+    name = "provisioning.pod-trigger"
+    kinds = (Pod,)
+
+    def __init__(self, provisioner: "Provisioner"):
+        self.provisioner = provisioner
+
+    def reconcile(self, pod) -> None:
+        if pod_utils.is_provisionable(pod):
+            self.provisioner.trigger()
+
+
+class Provisioner(SingletonController):
+    name = "provisioner"
+
+    def __init__(self, store: Store, cluster: Cluster, cloud_provider,
+                 clock: Optional[Clock] = None, batcher: Optional[Batcher] = None):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or store.clock
+        self.batcher = batcher or Batcher(self.clock)
+        # pod key -> nodeclaim name, consumed by the Binder
+        self.nominations: Dict[str, str] = {}
+        self.last_results = None
+
+    # -- trigger path (provisioning/controller.go:38-119) -------------------
+
+    def trigger(self) -> None:
+        self.batcher.trigger()
+
+    def get_pending_pods(self) -> List[Pod]:
+        return [p for p in self.store.list(Pod)
+                if pod_utils.is_provisionable(p)
+                and f"{p.namespace}/{p.name}" not in self.nominations]
+
+    # -- main loop ----------------------------------------------------------
+
+    def reconcile(self) -> Optional[Result]:
+        pods = self.get_pending_pods()
+        if not pods:
+            self.batcher.reset()
+            return None
+        if self.batcher._first is None:
+            # pods may predate trigger wiring; start the window now
+            self.batcher.trigger()
+        if not self.batcher.ready():
+            return Result(requeue_after=self.batcher.time_until_ready())
+        self.batcher.reset()
+        self.cluster.ack_pods(pods)
+
+        # pods on deleting nodes must be rescheduled too (provisioner.go:316-320)
+        deleting_pods: List[Pod] = []
+        for sn in self.cluster.deleting_nodes():
+            for uid in sn.pod_requests:
+                p = self._pod_by_uid(uid)
+                if p is not None and pod_utils.is_reschedulable(p):
+                    deleting_pods.append(p)
+        results = self.schedule(pods + deleting_pods)
+        self.last_results = results
+        self._create_nodeclaims(results)
+        self._record(results)
+        return None
+
+    def _pod_by_uid(self, uid: str) -> Optional[Pod]:
+        for p in self.store.list(Pod):
+            if p.uid == uid:
+                return p
+        return None
+
+    def schedule(self, pods: List[Pod]):
+        nodepools = order_by_weight(self.store.list(NodePool))
+        instance_types = {np.name: self.cloud_provider.get_instance_types(np)
+                          for np in nodepools}
+        nodepools = [np for np in nodepools if instance_types.get(np.name)]
+        # exclude deleting nodes from pack targets (NewScheduler filters them)
+        state_nodes = [sn for sn in self.cluster.state_nodes()
+                       if not sn.deleting()]
+        ts = TensorScheduler(
+            nodepools, instance_types, state_nodes=state_nodes,
+            daemonset_pods=self.cluster.daemonset_pod_list(),
+            cluster=StateClusterView(self.store, self.cluster))
+        return ts.solve(pods)
+
+    def _create_nodeclaims(self, results) -> None:
+        for nc in results.new_nodeclaims:
+            api_nc = nc.to_nodeclaim()
+            api_nc.metadata.namespace = ""
+            self.store.create(api_nc)
+            self.cluster.update_nodeclaim(api_nc)
+            for p in nc.pods:
+                self.nominations[f"{p.namespace}/{p.name}"] = api_nc.name
+
+    def _record(self, results) -> None:
+        nominations: Dict[str, str] = {}
+        for existing in results.existing_nodes:
+            for p in existing.pods:
+                self.cluster.nominate_node_for_pod(existing.name, p)
+                nominations[f"{p.namespace}/{p.name}"] = existing.name
+        self.cluster.mark_pod_scheduling_decisions(results.pod_errors, nominations)
+        # bind pods packed onto live existing nodes immediately
+        for existing in results.existing_nodes:
+            for p in existing.pods:
+                live = self.store.get(Pod, p.name, p.namespace)
+                if live is not None and not live.spec.node_name:
+                    live.spec.node_name = existing.name
+                    self.store.update(live)
+
+
+class Binder(SingletonController):
+    """Binds pods to the nodes their NodeClaims became (the kube-scheduler's
+    job in the reference; here nominations carry pod->nodeclaim intent)."""
+
+    name = "binder"
+
+    def __init__(self, store: Store, cluster: Cluster, provisioner: Provisioner):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+
+    def reconcile(self) -> Optional[Result]:
+        done: List[str] = []
+        for pod_key, nc_name in self.provisioner.nominations.items():
+            nc = self.store.get(NodeClaim, nc_name)
+            if nc is None:
+                done.append(pod_key)
+                continue
+            if not nc.status.node_name:
+                continue
+            node = self.store.get(Node, nc.status.node_name)
+            if node is None:
+                continue
+            ns, name = pod_key.split("/", 1)
+            pod = self.store.get(Pod, name, ns)
+            if pod is None or pod.spec.node_name:
+                done.append(pod_key)
+                continue
+            pod.spec.node_name = node.name
+            self.store.update(pod)
+            nc.status.last_pod_event_time = self.store.clock.now()
+            done.append(pod_key)
+        for k in done:
+            self.provisioner.nominations.pop(k, None)
+        return None

@@ -1,0 +1,33 @@
+"""Injectable clock, mirroring the reference's clock.Clock injection
+(/root/reference uses k8s.io/utils/clock everywhere; fake clocks drive
+time-dependent behavior in tests — SURVEY.md §4 determinism note)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+    def since(self, t: float) -> float:
+        return self.now() - t
+
+
+class FakeClock(Clock):
+    """Deterministic clock for tests: starts at a fixed epoch, moves only via
+    step()/set_time()."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def step(self, seconds: float) -> float:
+        self._now += seconds
+        return self._now
+
+    def set_time(self, t: float) -> None:
+        self._now = t

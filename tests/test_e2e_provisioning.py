@@ -1,0 +1,120 @@
+"""End-to-end provisioning loop against the kwok simulated provider:
+pending pods -> batcher -> tensor solve -> NodeClaims -> launch -> register ->
+initialize -> bind; then node deletion -> drain -> reschedule."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.node_termination import NodeTermination
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    mgr.register(provisioner,
+                 PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock),
+                 NodeTermination(store, cluster, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr, e.provisioner = \
+        clock, store, cluster, provider, mgr, provisioner
+    return e
+
+
+def settle(env, rounds=6):
+    """Run the control loop through the batch window until quiet."""
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)  # pass the batch idle window
+    env.mgr.run_until_quiet()
+
+
+class TestProvisioningE2E:
+    def test_pods_get_nodes_and_bind(self, env):
+        env.store.create(make_nodepool(name="default"))
+        for p in make_pods(10, cpu="500m", memory="256Mi"):
+            env.store.create(p)
+        settle(env)
+        pods = env.store.list(Pod)
+        assert all(p.spec.node_name for p in pods), \
+            [(p.name, p.spec.node_name) for p in pods]
+        nodes = env.store.list(Node)
+        assert nodes, "no nodes fabricated"
+        for n in nodes:
+            assert n.metadata.labels.get(api_labels.NODE_REGISTERED_LABEL_KEY) == "true"
+            assert n.metadata.labels.get(api_labels.NODE_INITIALIZED_LABEL_KEY) == "true"
+            assert not any(t.key == api_labels.UNREGISTERED_TAINT_KEY
+                           for t in n.spec.taints)
+        claims = env.store.list(NodeClaim)
+        assert all(c.launched() and c.registered() and c.initialized()
+                   for c in claims)
+        assert env.cluster.synced()
+
+    def test_batch_window_delays_solve(self, env):
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="500m"))
+        env.mgr.run_until_quiet()  # batch window still open: no claims yet
+        assert env.store.list(NodeClaim) == []
+        env.clock.step(1.1)
+        env.mgr.run_until_quiet()
+        assert len(env.store.list(NodeClaim)) == 1
+
+    def test_no_nodepool_means_pod_errors(self, env):
+        env.store.create(make_pod())
+        settle(env)
+        assert env.store.list(NodeClaim) == []
+        assert env.store.list(Node) == []
+
+    def test_node_delete_drains_and_reschedules(self, env):
+        env.store.create(make_nodepool(name="default"))
+        for p in make_pods(5, cpu="500m"):
+            env.store.create(p)
+        settle(env)
+        nodes = env.store.list(Node)
+        assert nodes
+        first = nodes[0]
+        bound_before = [p for p in env.store.list(Pod)
+                        if p.spec.node_name == first.name]
+        assert bound_before
+        env.store.delete(first)
+        settle(env)
+        # node + its claim are gone; every pod is bound somewhere live
+        assert env.store.get(Node, first.name) is None
+        live_nodes = {n.name for n in env.store.list(Node)}
+        for p in env.store.list(Pod):
+            assert p.spec.node_name in live_nodes
+
+    def test_existing_capacity_reused(self, env):
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="100m", memory="64Mi"))
+        settle(env)
+        n_nodes = len(env.store.list(Node))
+        assert n_nodes == 1
+        # a second small pod fits the already-provisioned node
+        env.store.create(make_pod(cpu="100m", memory="64Mi"))
+        settle(env)
+        assert len(env.store.list(Node)) == n_nodes
+        assert all(p.spec.node_name for p in env.store.list(Pod))

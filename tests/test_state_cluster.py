@@ -1,0 +1,213 @@
+"""Store, Cluster, and Manager behavior (reference: state/suite_test.go shapes)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus, ObjectMeta, Pod,
+                                       PodSpec)
+from karpenter_tpu.controllers.manager import Controller, Manager, Result
+from karpenter_tpu.kube.store import (ADDED, DELETED, MODIFIED, ConflictError,
+                                      NotFoundError, Store)
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_pod
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return Store(clock)
+
+
+@pytest.fixture
+def cluster(store, clock):
+    c = Cluster(store, clock)
+    wire_informers(store, c)
+    return c
+
+
+def make_node(name, provider_id=None, cpu="16", memory="32Gi", labels=None,
+              initialized=True):
+    lbl = {api_labels.LABEL_HOSTNAME: name}
+    if initialized:
+        lbl[api_labels.NODE_INITIALIZED_LABEL_KEY] = "true"
+    lbl.update(labels or {})
+    alloc = res.parse_list({"cpu": cpu, "memory": memory, "pods": "110"})
+    return Node(metadata=ObjectMeta(name=name, namespace="", labels=lbl),
+                spec=NodeSpec(provider_id=provider_id or f"test://{name}"),
+                status=NodeStatus(capacity=dict(alloc), allocatable=alloc))
+
+
+class TestStore:
+    def test_create_get_update_delete(self, store):
+        n = make_node("n1")
+        store.create(n)
+        assert store.get(Node, "n1") is n
+        rv1 = n.metadata.resource_version
+        store.update(n)
+        assert n.metadata.resource_version > rv1
+        store.delete(n)
+        assert store.get(Node, "n1") is None
+
+    def test_create_conflict(self, store):
+        store.create(make_node("n1"))
+        with pytest.raises(ConflictError):
+            store.create(make_node("n1"))
+
+    def test_update_missing(self, store):
+        with pytest.raises(NotFoundError):
+            store.update(make_node("ghost"))
+
+    def test_finalizer_two_phase_delete(self, store, clock):
+        n = make_node("n1")
+        n.metadata.finalizers.append("karpenter.sh/termination")
+        store.create(n)
+        store.delete(n)
+        # still present, deletion stamped
+        assert store.get(Node, "n1") is n
+        assert n.metadata.deletion_timestamp == clock.now()
+        store.delete(n)  # idempotent
+        store.remove_finalizer(n, "karpenter.sh/termination")
+        assert store.get(Node, "n1") is None
+
+    def test_watch_events(self, store):
+        seen = []
+        store.watch(lambda ev: seen.append((ev.type, ev.obj.metadata.name)))
+        n = make_node("n1")
+        store.create(n)
+        store.update(n)
+        store.delete(n)
+        assert seen == [("ADDED", "n1"), ("MODIFIED", "n1"), ("DELETED", "n1")]
+
+
+class TestCluster:
+    def test_node_tracking_via_informers(self, store, cluster):
+        store.create(make_node("n1"))
+        assert len(cluster.nodes) == 1
+        assert cluster.synced()
+        sn = cluster.state_nodes()[0]
+        assert sn.name() == "n1"
+        assert sn.initialized()
+
+    def test_pod_binding_updates_available(self, store, cluster):
+        store.create(make_node("n1", cpu="4"))
+        pod = make_pod(cpu="1000m")
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        sn = cluster.state_nodes()[0]
+        assert sn.available()["cpu"] == 3000
+        store.delete(pod)
+        sn = cluster.state_nodes()[0]
+        assert sn.available()["cpu"] == 4000
+
+    def test_nodeclaim_then_node_unify_by_provider_id(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.status.provider_id = "test://n1"
+        store.create(nc)
+        assert len(cluster.nodes) == 1
+        store.create(make_node("n1", provider_id="test://n1"))
+        assert len(cluster.nodes) == 1
+        sn = cluster.nodes["test://n1"]
+        assert sn.node is not None and sn.nodeclaim is not None
+
+    def test_nodeclaim_placeholder_migrates(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        store.create(nc)  # no providerID yet
+        assert "nodeclaim://nc1" in cluster.nodes
+        nc.status.provider_id = "test://real"
+        store.update(nc)
+        assert "nodeclaim://nc1" not in cluster.nodes
+        assert "test://real" in cluster.nodes
+        assert cluster.synced()
+
+    def test_mark_for_deletion_and_consolidation_state(self, store, cluster, clock):
+        store.create(make_node("n1"))
+        t = cluster.mark_consolidated()
+        assert cluster.consolidation_state() == t
+        cluster.mark_for_deletion("test://n1")
+        assert cluster.consolidation_state() == 0.0
+        assert cluster.nodes["test://n1"].deleting()
+        cluster.unmark_for_deletion("test://n1")
+        assert not cluster.nodes["test://n1"].deleting()
+
+    def test_consolidation_state_forced_revalidation(self, cluster, clock):
+        cluster.mark_consolidated()
+        clock.step(301)
+        assert cluster.consolidation_state() == 0.0
+
+    def test_nomination_window(self, store, cluster, clock):
+        store.create(make_node("n1"))
+        pod = make_pod()
+        store.create(pod)
+        cluster.nominate_node_for_pod("n1", pod)
+        sn = cluster.nodes["test://n1"]
+        assert sn.nominated(clock.now())
+        clock.step(21)
+        assert not sn.nominated(clock.now())
+
+    def test_deep_copy_isolation(self, store, cluster):
+        store.create(make_node("n1", cpu="4"))
+        snapshot = cluster.state_nodes()
+        pod = make_pod(cpu="1000m")
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        # snapshot taken before the pod landed is unaffected
+        assert snapshot[0].available()["cpu"] == 4000
+
+    def test_daemonset_cache(self, store, cluster):
+        pod = make_pod(cpu="100m")
+        pod.is_daemonset_pod = True
+        pod.spec.node_name = ""
+        store.create(pod)
+        assert len(cluster.daemonset_pod_list()) == 1
+
+
+class TestManager:
+    def test_watch_controller_dispatch_and_requeue(self, store, clock):
+        mgr = Manager(store, clock)
+        seen = []
+
+        class C(Controller):
+            name = "test"
+            kinds = (Node,)
+
+            def reconcile(self, obj):
+                seen.append(obj.metadata.name)
+                if len(seen) == 1:
+                    return Result(requeue_after=10.0)
+                return None
+
+        mgr.register(C())
+        store.create(make_node("n1"))
+        assert mgr.drain() == 1
+        assert seen == ["n1"]
+        # requeue fires only after the clock advances
+        assert mgr.drain() == 0
+        mgr.advance(10.0)
+        assert seen == ["n1", "n1"]
+
+    def test_queue_dedup(self, store, clock):
+        mgr = Manager(store, clock)
+        count = []
+
+        class C(Controller):
+            name = "test"
+            kinds = (Node,)
+
+            def reconcile(self, obj):
+                count.append(1)
+
+        mgr.register(C())
+        n = make_node("n1")
+        store.create(n)
+        store.update(n)
+        store.update(n)
+        assert mgr.drain() == 1  # deduped to one work item
