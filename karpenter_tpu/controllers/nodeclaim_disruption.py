@@ -1,0 +1,114 @@
+"""NodeClaim disruption markers: the status conditions the disruption solver
+consumes.
+
+Mirrors /root/reference/pkg/controllers/nodeclaim/disruption/:
+- Consolidatable (consolidation.go:41-100): set once consolidateAfter has
+  elapsed since the last pod event; cleared while pods churn.
+- Drifted (drift.go:46-110): static drift via the nodepool-hash annotation
+  diff, requirements drift via nodepool requirements vs claim labels, plus
+  cloudProvider.IsDrifted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import (COND_CONSOLIDATABLE, COND_DRIFTED, COND_INITIALIZED,
+                             NodeClaim)
+from ..api.nodepool import NodePool
+from ..kube.store import Store
+from ..scheduling.requirements import label_requirements, node_selector_requirements
+from ..state.cluster import Cluster
+from ..utils.clock import Clock
+from .manager import Controller, Result
+
+
+class NodeClaimDisruptionMarker(Controller):
+    name = "nodeclaim.disruption"
+    kinds = (NodeClaim,)
+
+    def __init__(self, store: Store, cluster: Cluster, cloud_provider,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or store.clock
+
+    def reconcile(self, nc: NodeClaim) -> Optional[Result]:
+        if nc.metadata.deletion_timestamp is not None:
+            return None
+        if not nc.initialized():
+            return None
+        requeue = self._consolidatable(nc)
+        self._drifted(nc)
+        return Result(requeue_after=requeue) if requeue else None
+
+    # -- Consolidatable -----------------------------------------------------
+
+    def _consolidatable(self, nc: NodeClaim) -> Optional[float]:
+        pool = self.store.get(NodePool, nc.nodepool_name)
+        if pool is None:
+            return None
+        after = pool.spec.disruption.consolidate_after
+        if after is None:  # Never
+            if nc.conditions.is_true(COND_CONSOLIDATABLE):
+                nc.conditions.clear(COND_CONSOLIDATABLE)
+                self.store.update(nc)
+            return None
+        last_event = nc.status.last_pod_event_time or \
+            nc.metadata.creation_timestamp
+        elapsed = self.clock.now() - last_event
+        if elapsed >= after:
+            if not nc.conditions.is_true(COND_CONSOLIDATABLE):
+                nc.conditions.set_true(COND_CONSOLIDATABLE,
+                                       reason="PodsHaveSettled",
+                                       now=self.clock.now())
+                self.store.update(nc)
+                self.cluster.mark_unconsolidated()
+            return None
+        if nc.conditions.is_true(COND_CONSOLIDATABLE):
+            nc.conditions.clear(COND_CONSOLIDATABLE)
+            self.store.update(nc)
+        return after - elapsed
+
+    # -- Drifted ------------------------------------------------------------
+
+    def _drifted(self, nc: NodeClaim) -> None:
+        pool = self.store.get(NodePool, nc.nodepool_name)
+        if pool is None:
+            return
+        reason = self._static_drift(nc, pool) or \
+            self._requirements_drift(nc, pool) or \
+            self.cloud_provider.is_drifted(nc)
+        if reason:
+            if not nc.conditions.is_true(COND_DRIFTED):
+                nc.conditions.set_true(COND_DRIFTED, reason=reason,
+                                       now=self.clock.now())
+                self.store.update(nc)
+                self.cluster.mark_unconsolidated()
+        elif nc.conditions.is_true(COND_DRIFTED):
+            nc.conditions.clear(COND_DRIFTED)
+            self.store.update(nc)
+
+    def _static_drift(self, nc: NodeClaim, pool: NodePool) -> str:
+        """drift.go NodePoolHash: annotation hash mismatch at same hash
+        version."""
+        nc_hash = nc.metadata.annotations.get(
+            api_labels.NODEPOOL_HASH_ANNOTATION_KEY)
+        nc_ver = nc.metadata.annotations.get(
+            api_labels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
+        from ..api.nodepool import NODEPOOL_HASH_VERSION
+        if nc_hash is None or nc_ver != NODEPOOL_HASH_VERSION:
+            return ""
+        return "NodePoolDrifted" if nc_hash != pool.static_hash() else ""
+
+    def _requirements_drift(self, nc: NodeClaim, pool: NodePool) -> str:
+        """drift.go RequirementsDrifted: pool requirements no longer admit the
+        claim's labels."""
+        pool_reqs = node_selector_requirements(
+            pool.spec.template.spec.requirements)
+        claim_reqs = label_requirements(nc.metadata.labels)
+        if pool_reqs.intersects(claim_reqs):
+            return "RequirementsDrifted"
+        return ""

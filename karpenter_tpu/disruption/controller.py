@@ -1,0 +1,176 @@
+"""Disruption controller + orchestration queue.
+
+Mirrors /root/reference/pkg/controllers/disruption/controller.go and
+orchestration/queue.go: a 10s singleton loop trying methods in order
+Drift -> Emptiness -> MultiNodeConsolidation -> SingleNodeConsolidation,
+first success wins (:84-94,137-149); execution taints candidates, launches
+replacements, marks for deletion, and hands the command to the async queue,
+which waits for replacements to initialize before deleting the candidates,
+rolling back (untaint + unmark) on timeout (queue.go:163-281).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim
+from ..api.objects import Node
+from ..controllers.manager import Result, SingletonController
+from ..kube.store import Store
+from ..provisioning.provisioner import Provisioner
+from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from ..state.cluster import Cluster
+from ..utils.clock import Clock
+from .helpers import build_disruption_budget_mapping, get_candidates
+from .methods import (Drift, Emptiness, Method, MultiNodeConsolidation,
+                      SingleNodeConsolidation)
+from .types import Command
+
+POLL_INTERVAL_SECONDS = 10.0         # controller.go:68
+COMMAND_TIMEOUT_SECONDS = 10 * 60.0  # queue.go commandTimeout
+
+
+@dataclass
+class QueuedCommand:
+    command: Command
+    replacement_names: List[str]
+    enqueued_at: float
+    provider_ids: List[str] = field(default_factory=list)
+
+
+class OrchestrationQueue(SingletonController):
+    """orchestration/queue.go:108-281 (deterministic-runtime version)."""
+
+    name = "disruption.queue"
+
+    def __init__(self, store: Store, cluster: Cluster,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock or store.clock
+        self.items: List[QueuedCommand] = []
+
+    def has_any(self, provider_id: str) -> bool:
+        return any(provider_id in qc.provider_ids for qc in self.items)
+
+    def add(self, qc: QueuedCommand) -> None:
+        qc.provider_ids = [c.provider_id for c in qc.command.candidates]
+        self.items.append(qc)
+
+    def reconcile(self) -> Optional[Result]:
+        remaining: List[QueuedCommand] = []
+        for qc in self.items:
+            state = self._process(qc)
+            if state == "wait":
+                remaining.append(qc)
+        self.items = remaining
+        return Result(requeue_after=1.0) if remaining else None
+
+    def _process(self, qc: QueuedCommand) -> str:
+        if self.clock.now() - qc.enqueued_at > COMMAND_TIMEOUT_SECONDS:
+            self._rollback(qc)
+            return "done"
+        for name in qc.replacement_names:
+            nc = self.store.get(NodeClaim, name)
+            if nc is None:
+                # replacement died (launch failure / liveness): roll back
+                self._rollback(qc)
+                return "done"
+            if not nc.initialized():
+                return "wait"
+        # all replacements ready: delete the candidates (queue.go:258-274)
+        for c in qc.command.candidates:
+            nc = c.state_node.nodeclaim
+            live = self.store.get(NodeClaim, nc.name) if nc is not None else None
+            if live is not None and live.metadata.deletion_timestamp is None:
+                self.store.delete(live)
+        return "done"
+
+    def _rollback(self, qc: QueuedCommand) -> None:
+        """queue.go:181-223: untaint + unmark so the nodes return to service."""
+        for c in qc.command.candidates:
+            node = self.store.get(Node, c.state_node.name())
+            if node is not None:
+                before = len(node.spec.taints)
+                node.spec.taints = [
+                    t for t in node.spec.taints
+                    if not t.matches(DISRUPTED_NO_SCHEDULE_TAINT)]
+                if len(node.spec.taints) != before:
+                    self.store.update(node)
+        self.cluster.unmark_for_deletion(*qc.provider_ids)
+
+
+class DisruptionController(SingletonController):
+    name = "disruption"
+
+    def __init__(self, store: Store, cluster: Cluster, provisioner: Provisioner,
+                 queue: OrchestrationQueue, clock: Optional[Clock] = None,
+                 spot_to_spot_enabled: bool = False):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.queue = queue
+        self.clock = clock or store.clock
+        self.methods: List[Method] = [
+            Drift(cluster, provisioner),
+            Emptiness(cluster, provisioner),
+            MultiNodeConsolidation(cluster, provisioner, spot_to_spot_enabled),
+            SingleNodeConsolidation(cluster, provisioner, spot_to_spot_enabled),
+        ]
+        self.last_command: Optional[Command] = None
+
+    def reconcile(self) -> Optional[Result]:
+        if not self.cluster.synced():
+            return Result(requeue_after=1.0)
+        for method in self.methods:
+            if getattr(method, "is_consolidated", None) and method.is_consolidated():
+                continue
+            executed = self._disrupt(method)
+            if executed:
+                return Result(requeue_after=POLL_INTERVAL_SECONDS)
+            if isinstance(method, (MultiNodeConsolidation,
+                                   SingleNodeConsolidation)):
+                method.mark_consolidated()
+        return Result(requeue_after=POLL_INTERVAL_SECONDS)
+
+    def _disrupt(self, method: Method) -> bool:
+        """controller.go:155-190."""
+        disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
+        candidates = get_candidates(
+            self.cluster, self.provisioner, method.should_disrupt,
+            disrupting_provider_ids=disrupting,
+            disruption_class=method.disruption_class)
+        if not candidates:
+            return False
+        budgets = build_disruption_budget_mapping(self.cluster, method.reason)
+        cmd, results = method.compute_command(budgets, candidates)
+        if cmd.is_empty():
+            return False
+        self._execute(cmd)
+        return True
+
+    def _execute(self, cmd: Command) -> None:
+        """controller.go:196-246: taint -> launch replacements -> mark ->
+        enqueue."""
+        self.last_command = cmd
+        for c in cmd.candidates:
+            node = self.store.get(Node, c.state_node.name())
+            if node is not None and not any(
+                    t.matches(DISRUPTED_NO_SCHEDULE_TAINT)
+                    for t in node.spec.taints):
+                node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+                self.store.update(node)
+        replacement_names: List[str] = []
+        for nc in cmd.replacements:
+            nc.finalize()
+            api_nc = nc.to_nodeclaim()
+            api_nc.metadata.namespace = ""
+            self.store.create(api_nc)
+            self.cluster.update_nodeclaim(api_nc)
+            replacement_names.append(api_nc.name)
+        self.cluster.mark_for_deletion(*(c.provider_id for c in cmd.candidates))
+        self.queue.add(QueuedCommand(
+            command=cmd, replacement_names=replacement_names,
+            enqueued_at=self.clock.now()))

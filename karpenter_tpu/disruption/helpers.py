@@ -1,0 +1,106 @@
+"""Candidate collection, budgets, and the simulation bridge.
+
+Mirrors /root/reference/pkg/controllers/disruption/helpers.go:
+- SimulateScheduling (:49-113): re-run the provisioning solver with the
+  candidates' nodes removed and their reschedulable pods in the pending set;
+- GetCandidates (:144-161): every disruptable StateNode as a Candidate;
+- BuildDisruptionBudgetMapping (:197-245): per-nodepool allowed disruptions
+  minus nodes already disrupting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api.nodepool import NodePool
+from ..api.objects import Pod
+from ..api.policy import PodDisruptionBudget
+from ..provisioning.provisioner import Provisioner
+from ..state.cluster import Cluster
+from ..utils import pod as pod_utils
+from ..utils.pdb import Limits
+from .types import Candidate, CandidateError, new_candidate
+
+
+def pods_on_node(cluster: Cluster, sn) -> List[Pod]:
+    from ..api.objects import Pod as PodKind
+    return cluster.store.list(
+        PodKind, predicate=lambda p: p.spec.node_name == sn.name()
+        and pod_utils.is_active(p))
+
+
+def build_pdb_limits(cluster: Cluster) -> Limits:
+    store = cluster.store
+    return Limits(store.list(PodDisruptionBudget), store.list(Pod))
+
+
+def get_candidates(cluster: Cluster, provisioner: Provisioner,
+                   should_disrupt, disrupting_provider_ids=(),
+                   disruption_class: str = "graceful") -> List[Candidate]:
+    """helpers.go:144-161: candidates from disruptable cluster nodes that the
+    method's ShouldDisrupt predicate accepts."""
+    now = cluster.clock.now()
+    nodepools = {np.name: np for np in cluster.store.list(NodePool)}
+    instance_types = {
+        name: {it.name: it
+               for it in provisioner.cloud_provider.get_instance_types(np)}
+        for name, np in nodepools.items()}
+    pdb_limits = build_pdb_limits(cluster)
+    out: List[Candidate] = []
+    for sn in cluster.state_nodes():
+        try:
+            cand = new_candidate(now, sn, pods_on_node(cluster, sn),
+                                 pdb_limits, nodepools, instance_types,
+                                 disrupting_provider_ids, disruption_class)
+        except CandidateError:
+            continue
+        if should_disrupt(cand):
+            out.append(cand)
+    return out
+
+
+def build_disruption_budget_mapping(cluster: Cluster, reason: str) -> Dict[str, int]:
+    """helpers.go:197-245: allowed = budget - already-disrupting, per pool."""
+    now = cluster.clock.now()
+    allowed: Dict[str, int] = {}
+    nodes_per_pool: Dict[str, int] = {}
+    disrupting_per_pool: Dict[str, int] = {}
+    for sn in cluster.state_nodes(deep_copy=False):
+        pool = sn.nodepool_name()
+        if not pool:
+            continue
+        nodes_per_pool[pool] = nodes_per_pool.get(pool, 0) + 1
+        if sn.deleting():
+            disrupting_per_pool[pool] = disrupting_per_pool.get(pool, 0) + 1
+    for np in cluster.store.list(NodePool):
+        total = np.allowed_disruptions(now, nodes_per_pool.get(np.name, 0), reason)
+        allowed[np.name] = max(0, total - disrupting_per_pool.get(np.name, 0))
+    return allowed
+
+
+def simulate_scheduling(cluster: Cluster, provisioner: Provisioner,
+                        candidates: List[Candidate]):
+    """helpers.go:49-113: the bridge into the provisioning solver. Removes the
+    candidates from the packable node set, marks their reschedulable pods
+    pending, and solves. deleted-candidate races surface as CandidateError."""
+    candidate_ids = {c.provider_id for c in candidates}
+    for c in candidates:
+        sn = cluster.nodes.get(c.provider_id)
+        if sn is None or sn.deleting():
+            raise CandidateError("candidate is deleting")
+    state_nodes = [sn for sn in cluster.state_nodes()
+                   if not sn.deleting() and sn.provider_id not in candidate_ids]
+    pods = provisioner.get_pending_pods()
+    # pods already being rescheduled from deleting nodes ride along
+    for sn in cluster.deleting_nodes():
+        for p in pods_on_node(cluster, sn):
+            if pod_utils.is_reschedulable(p):
+                pods.append(p)
+    reschedulable = [p for c in candidates for p in c.reschedulable_pods]
+    results = provisioner.schedule_with(pods + reschedulable, state_nodes)
+    # pods that only became pending for the simulation must all land
+    # (AllNonPendingPodsScheduled)
+    sim_uids = {p.uid for p in reschedulable}
+    non_pending_errors = {uid: e for uid, e in results.pod_errors.items()
+                          if uid in sim_uids}
+    return results, non_pending_errors

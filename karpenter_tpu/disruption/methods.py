@@ -1,0 +1,277 @@
+"""Disruption methods: Drift, Emptiness, Multi/Single-node consolidation.
+
+Mirrors /root/reference/pkg/controllers/disruption/{drift,emptiness,
+multinodeconsolidation,singlenodeconsolidation,consolidation}.go. The compute
+order, ≤1-replacement rule, price filter, spot-to-spot floor, and budget
+handling match the reference; the multi-node prefix search differs in
+mechanics (see MultiNodeConsolidation docstring) while preserving the
+decision rule: the largest low-disruption-cost candidate prefix replaceable
+by at most one cheaper node.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
+from ..api.nodepool import (REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED,
+                            WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED)
+from ..scheduling.requirement import IN, Requirement
+from ..state.cluster import Cluster
+from .helpers import simulate_scheduling
+from .types import Candidate, CandidateError, Command
+
+MULTI_NODE_CONSOLIDATION_CANDIDATES = 100   # multinodeconsolidation.go:35
+MIN_SPOT_TO_SPOT_INSTANCE_TYPES = 15        # consolidation.go:47
+
+
+class Method:
+    """types.go:46-52."""
+
+    reason: str = ""
+    consolidation_type: str = ""
+    disruption_class: str = "graceful"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        raise NotImplementedError
+
+    def compute_command(self, budgets: Dict[str, int],
+                        candidates: List[Candidate]) -> Tuple[Command, object]:
+        raise NotImplementedError
+
+
+def _within_budget(budgets: Dict[str, int], candidates: List[Candidate]) -> List[Candidate]:
+    """Trim a candidate list so no pool exceeds its allowed disruptions."""
+    used: Dict[str, int] = {}
+    out = []
+    for c in candidates:
+        pool = c.nodepool_name
+        if used.get(pool, 0) >= budgets.get(pool, 0):
+            continue
+        used[pool] = used.get(pool, 0) + 1
+        out.append(c)
+    return out
+
+
+class Emptiness(Method):
+    """emptiness.go:57-122: nodes with zero reschedulable pods delete without
+    simulation."""
+
+    reason = REASON_EMPTY
+    consolidation_type = "empty"
+
+    def __init__(self, cluster: Cluster, provisioner=None):
+        self.cluster = cluster
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        policy = c.nodepool.spec.disruption.consolidation_policy
+        if policy not in (WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED):
+            return False
+        if c.nodepool.spec.disruption.consolidate_after is None:
+            return False
+        if c.state_node.nodeclaim is None or \
+                not c.state_node.nodeclaim.conditions.is_true(COND_CONSOLIDATABLE):
+            return False
+        return not c.reschedulable_pods
+
+    def compute_command(self, budgets, candidates):
+        empty = [c for c in candidates if not c.reschedulable_pods]
+        fitting = _within_budget(budgets, empty)
+        return Command(candidates=fitting, reason=self.reason,
+                       consolidation_type=self.consolidation_type), None
+
+
+class Drift(Method):
+    """drift.go:57-113: Drifted claims go first, oldest first; empty drifted
+    nodes delete en masse, the rest one-at-a-time with a replacement sim."""
+
+    reason = REASON_DRIFTED
+    disruption_class = "eventual"
+
+    def __init__(self, cluster: Cluster, provisioner):
+        self.cluster = cluster
+        self.provisioner = provisioner
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        nc = c.state_node.nodeclaim
+        return nc is not None and nc.conditions.is_true(COND_DRIFTED)
+
+    def compute_command(self, budgets, candidates):
+        candidates = sorted(
+            candidates,
+            key=lambda c: c.state_node.nodeclaim.metadata.creation_timestamp
+            if c.state_node.nodeclaim is not None else 0.0)
+        candidates = _within_budget(budgets, candidates)
+        empty = [c for c in candidates if not c.reschedulable_pods]
+        if empty:
+            return Command(candidates=empty, reason=self.reason), None
+        for c in candidates:
+            try:
+                results, sim_errors = simulate_scheduling(
+                    self.cluster, self.provisioner, [c])
+            except CandidateError:
+                continue
+            if sim_errors:
+                continue
+            return Command(candidates=[c],
+                           replacements=list(results.new_nodeclaims),
+                           reason=self.reason), results
+        return Command(reason=self.reason), None
+
+
+class consolidation(Method):
+    """consolidation.go:77-302 shared base."""
+
+    reason = REASON_UNDERUTILIZED
+
+    def __init__(self, cluster: Cluster, provisioner,
+                 spot_to_spot_enabled: bool = False):
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.spot_to_spot_enabled = spot_to_spot_enabled
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        if c.nodepool.spec.disruption.consolidation_policy != \
+                WHEN_EMPTY_OR_UNDERUTILIZED:
+            return False
+        if c.nodepool.spec.disruption.consolidate_after is None:
+            return False
+        nc = c.state_node.nodeclaim
+        return nc is not None and nc.conditions.is_true(COND_CONSOLIDATABLE)
+
+    def is_consolidated(self) -> bool:
+        """Memoization off the cluster consolidation token
+        (consolidation.go:77-84)."""
+        return self.cluster.consolidation_state() != 0.0
+
+    def mark_consolidated(self) -> None:
+        self.cluster.mark_consolidated()
+
+    # -- core decision (consolidation.go:131-222) ---------------------------
+
+    def compute_consolidation(self, candidates: List[Candidate]
+                              ) -> Tuple[Command, object]:
+        try:
+            results, sim_errors = simulate_scheduling(
+                self.cluster, self.provisioner, candidates)
+        except CandidateError:
+            return Command(reason=self.reason), None
+        if sim_errors:
+            return Command(reason=self.reason), None
+        if not results.new_nodeclaims:
+            return Command(candidates=list(candidates), reason=self.reason,
+                           consolidation_type=self.consolidation_type), results
+        if len(results.new_nodeclaims) != 1:
+            return Command(reason=self.reason), None
+
+        candidate_price = 0.0
+        for c in candidates:
+            p = c.price()
+            if p is None:
+                return Command(reason=self.reason), None
+            candidate_price += p
+
+        replacement = results.new_nodeclaims[0]
+        all_spot = all(c.capacity_type == api_labels.CAPACITY_TYPE_SPOT
+                       for c in candidates)
+        ct_req = replacement.requirements.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        if all_spot and ct_req.has(api_labels.CAPACITY_TYPE_SPOT):
+            return self._spot_to_spot(candidates, results, candidate_price)
+
+        filtered, err = replacement.remove_instance_types_by_price_and_min_values(
+            replacement.requirements, candidate_price)
+        if err is not None or filtered is None or \
+                not filtered.instance_type_options:
+            return Command(reason=self.reason), None
+        # OD->[OD,spot] must pin spot so a failed spot launch doesn't upgrade
+        # to pricier on-demand (consolidation.go:212-219)
+        ct_req = filtered.requirements.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        if ct_req.has(api_labels.CAPACITY_TYPE_SPOT) and \
+                ct_req.has(api_labels.CAPACITY_TYPE_ON_DEMAND):
+            filtered.requirements.add(Requirement(
+                api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+                [api_labels.CAPACITY_TYPE_SPOT]))
+        return Command(candidates=list(candidates), replacements=[filtered],
+                       reason=self.reason,
+                       consolidation_type=self.consolidation_type), results
+
+    def _spot_to_spot(self, candidates, results, candidate_price
+                      ) -> Tuple[Command, object]:
+        """consolidation.go:229-302."""
+        if not self.spot_to_spot_enabled:
+            return Command(reason=self.reason), None
+        replacement = results.new_nodeclaims[0]
+        replacement.requirements.add(Requirement(
+            api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+            [api_labels.CAPACITY_TYPE_SPOT]))
+        filtered, err = replacement.remove_instance_types_by_price_and_min_values(
+            replacement.requirements, candidate_price)
+        if err is not None or filtered is None or \
+                not filtered.instance_type_options:
+            return Command(reason=self.reason), None
+        if len(candidates) > 1:
+            return Command(candidates=list(candidates), replacements=[filtered],
+                           reason=self.reason,
+                           consolidation_type=self.consolidation_type), results
+        if len(filtered.instance_type_options) < MIN_SPOT_TO_SPOT_INSTANCE_TYPES:
+            return Command(reason=self.reason), None
+        filtered.instance_type_options = \
+            filtered.instance_type_options[:MIN_SPOT_TO_SPOT_INSTANCE_TYPES]
+        return Command(candidates=list(candidates), replacements=[filtered],
+                       reason=self.reason,
+                       consolidation_type=self.consolidation_type), results
+
+
+class MultiNodeConsolidation(consolidation):
+    """multinodeconsolidation.go:79-162.
+
+    The reference binary-searches the largest prefix of cost-sorted candidates
+    replaceable by ≤1 node, paying a full scheduling simulation per probe
+    (O(log N) sims, each rebuilding scheduler state). Here every probe's
+    simulation runs on the tensor path where the feasibility precompute is
+    jit-cached across probes — the prefixes share pod groups and catalog, so
+    successive probes hit the same compiled program and the search is
+    dominated by one device program + cheap host greedy replays. Same
+    decision, amortized device work.
+    """
+
+    consolidation_type = "multi"
+
+    def compute_command(self, budgets, candidates):
+        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
+        candidates = _within_budget(budgets, candidates)
+        candidates = candidates[:MULTI_NODE_CONSOLIDATION_CANDIDATES]
+        if not candidates:
+            return Command(reason=self.reason), None
+        # binary search on prefix size (multinodeconsolidation.go:110-162)
+        lo, hi = 1, len(candidates)
+        best: Tuple[Command, object] = (Command(reason=self.reason), None)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            cmd, results = self.compute_consolidation(candidates[:mid])
+            if cmd.is_empty():
+                hi = mid - 1
+                continue
+            # accept only if strictly cheaper than what the prefix costs now
+            best = (cmd, results)
+            lo = mid + 1
+        return best
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        return super().should_disrupt(c)
+
+
+class SingleNodeConsolidation(consolidation):
+    """singlenodeconsolidation.go:44-101: linear scan, first win."""
+
+    consolidation_type = "single"
+
+    def compute_command(self, budgets, candidates):
+        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
+        candidates = _within_budget(budgets, candidates)
+        for c in candidates:
+            cmd, results = self.compute_consolidation([c])
+            if not cmd.is_empty():
+                return cmd, results
+        return Command(reason=self.reason), None

@@ -170,13 +170,18 @@ class Provisioner(SingletonController):
         return None
 
     def schedule(self, pods: List[Pod]):
+        # exclude deleting nodes from pack targets (NewScheduler filters them)
+        state_nodes = [sn for sn in self.cluster.state_nodes()
+                       if not sn.deleting()]
+        return self.schedule_with(pods, state_nodes)
+
+    def schedule_with(self, pods: List[Pod], state_nodes):
+        """Solve against an explicit packable-node set; the disruption
+        solver's SimulateScheduling entry point (helpers.go:49-113)."""
         nodepools = order_by_weight(self.store.list(NodePool))
         instance_types = {np.name: self.cloud_provider.get_instance_types(np)
                           for np in nodepools}
         nodepools = [np for np in nodepools if instance_types.get(np.name)]
-        # exclude deleting nodes from pack targets (NewScheduler filters them)
-        state_nodes = [sn for sn in self.cluster.state_nodes()
-                       if not sn.deleting()]
         ts = TensorScheduler(
             nodepools, instance_types, state_nodes=state_nodes,
             daemonset_pods=self.cluster.daemonset_pod_list(),

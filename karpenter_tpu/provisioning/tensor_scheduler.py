@@ -50,6 +50,17 @@ class TensorNodeClaim:
     def finalize(self) -> None:
         self.requirements.delete(api_labels.LABEL_HOSTNAME)
 
+    def remove_instance_types_by_price_and_min_values(self, reqs, max_price: float):
+        """Consolidation price filter (nodeclaim.go:136-145)."""
+        from ..cloudprovider.types import satisfies_min_values
+        self.instance_type_options = [
+            it for it in self.instance_type_options
+            if it.offerings.available().worst_launch_price(reqs) < max_price]
+        _, err = satisfies_min_values(self.instance_type_options, reqs)
+        if err is not None:
+            return None, err
+        return self, None
+
     def to_nodeclaim(self) -> APINodeClaim:
         t = self.template
         reqs = Requirements(self.requirements.values())

@@ -1,0 +1,63 @@
+"""PDB limit evaluation (/root/reference/pkg/utils/pdb/pdb.go:33-112).
+
+Limits answers: can this pod be evicted right now, and which PDB blocks it?
+A pod is blocked when any matching PDB has disruptionsAllowed == 0. The
+reference reads status computed by the disruption controller; standalone we
+compute it live from current pod health.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+from ..api.objects import Pod
+from ..api.policy import PodDisruptionBudget
+from . import pod as pod_utils
+
+
+def _parse_intstr(v: str, total: int) -> int:
+    v = v.strip()
+    if v.endswith("%"):
+        return int(math.ceil(total * int(v[:-1]) / 100.0))
+    return int(v)
+
+
+class Limits:
+    def __init__(self, pdbs: List[PodDisruptionBudget], pods: List[Pod]):
+        self.pdbs = pdbs
+        self.pods = pods
+
+    def _matching_pods(self, pdb: PodDisruptionBudget) -> List[Pod]:
+        sel = pdb.spec.selector
+        return [p for p in self.pods
+                if p.namespace == pdb.namespace
+                and sel is not None and sel.matches(p.labels)]
+
+    def disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
+        matching = self._matching_pods(pdb)
+        expected = len(matching)
+        healthy = len([p for p in matching
+                       if pod_utils.is_active(p) and p.spec.node_name])
+        if pdb.spec.max_unavailable is not None:
+            max_unavail = _parse_intstr(pdb.spec.max_unavailable, expected)
+            unhealthy = expected - healthy
+            return max(0, max_unavail - unhealthy)
+        if pdb.spec.min_available is not None:
+            min_avail = _parse_intstr(pdb.spec.min_available, expected)
+            return max(0, healthy - min_avail)
+        return expected
+
+    def can_evict(self, pod: Pod) -> Tuple[bool, Optional[PodDisruptionBudget]]:
+        """pdb.go CanEvictPods: blocked when a matching PDB has no headroom.
+        Fully-blocking PDBs (maxUnavailable 0/0%) block even unhealthy pods."""
+        for pdb in self.pdbs:
+            if pdb.namespace != pod.namespace:
+                continue
+            sel = pdb.spec.selector
+            if sel is None or not sel.matches(pod.labels):
+                continue
+            if self.disruptions_allowed(pdb) <= 0:
+                return False, pdb
+            return True, None
+        return True, None
