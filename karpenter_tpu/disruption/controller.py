@@ -155,6 +155,13 @@ class DisruptionController(SingletonController):
         """controller.go:196-246: taint -> launch replacements -> mark ->
         enqueue."""
         self.last_command = cmd
+        from ..metrics import registry as metrics
+        metrics.DISRUPTION_DECISIONS.inc({
+            "decision": cmd.decision, "reason": cmd.reason,
+            "consolidation_type": cmd.consolidation_type})
+        for c in cmd.candidates:
+            metrics.NODECLAIMS_DISRUPTED.inc({
+                "nodepool": c.nodepool_name, "reason": cmd.reason})
         for c in cmd.candidates:
             node = self.store.get(Node, c.state_node.name())
             if node is not None and not any(

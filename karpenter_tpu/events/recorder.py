@@ -1,0 +1,57 @@
+"""Deduplicating event recorder.
+
+Mirrors /root/reference/pkg/events/recorder.go:47-100: identical events
+(involved object + reason + message) within the dedupe TTL are dropped; a
+per-key rate limit (10 qps in the reference) bounds bursts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.clock import Clock
+
+DEDUPE_TTL_SECONDS = 120.0   # recorder.go dedupeTimeout
+RATE_LIMIT_QPS = 10.0
+
+
+@dataclass
+class Event:
+    """events/events.go Event shape."""
+    object_kind: str
+    object_name: str
+    type: str          # Normal | Warning
+    reason: str
+    message: str
+    timestamp: float = 0.0
+
+    def dedupe_key(self) -> str:
+        return f"{self.object_kind}/{self.object_name}/{self.reason}/{self.message}"
+
+
+class Recorder:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self.events: List[Event] = []
+        self._last_seen: Dict[str, float] = {}
+        self._bucket: Dict[str, List[float]] = {}
+
+    def publish(self, *events: Event) -> None:
+        now = self.clock.now()
+        for ev in events:
+            key = ev.dedupe_key()
+            last = self._last_seen.get(key)
+            if last is not None and now - last < DEDUPE_TTL_SECONDS:
+                continue
+            window = [t for t in self._bucket.get(key, []) if now - t < 1.0]
+            if len(window) >= RATE_LIMIT_QPS:
+                continue
+            window.append(now)
+            self._bucket[key] = window
+            self._last_seen[key] = now
+            ev.timestamp = now
+            self.events.append(ev)
+
+    def for_object(self, name: str) -> List[Event]:
+        return [e for e in self.events if e.object_name == name]

@@ -1,0 +1,204 @@
+"""Prometheus-style metrics registry.
+
+Mirrors the metric families of /root/reference/pkg/metrics/metrics.go (the
+karpenter_ namespace counters for nodeclaims/nodes/pods) plus the solver
+timing metrics (provisioning/scheduling/metrics.go:39-94, disruption/
+metrics.go:44-85), with text exposition for scraping.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+
+def _label_key(labels: dict) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Metric:
+    def __init__(self, name: str, help: str, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def labels_dict(self, key: Tuple) -> dict:
+        return dict(key)
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, labels: Optional[dict] = None, value: float = 1.0) -> None:
+        k = _label_key(labels or {})
+        self._values[k] = self._values.get(k, 0.0) + value
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_label_key(labels or {}), 0.0)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Optional[dict] = None) -> None:
+        self._values[_label_key(labels or {})] = value
+
+    def delete(self, labels: Optional[dict] = None) -> None:
+        self._values.pop(_label_key(labels or {}), None)
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_label_key(labels or {}), 0.0)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name, help, label_names=(), buckets=None):
+        super().__init__(name, help, label_names)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, labels: Optional[dict] = None) -> None:
+        k = _label_key(labels or {})
+        counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                counts[i] += 1
+        counts[-1] += 1  # +Inf
+        self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def count(self, labels: Optional[dict] = None) -> int:
+        k = _label_key(labels or {})
+        return self._counts.get(k, [0])[-1]
+
+    def sum(self, labels: Optional[dict] = None) -> float:
+        return self._sums.get(_label_key(labels or {}), 0.0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "", label_names=()) -> Counter:
+        return self._register(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "", label_names=()) -> Gauge:
+        return self._register(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str = "", label_names=(),
+                  buckets=None) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help, label_names, buckets)
+                self._metrics[name] = m
+            return m
+
+    def _register(self, cls, name, help, label_names):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, label_names)
+                self._metrics[name] = m
+            return m
+
+    def measure(self, histogram_name: str, labels: Optional[dict] = None):
+        """metrics.Measure() duration helper (metrics.go:88-96)."""
+        h = self.histogram(histogram_name)
+        start = time.perf_counter()
+
+        def done():
+            h.observe(time.perf_counter() - start, labels)
+
+        return done
+
+    # -- exposition ---------------------------------------------------------
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                for k, counts in m._counts.items():
+                    lbl = dict(k)
+                    cum = 0
+                    for b, c in zip(m.buckets, counts[:-1]):
+                        cum = c
+                        lines.append(_line(f"{name}_bucket",
+                                           {**lbl, "le": _fmt(b)}, cum))
+                    lines.append(_line(f"{name}_bucket",
+                                       {**lbl, "le": "+Inf"}, counts[-1]))
+                    lines.append(_line(f"{name}_sum", lbl, m._sums.get(k, 0.0)))
+                    lines.append(_line(f"{name}_count", lbl, counts[-1]))
+            else:
+                for k, v in m._values.items():
+                    lines.append(_line(name, dict(k), v))
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return repr(v) if not math.isinf(v) else "+Inf"
+
+
+def _line(name: str, labels: dict, value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+REGISTRY = Registry()
+
+# -- metric families mirrored from the reference ---------------------------
+
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "karpenter_nodeclaims_created_total",
+    "Number of nodeclaims created", ("nodepool",))
+NODECLAIMS_TERMINATED = REGISTRY.counter(
+    "karpenter_nodeclaims_terminated_total",
+    "Number of nodeclaims terminated", ("nodepool",))
+NODECLAIMS_DISRUPTED = REGISTRY.counter(
+    "karpenter_nodeclaims_disrupted_total",
+    "Number of nodeclaims disrupted", ("nodepool", "reason"))
+NODES_CREATED = REGISTRY.counter(
+    "karpenter_nodes_created_total", "Number of nodes created", ("nodepool",))
+NODES_TERMINATED = REGISTRY.counter(
+    "karpenter_nodes_terminated_total", "Number of nodes terminated",
+    ("nodepool",))
+PODS_STARTUP_DURATION = REGISTRY.histogram(
+    "karpenter_pods_startup_duration_seconds",
+    "Time from pod creation to running")
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_provisioner_scheduling_duration_seconds",
+    "Duration of one scheduling solve")
+SCHEDULING_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_provisioner_scheduling_queue_depth",
+    "Pending pods in the scheduling queue")
+UNSCHEDULABLE_PODS = REGISTRY.gauge(
+    "karpenter_ignored_pod_count", "Pods the solver could not place")
+DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
+    "karpenter_voluntary_disruption_decision_evaluation_duration_seconds",
+    "Duration of disruption decision evaluation", ("method",))
+DISRUPTION_DECISIONS = REGISTRY.counter(
+    "karpenter_voluntary_disruption_decisions_total",
+    "Disruption decisions made", ("decision", "reason", "consolidation_type"))
+DISRUPTION_ELIGIBLE_NODES = REGISTRY.gauge(
+    "karpenter_voluntary_disruption_eligible_nodes",
+    "Nodes eligible for disruption", ("reason",))
+NODEPOOL_USAGE = REGISTRY.gauge(
+    "karpenter_nodepools_usage", "In-use resources per nodepool",
+    ("nodepool", "resource_type"))
+NODEPOOL_LIMIT = REGISTRY.gauge(
+    "karpenter_nodepools_limit", "Resource limits per nodepool",
+    ("nodepool", "resource_type"))

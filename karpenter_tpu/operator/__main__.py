@@ -1,0 +1,26 @@
+"""`python -m karpenter_tpu.operator`: run the operator against the kwok
+simulated provider (the reference's kwok/main.go:33-48)."""
+
+from __future__ import annotations
+
+import sys
+
+from .operator import Operator
+from .options import parse_options
+
+
+def main(argv=None) -> int:
+    options = parse_options(argv if argv is not None else sys.argv[1:])
+    op = Operator(options)
+    print(f"karpenter-tpu operator starting "
+          f"(provider={op.cloud_provider.name}, "
+          f"backend={options.solver_backend})", flush=True)
+    try:
+        op.run()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

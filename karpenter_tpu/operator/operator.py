@@ -1,0 +1,96 @@
+"""Operator: wires store, state, and every controller into one runtime.
+
+Mirrors /root/reference/pkg/operator/operator.go:105-206 (bootstrap) and
+pkg/controllers/controllers.go:61-111 (the full controller roster). The
+deterministic manager replaces controller-runtime; `run()` drives it in real
+time against a cloud provider (kwok by default), `step()` drives it under a
+fake clock for tests and simulations.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..cloudprovider.kwok import KwokCloudProvider
+from ..controllers.manager import Manager
+from ..controllers.node_health import NodeHealth
+from ..controllers.node_termination import NodeTermination
+from ..controllers.nodeclaim_aux import (Consistency, Expiration,
+                                         GarbageCollection, PodEvents)
+from ..controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
+from ..controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from ..controllers.nodepool_aux import (NodePoolCounter, NodePoolHash,
+                                        NodePoolReadiness, NodePoolValidation)
+from ..disruption.controller import DisruptionController, OrchestrationQueue
+from ..events.recorder import Recorder
+from ..kube.store import Store
+from ..provisioning.provisioner import Binder, PodTrigger, Provisioner
+from ..state.cluster import Cluster
+from ..state.informers import wire_informers
+from ..utils.clock import Clock
+from .options import Options
+
+
+class Operator:
+    def __init__(self, options: Optional[Options] = None, cloud_provider=None,
+                 clock: Optional[Clock] = None):
+        self.options = options or Options()
+        self.clock = clock or Clock()
+        self.store = Store(self.clock)
+        self.cluster = Cluster(self.store, self.clock)
+        wire_informers(self.store, self.cluster)
+        self.cloud_provider = cloud_provider or KwokCloudProvider(store=self.store)
+        self.recorder = Recorder(self.clock)
+        self.manager = Manager(self.store, self.clock)
+
+        gates = self.options.gates
+        self.provisioner = Provisioner(self.store, self.cluster,
+                                       self.cloud_provider, self.clock)
+        self.provisioner.batcher.idle = self.options.batch_idle_duration
+        self.provisioner.batcher.max_duration = self.options.batch_max_duration
+        self.queue = OrchestrationQueue(self.store, self.cluster, self.clock)
+        self.disruption = DisruptionController(
+            self.store, self.cluster, self.provisioner, self.queue, self.clock,
+            spot_to_spot_enabled=gates.spot_to_spot_consolidation)
+
+        controllers = [
+            self.provisioner,
+            PodTrigger(self.provisioner),
+            Binder(self.store, self.cluster, self.provisioner),
+            self.queue,
+            self.disruption,
+            NodeClaimLifecycle(self.store, self.cluster, self.cloud_provider,
+                               self.clock),
+            NodeClaimDisruptionMarker(self.store, self.cluster,
+                                      self.cloud_provider, self.clock),
+            NodeTermination(self.store, self.cluster, self.clock),
+            Expiration(self.store, self.clock),
+            GarbageCollection(self.store, self.cloud_provider, self.clock),
+            PodEvents(self.store, self.cluster, self.clock),
+            Consistency(self.store, self.recorder, self.clock),
+            NodePoolHash(self.store),
+            NodePoolCounter(self.store, self.cluster),
+            NodePoolValidation(self.store),
+            NodePoolReadiness(self.store, self.cloud_provider),
+        ]
+        if gates.node_repair:
+            controllers.append(NodeHealth(self.store, self.cluster,
+                                          self.cloud_provider, self.clock))
+        self.manager.register(*controllers)
+
+    # -- drive --------------------------------------------------------------
+
+    def step(self) -> None:
+        """One full pass: watch fallout + singleton loops (tests/sim)."""
+        self.manager.run_until_quiet()
+
+    def run(self, stop=None, tick_seconds: float = 1.0) -> None:
+        """Real-time loop (kwok/main.go:33-48 equivalent)."""
+        while stop is None or not stop():
+            self.manager.run_until_quiet()
+            time.sleep(tick_seconds)
+
+    def metrics_text(self) -> str:
+        from ..metrics.registry import REGISTRY
+        return REGISTRY.expose()

@@ -1,0 +1,91 @@
+"""Flat options struct with flag + env fallback.
+
+Mirrors /root/reference/pkg/operator/options/options.go:49-157: a single
+Options dataclass, every field settable by CLI flag or KARPENTER_-prefixed
+environment variable (flag wins), feature gates as a comma-separated string.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Dict, List, Optional
+
+
+@dataclass
+class FeatureGates:
+    """options.go:127-144."""
+    spot_to_spot_consolidation: bool = False
+    node_repair: bool = False
+
+    @classmethod
+    def parse(cls, raw: str) -> "FeatureGates":
+        fg = cls()
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, value = part.partition("=")
+            val = value.lower() in ("true", "1", "")
+            if name == "SpotToSpotConsolidation":
+                fg.spot_to_spot_consolidation = val
+            elif name == "NodeRepair":
+                fg.node_repair = val
+        return fg
+
+
+@dataclass
+class Options:
+    """The reference's flag set, minus the kube-client tuning that has no
+    analog here (options.go:49-102)."""
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    log_level: str = "info"
+    batch_max_duration: float = 10.0
+    batch_idle_duration: float = 1.0
+    feature_gates: str = ""
+    cpu_requests: str = ""  # reserved
+    cluster_name: str = "karpenter-tpu"
+    enable_profiling: bool = False
+    # TPU solver knobs (new surface: no reference analog)
+    solver_backend: str = "tensor"   # tensor | host
+    solver_devices: int = 0          # 0 = all visible
+
+    @property
+    def gates(self) -> FeatureGates:
+        return FeatureGates.parse(self.feature_gates)
+
+
+_ENV_PREFIX = "KARPENTER_"
+
+
+def _env_name(flag: str) -> str:
+    return _ENV_PREFIX + flag.upper().replace("-", "_")
+
+
+def parse_options(argv: Optional[List[str]] = None) -> Options:
+    """Flag > env > default (options.go BoolVarWithEnv pattern)."""
+    defaults = Options()
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    for f in fields(Options):
+        flag = "--" + f.name.replace("_", "-")
+        env = os.environ.get(_env_name(f.name))
+        default = getattr(defaults, f.name)
+        if env is not None:
+            if f.type in ("bool", bool):
+                default = env.lower() in ("true", "1")
+            elif f.type in ("int", int):
+                default = int(env)
+            elif f.type in ("float", float):
+                default = float(env)
+            else:
+                default = env
+        if isinstance(default, bool):
+            parser.add_argument(flag, action="store_true" if not default
+                                else "store_false", dest=f.name)
+        else:
+            parser.add_argument(flag, type=type(default), default=default,
+                                dest=f.name)
+    ns = parser.parse_args(argv or [])
+    return Options(**vars(ns))

@@ -157,7 +157,11 @@ class Provisioner(SingletonController):
                 p = self._pod_by_uid(uid)
                 if p is not None and pod_utils.is_reschedulable(p):
                     deleting_pods.append(p)
+        from ..metrics import registry as metrics
+        done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
         results = self.schedule(pods + deleting_pods)
+        done()
+        metrics.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
         self.last_results = results
         self._create_nodeclaims(results)
         self._record(results)
@@ -189,11 +193,14 @@ class Provisioner(SingletonController):
         return ts.solve(pods)
 
     def _create_nodeclaims(self, results) -> None:
+        from ..metrics import registry as metrics
         for nc in results.new_nodeclaims:
             api_nc = nc.to_nodeclaim()
             api_nc.metadata.namespace = ""
             self.store.create(api_nc)
             self.cluster.update_nodeclaim(api_nc)
+            metrics.NODECLAIMS_CREATED.inc(
+                {"nodepool": api_nc.nodepool_name})
             for p in nc.pods:
                 self.nominations[f"{p.namespace}/{p.name}"] = api_nc.name
 

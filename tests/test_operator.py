@@ -1,0 +1,173 @@
+"""Operator wiring, options, events recorder, metrics, aux controllers."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import RepairPolicy
+from karpenter_tpu.events.recorder import Event, Recorder
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import FeatureGates, Options, parse_options
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture
+def op():
+    return Operator(clock=FakeClock())
+
+
+def settle(op, rounds=6):
+    for _ in range(rounds):
+        op.step()
+        op.clock.step(1.1)
+    op.step()
+
+
+class TestOperator:
+    def test_full_wiring_provisions(self, op):
+        op.store.create(make_nodepool(name="default"))
+        for p in make_pods(4, cpu="500m"):
+            op.store.create(p)
+        settle(op)
+        assert all(p.spec.node_name for p in op.store.list(Pod))
+        assert op.store.list(Node)
+
+    def test_nodepool_hash_annotation_maintained(self, op):
+        pool = make_nodepool(name="default")
+        op.store.create(pool)
+        op.step()
+        assert pool.metadata.annotations[
+            api_labels.NODEPOOL_HASH_ANNOTATION_KEY] == pool.static_hash()
+
+    def test_nodepool_counter_aggregates(self, op):
+        pool = make_nodepool(name="default")
+        op.store.create(pool)
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        assert pool.status.resources.get("nodes") == 1000
+        assert pool.status.resources.get("cpu", 0) > 0
+
+    def test_expiration_deletes_old_claims(self, op):
+        pool = make_nodepool(name="default")
+        pool.spec.template.spec.expire_after = 3600.0
+        op.store.create(pool)
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        assert len(op.store.list(NodeClaim)) == 1
+        op.clock.step(3700)
+        settle(op)
+        # claim expired; replacement provisioned for the rescheduled pod
+        for p in op.store.list(Pod):
+            assert p.spec.node_name
+        claims = op.store.list(NodeClaim)
+        assert all(op.clock.now() -
+                   c.metadata.creation_timestamp < 3600 for c in claims)
+
+    def test_garbage_collection_removes_vanished_instances(self, op):
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        nc = op.store.list(NodeClaim)[0]
+        # instance vanishes behind karpenter's back
+        del op.cloud_provider.created[nc.status.provider_id]
+        settle(op)  # gc singleton runs as part of step()
+        assert op.store.get(NodeClaim, nc.name) is None
+
+    def test_metrics_exposed(self, op):
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        text = op.metrics_text()
+        assert "karpenter_nodeclaims_created_total" in text
+        assert "karpenter_provisioner_scheduling_duration_seconds_count" in text
+
+
+class TestNodeRepair:
+    def test_unhealthy_node_repaired(self):
+        class RepairingKwok(KwokCloudProvider):
+            def repair_policies(self):
+                return [RepairPolicy(condition_type="Ready",
+                                     condition_status="False",
+                                     toleration_duration=300.0)]
+
+        clock = FakeClock()
+        op = Operator(options=Options(feature_gates="NodeRepair"),
+                      cloud_provider=RepairingKwok(), clock=clock)
+        op.cloud_provider.store = op.store
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        node = op.store.list(Node)[0]
+        node.status.conditions.append(
+            {"type": "Ready", "status": "False",
+             "last_transition_time": clock.now()})
+        op.store.update(node)
+        clock.step(301)
+        settle(op)
+        # node force-deleted and replaced; pod rescheduled
+        live = op.store.list(Node)
+        assert all(n.name != node.name for n in live)
+        for p in op.store.list(Pod):
+            assert p.spec.node_name
+
+
+class TestOptions:
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_DURATION", "2.5")
+        opts = parse_options([])
+        assert opts.batch_idle_duration == 2.5
+
+    def test_flag_wins(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_LOG_LEVEL", "debug")
+        opts = parse_options(["--log-level", "error"])
+        assert opts.log_level == "error"
+
+    def test_feature_gates(self):
+        fg = FeatureGates.parse("SpotToSpotConsolidation=true,NodeRepair")
+        assert fg.spot_to_spot_consolidation and fg.node_repair
+        assert not FeatureGates.parse("").node_repair
+
+
+class TestRecorder:
+    def test_dedupes_identical_events(self):
+        clock = FakeClock()
+        r = Recorder(clock)
+        ev = lambda: Event(object_kind="Node", object_name="n1",
+                           type="Normal", reason="Test", message="hi")
+        r.publish(ev())
+        r.publish(ev())
+        assert len(r.events) == 1
+        clock.step(121)
+        r.publish(ev())
+        assert len(r.events) == 2
+
+    def test_different_messages_pass(self):
+        r = Recorder(FakeClock())
+        r.publish(Event("Node", "n1", "Normal", "Test", "a"))
+        r.publish(Event("Node", "n1", "Normal", "Test", "b"))
+        assert len(r.events) == 2
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = Registry()
+        c = reg.counter("test_total", "t", ("l",))
+        c.inc({"l": "x"})
+        c.inc({"l": "x"}, 2)
+        assert c.value({"l": "x"}) == 3
+        g = reg.gauge("test_gauge", "t")
+        g.set(7.5)
+        assert g.value() == 7.5
+        h = reg.histogram("test_seconds", "t")
+        h.observe(0.05)
+        h.observe(3.0)
+        assert h.count() == 2
+        text = reg.expose()
+        assert 'test_total{l="x"} 3' in text
+        assert "test_seconds_bucket" in text
