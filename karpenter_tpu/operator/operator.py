@@ -45,8 +45,20 @@ class Operator:
         self.manager = Manager(self.store, self.clock)
 
         gates = self.options.gates
+        scheduler_factory = None
+        if self.options.solver_backend == "sidecar":
+            from ..sidecar.client import RemoteScheduler
+            address = self.options.solver_address
+
+            def scheduler_factory(nodepools, instance_types, state_nodes,
+                                  daemonset_pods, cluster):
+                return RemoteScheduler(address, nodepools, instance_types,
+                                       state_nodes=state_nodes,
+                                       daemonset_pods=daemonset_pods,
+                                       cluster=cluster)
         self.provisioner = Provisioner(self.store, self.cluster,
-                                       self.cloud_provider, self.clock)
+                                       self.cloud_provider, self.clock,
+                                       scheduler_factory=scheduler_factory)
         self.provisioner.batcher.idle = self.options.batch_idle_duration
         self.provisioner.batcher.max_duration = self.options.batch_max_duration
         self.queue = OrchestrationQueue(self.store, self.cluster, self.clock)

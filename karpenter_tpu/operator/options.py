@@ -49,7 +49,8 @@ class Options:
     cluster_name: str = "karpenter-tpu"
     enable_profiling: bool = False
     # TPU solver knobs (new surface: no reference analog)
-    solver_backend: str = "tensor"   # tensor | host
+    solver_backend: str = "tensor"   # tensor | sidecar
+    solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint
     solver_devices: int = 0          # 0 = all visible
 
     @property

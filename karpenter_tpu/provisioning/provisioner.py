@@ -115,12 +115,21 @@ class Provisioner(SingletonController):
     name = "provisioner"
 
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
-                 clock: Optional[Clock] = None, batcher: Optional[Batcher] = None):
+                 clock: Optional[Clock] = None, batcher: Optional[Batcher] = None,
+                 scheduler_factory=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or store.clock
         self.batcher = batcher or Batcher(self.clock)
+        # scheduler_factory(nodepools, instance_types, state_nodes,
+        # daemonset_pods, cluster) -> object with solve(pods); defaults to the
+        # in-process TPU tensor scheduler, swappable for the gRPC sidecar
+        self.scheduler_factory = scheduler_factory or (
+            lambda nodepools, instance_types, state_nodes, daemonset_pods,
+            cluster: TensorScheduler(
+                nodepools, instance_types, state_nodes=state_nodes,
+                daemonset_pods=daemonset_pods, cluster=cluster))
         # pod key -> nodeclaim name, consumed by the Binder
         self.nominations: Dict[str, str] = {}
         self.last_results = None
@@ -186,10 +195,10 @@ class Provisioner(SingletonController):
         instance_types = {np.name: self.cloud_provider.get_instance_types(np)
                           for np in nodepools}
         nodepools = [np for np in nodepools if instance_types.get(np.name)]
-        ts = TensorScheduler(
-            nodepools, instance_types, state_nodes=state_nodes,
-            daemonset_pods=self.cluster.daemonset_pod_list(),
-            cluster=StateClusterView(self.store, self.cluster))
+        ts = self.scheduler_factory(
+            nodepools, instance_types, state_nodes,
+            self.cluster.daemonset_pod_list(),
+            StateClusterView(self.store, self.cluster))
         return ts.solve(pods)
 
     def _create_nodeclaims(self, results) -> None:

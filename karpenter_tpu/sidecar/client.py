@@ -1,0 +1,120 @@
+"""Sidecar client: a Scheduler-shaped proxy over the gRPC boundary.
+
+RemoteScheduler mirrors TensorScheduler's solve() contract so the
+Provisioner can swap it in (options.solver_backend = "sidecar") without any
+controller change — the hiding-behind-the-interface requirement of the north
+star.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import grpc
+
+from ..api.objects import Pod
+from . import codec
+from .server import SERVICE
+
+
+@dataclass
+class RemoteNodeClaim:
+    """Launch decision reconstructed from the wire; satisfies both consumer
+    contracts — the provisioner's (to_nodeclaim() + pods) and the disruption
+    solver's (requirements + instance_type_options + the price filter)."""
+    api_nodeclaim: object
+    pods: List[Pod]
+    requirements: object = None          # scheduling.Requirements
+    instance_type_options: list = field(default_factory=list)
+
+    def finalize(self) -> None:
+        pass  # server already finalized before encoding
+
+    def to_nodeclaim(self):
+        # reflect any client-side instance-type filtering back into the claim
+        if self.instance_type_options:
+            from ..api import labels as api_labels
+            names = tuple(it.name
+                          for it in self.instance_type_options[:60])
+            for r in self.api_nodeclaim.spec.requirements:
+                if r.key == api_labels.LABEL_INSTANCE_TYPE:
+                    r.values = names
+        return self.api_nodeclaim
+
+    def remove_instance_types_by_price_and_min_values(self, reqs, max_price):
+        from ..cloudprovider.types import satisfies_min_values
+        self.instance_type_options = [
+            it for it in self.instance_type_options
+            if it.offerings.available().worst_launch_price(reqs) < max_price]
+        _, err = satisfies_min_values(self.instance_type_options, reqs)
+        if err is not None:
+            return None, err
+        return self, None
+
+    @property
+    def template(self):
+        return self  # nodepool_name passthrough
+
+    @property
+    def nodepool_name(self):
+        from ..api import labels as api_labels
+        return self.api_nodeclaim.metadata.labels.get(
+            api_labels.NODEPOOL_LABEL_KEY, "")
+
+
+@dataclass
+class RemoteExistingNode:
+    name: str
+    pods: List[Pod]
+
+
+@dataclass
+class RemoteResults:
+    new_nodeclaims: list = field(default_factory=list)
+    existing_nodes: list = field(default_factory=list)
+    pod_errors: Dict[str, str] = field(default_factory=dict)
+
+    def all_pods_scheduled(self) -> bool:
+        return not self.pod_errors
+
+
+class RemoteScheduler:
+    def __init__(self, address: str, nodepools, instance_types,
+                 state_nodes=(), daemonset_pods=(), cluster=None,
+                 channel: Optional[grpc.Channel] = None):
+        self.address = address
+        self.nodepools = list(nodepools)
+        self.instance_types = instance_types
+        self.state_nodes = list(state_nodes)
+        self.daemonset_pods = list(daemonset_pods)
+        self.fallback_reason = ""
+        self._channel = channel or grpc.insecure_channel(address)
+
+    def solve(self, pods: List[Pod]) -> RemoteResults:
+        request = codec.encode_solve_request(
+            self.nodepools, self.instance_types, pods,
+            state_nodes=self.state_nodes, daemonset_pods=self.daemonset_pods)
+        call = self._channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=None, response_deserializer=None)
+        response = call(request)
+        d = codec.decode_solve_response(response)
+        self.fallback_reason = d["fallback_reason"]
+        by_uid = {p.uid: p for p in pods}
+        it_by_name = {it.name: it for its in self.instance_types.values()
+                      for it in its}
+        results = RemoteResults(pod_errors=dict(d["pod_errors"]))
+        for item in d["new_nodeclaims"]:
+            results.new_nodeclaims.append(RemoteNodeClaim(
+                api_nodeclaim=codec.api_nodeclaim_from_dict(item["nodeclaim"]),
+                pods=[by_uid[u] for u in item["pod_uids"] if u in by_uid],
+                requirements=codec.reqs_from_list(item["requirements"]),
+                instance_type_options=[
+                    it_by_name[n] for n in item["instance_type_names"]
+                    if n in it_by_name]))
+        for item in d["existing_nodes"]:
+            results.existing_nodes.append(RemoteExistingNode(
+                name=item["name"],
+                pods=[by_uid[u] for u in item["pod_uids"] if u in by_uid]))
+        return results

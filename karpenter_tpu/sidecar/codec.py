@@ -1,0 +1,479 @@
+"""Wire codec for the solver sidecar.
+
+Serializes exactly the inputs Scheduler.Solve consumes (pods, nodepools,
+instance-type catalogs, state-node views, daemonset pods) and the outputs the
+controllers need (launchable API NodeClaims + pod assignments + errors).
+JSON-over-gRPC keeps the schema in one reviewable place; the north-star
+boundary (BASELINE.json: controllers call the accelerator via a sidecar
+hidden behind the Scheduler interface) only requires the contract, not a
+specific IDL.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim, NodeClaimSpec
+from ..api.nodepool import (Budget, Disruption, NodeClaimTemplate,
+                            NodeClaimTemplateSpec, NodeClassRef, NodePool,
+                            NodePoolSpec)
+from ..api.objects import (Affinity, HostPort, LabelSelector, NodeAffinity,
+                           NodeSelectorRequirement, NodeSelectorTerm, ObjectMeta,
+                           OwnerReference, Pod, PodAffinity, PodAffinityTerm,
+                           PodSpec, PreferredSchedulingTerm, Taint, Toleration,
+                           TopologySpreadConstraint, WeightedPodAffinityTerm)
+from ..cloudprovider.types import (InstanceType, InstanceTypeOverhead, Offering,
+                                   Offerings)
+from ..scheduling.requirement import Requirement
+from ..scheduling.requirements import Requirements
+
+# -- requirements -----------------------------------------------------------
+
+
+def req_to_dict(r: Requirement) -> dict:
+    return {"key": r.key, "op": r.operator(), "values": r.values_list(),
+            "gt": r.greater_than, "lt": r.less_than, "min_values": r.min_values}
+
+
+def req_from_dict(d: dict) -> Requirement:
+    from ..scheduling.requirement import (DOES_NOT_EXIST, EXISTS, GT, IN, LT,
+                                          NOT_IN)
+    op = d["op"]
+    if op == "Gt":
+        return Requirement(d["key"], GT, [str(d["gt"])],
+                           min_values=d.get("min_values"))
+    if op == "Lt":
+        return Requirement(d["key"], LT, [str(d["lt"])],
+                           min_values=d.get("min_values"))
+    return Requirement(d["key"], op, d["values"],
+                       min_values=d.get("min_values"))
+
+
+def reqs_to_list(reqs: Requirements) -> list:
+    return [req_to_dict(reqs.get(k)) for k in reqs]
+
+
+def reqs_from_list(items: list) -> Requirements:
+    return Requirements([req_from_dict(d) for d in items])
+
+
+# -- selectors / affinity ---------------------------------------------------
+
+
+def selector_to_dict(sel: Optional[LabelSelector]) -> Optional[dict]:
+    if sel is None:
+        return None
+    return {"match_labels": list(sel.match_labels),
+            "match_expressions": [
+                {"key": e.key, "op": e.operator, "values": list(e.values)}
+                for e in sel.match_expressions]}
+
+
+def selector_from_dict(d: Optional[dict]) -> Optional[LabelSelector]:
+    if d is None:
+        return None
+    return LabelSelector(
+        match_labels=tuple(tuple(kv) for kv in d["match_labels"]),
+        match_expressions=tuple(
+            NodeSelectorRequirement(e["key"], e["op"], tuple(e["values"]))
+            for e in d["match_expressions"]))
+
+
+def _term_to_dict(t: NodeSelectorTerm) -> list:
+    return [{"key": e.key, "op": e.operator, "values": list(e.values)}
+            for e in t.match_expressions]
+
+
+def _term_from_dict(items: list) -> NodeSelectorTerm:
+    return NodeSelectorTerm(match_expressions=tuple(
+        NodeSelectorRequirement(e["key"], e["op"], tuple(e["values"]))
+        for e in items))
+
+
+def affinity_to_dict(a: Optional[Affinity]) -> Optional[dict]:
+    if a is None:
+        return None
+    out: dict = {}
+    if a.node_affinity is not None:
+        out["node"] = {
+            "required": [_term_to_dict(t) for t in a.node_affinity.required_terms],
+            "preferred": [{"weight": p.weight,
+                           "term": _term_to_dict(p.preference)}
+                          for p in a.node_affinity.preferred]}
+    for name, pa in (("pod", a.pod_affinity), ("anti", a.pod_anti_affinity)):
+        if pa is not None:
+            out[name] = {
+                "required": [{"topology_key": t.topology_key,
+                              "selector": selector_to_dict(t.label_selector),
+                              "namespaces": list(t.namespaces)}
+                             for t in pa.required],
+                "preferred": [{"weight": w.weight,
+                               "term": {
+                                   "topology_key": w.term.topology_key,
+                                   "selector": selector_to_dict(w.term.label_selector),
+                                   "namespaces": list(w.term.namespaces)}}
+                              for w in pa.preferred]}
+    return out or None
+
+
+def _pa_term_from(d: dict) -> PodAffinityTerm:
+    return PodAffinityTerm(topology_key=d["topology_key"],
+                           label_selector=selector_from_dict(d["selector"]),
+                           namespaces=tuple(d.get("namespaces", ())))
+
+
+def affinity_from_dict(d: Optional[dict]) -> Optional[Affinity]:
+    if not d:
+        return None
+    na = pa = anti = None
+    if "node" in d:
+        na = NodeAffinity(
+            required_terms=[_term_from_dict(t) for t in d["node"]["required"]],
+            preferred=[PreferredSchedulingTerm(p["weight"],
+                                               _term_from_dict(p["term"]))
+                       for p in d["node"]["preferred"]])
+    if "pod" in d:
+        pa = PodAffinity(
+            required=[_pa_term_from(t) for t in d["pod"]["required"]],
+            preferred=[WeightedPodAffinityTerm(w["weight"],
+                                               _pa_term_from(w["term"]))
+                       for w in d["pod"]["preferred"]])
+    if "anti" in d:
+        anti = PodAffinity(
+            required=[_pa_term_from(t) for t in d["anti"]["required"]],
+            preferred=[WeightedPodAffinityTerm(w["weight"],
+                                               _pa_term_from(w["term"]))
+                       for w in d["anti"]["preferred"]])
+    return Affinity(node_affinity=na, pod_affinity=pa, pod_anti_affinity=anti)
+
+
+# -- taints / tolerations ---------------------------------------------------
+
+
+def taint_to_dict(t: Taint) -> dict:
+    return {"key": t.key, "effect": t.effect, "value": t.value}
+
+
+def taint_from_dict(d: dict) -> Taint:
+    return Taint(key=d["key"], effect=d["effect"], value=d["value"])
+
+
+def toleration_to_dict(t: Toleration) -> dict:
+    return {"key": t.key, "operator": t.operator, "value": t.value,
+            "effect": t.effect}
+
+
+def toleration_from_dict(d: dict) -> Toleration:
+    return Toleration(key=d["key"], operator=d["operator"], value=d["value"],
+                      effect=d["effect"])
+
+
+# -- pods -------------------------------------------------------------------
+
+
+def pod_to_dict(p: Pod) -> dict:
+    return {
+        "name": p.name, "namespace": p.namespace, "uid": p.uid,
+        "labels": dict(p.labels),
+        "annotations": dict(p.metadata.annotations),
+        "creation_timestamp": p.metadata.creation_timestamp,
+        "node_selector": dict(p.spec.node_selector),
+        "affinity": affinity_to_dict(p.spec.affinity),
+        "tolerations": [toleration_to_dict(t) for t in p.spec.tolerations],
+        "spread": [{"topology_key": c.topology_key, "max_skew": c.max_skew,
+                    "selector": selector_to_dict(c.label_selector),
+                    "when_unsatisfiable": c.when_unsatisfiable,
+                    "min_domains": c.min_domains}
+                   for c in p.spec.topology_spread_constraints],
+        "host_ports": [{"port": hp.port, "protocol": hp.protocol,
+                        "host_ip": hp.host_ip} for hp in p.spec.host_ports],
+        "priority": p.spec.priority,
+        "requests": [dict(r) for r in p.container_requests],
+        "init_requests": [dict(r) for r in p.init_container_requests],
+        "daemonset": p.is_daemonset_pod,
+    }
+
+
+def pod_from_dict(d: dict) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=d["name"], namespace=d["namespace"],
+                            uid=d["uid"], labels=dict(d["labels"]),
+                            annotations=dict(d["annotations"]),
+                            creation_timestamp=d["creation_timestamp"]),
+        spec=PodSpec(
+            node_selector=dict(d["node_selector"]),
+            affinity=affinity_from_dict(d["affinity"]),
+            tolerations=[toleration_from_dict(t) for t in d["tolerations"]],
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    topology_key=c["topology_key"], max_skew=c["max_skew"],
+                    label_selector=selector_from_dict(c["selector"]),
+                    when_unsatisfiable=c["when_unsatisfiable"],
+                    min_domains=c["min_domains"])
+                for c in d["spread"]],
+            host_ports=[HostPort(port=hp["port"], protocol=hp["protocol"],
+                                 host_ip=hp["host_ip"])
+                        for hp in d["host_ports"]],
+            priority=d["priority"]),
+        container_requests=[dict(r) for r in d["requests"]],
+        init_container_requests=[dict(r) for r in d["init_requests"]],
+        is_daemonset_pod=d["daemonset"])
+
+
+# -- instance types ---------------------------------------------------------
+
+
+def instance_type_to_dict(it: InstanceType) -> dict:
+    return {
+        "name": it.name,
+        "requirements": reqs_to_list(it.requirements),
+        "capacity": dict(it.capacity),
+        "overhead": {"kube_reserved": dict(it.overhead.kube_reserved),
+                     "system_reserved": dict(it.overhead.system_reserved),
+                     "eviction_threshold": dict(it.overhead.eviction_threshold)},
+        "offerings": [{"requirements": reqs_to_list(o.requirements),
+                       "price": o.price, "available": o.available}
+                      for o in it.offerings],
+    }
+
+
+def instance_type_from_dict(d: dict) -> InstanceType:
+    offs = Offerings(Offering(requirements=reqs_from_list(o["requirements"]),
+                              price=o["price"], available=o["available"])
+                     for o in d["offerings"])
+    return InstanceType(
+        name=d["name"], requirements=reqs_from_list(d["requirements"]),
+        capacity=dict(d["capacity"]), offerings=offs,
+        overhead=InstanceTypeOverhead(
+            kube_reserved=dict(d["overhead"]["kube_reserved"]),
+            system_reserved=dict(d["overhead"]["system_reserved"]),
+            eviction_threshold=dict(d["overhead"]["eviction_threshold"])))
+
+
+# -- nodepools --------------------------------------------------------------
+
+
+def nodepool_to_dict(np: NodePool) -> dict:
+    spec = np.spec.template.spec
+    return {
+        "name": np.name, "uid": np.metadata.uid,
+        "labels": dict(np.spec.template.metadata_labels),
+        "annotations": dict(np.spec.template.metadata_annotations),
+        "requirements": [{"key": r.key, "op": r.operator,
+                          "values": list(r.values),
+                          "min_values": getattr(r, "min_values", None)}
+                         for r in spec.requirements],
+        "taints": [taint_to_dict(t) for t in spec.taints],
+        "startup_taints": [taint_to_dict(t) for t in spec.startup_taints],
+        "expire_after": spec.expire_after,
+        "termination_grace_period": spec.termination_grace_period,
+        "limits": dict(np.spec.limits),
+        "weight": np.spec.weight,
+    }
+
+
+def nodepool_from_dict(d: dict) -> NodePool:
+    reqs = []
+    for r in d["requirements"]:
+        nsr = NodeSelectorRequirement(r["key"], r["op"], tuple(r["values"]))
+        if r.get("min_values") is not None:
+            nsr = _MinValuesReq(nsr, r["min_values"])
+        reqs.append(nsr)
+    return NodePool(
+        metadata=ObjectMeta(name=d["name"], uid=d["uid"], namespace=""),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplate(
+                metadata_labels=dict(d["labels"]),
+                metadata_annotations=dict(d["annotations"]),
+                spec=NodeClaimTemplateSpec(
+                    requirements=reqs,
+                    taints=[taint_from_dict(t) for t in d["taints"]],
+                    startup_taints=[taint_from_dict(t)
+                                    for t in d["startup_taints"]],
+                    expire_after=d["expire_after"],
+                    termination_grace_period=d["termination_grace_period"])),
+            limits=dict(d["limits"]), weight=d["weight"]))
+
+
+class _MinValuesReq:
+    """NodeSelectorRequirement + min_values rider."""
+
+    def __init__(self, base: NodeSelectorRequirement, min_values: int):
+        self.key = base.key
+        self.operator = base.operator
+        self.values = base.values
+        self.min_values = min_values
+
+
+# -- state nodes ------------------------------------------------------------
+
+
+def state_node_to_dict(sn) -> dict:
+    return {
+        "name": sn.name(), "labels": dict(sn.labels()),
+        "taints": [taint_to_dict(t) for t in sn.taints()],
+        "allocatable": dict(sn.allocatable()),
+        "capacity": dict(sn.capacity()),
+        "pod_requests": {uid: dict(r) for uid, r in sn.pod_requests.items()},
+        "daemonset_requests": {uid: dict(r) for uid, r
+                               in sn.daemonset_pod_requests.items()},
+        "initialized": sn.initialized(),
+    }
+
+
+class WireStateNode:
+    """StateNode view reconstructed from the wire (duck-typed for the
+    scheduler: name/labels/taints/allocatable/available/capacity/
+    daemonset_requests/hostname/host_port_usage/initialized)."""
+
+    def __init__(self, d: dict):
+        from ..scheduling.hostports import HostPortUsage
+        from ..utils import resources as res
+        self._d = d
+        self._taints = [taint_from_dict(t) for t in d["taints"]]
+        self._hpu = HostPortUsage()
+        self.pod_requests = dict(d["pod_requests"])
+        self.daemonset_pod_requests = dict(d["daemonset_requests"])
+        total = (res.merge(*self.pod_requests.values())
+                 if self.pod_requests else {})
+        self._available = res.subtract(dict(d["allocatable"]), total)
+
+    def name(self):
+        return self._d["name"]
+
+    def hostname(self):
+        return self._d["labels"].get(api_labels.LABEL_HOSTNAME, self._d["name"])
+
+    def labels(self):
+        return self._d["labels"]
+
+    def taints(self):
+        return self._taints
+
+    def allocatable(self):
+        return dict(self._d["allocatable"])
+
+    def capacity(self):
+        return dict(self._d["capacity"])
+
+    def available(self):
+        return dict(self._available)
+
+    def daemonset_requests(self):
+        from ..utils import resources as res
+        return (res.merge(*self.daemonset_pod_requests.values())
+                if self.daemonset_pod_requests else {})
+
+    def host_port_usage(self):
+        return self._hpu
+
+    def initialized(self):
+        return self._d["initialized"]
+
+
+# -- nodeclaims (results) ---------------------------------------------------
+
+
+def api_nodeclaim_to_dict(nc: NodeClaim) -> dict:
+    return {
+        "name": nc.name, "labels": dict(nc.metadata.labels),
+        "annotations": dict(nc.metadata.annotations),
+        "owner_refs": [{"kind": o.kind, "name": o.name, "uid": o.uid}
+                       for o in nc.metadata.owner_refs],
+        "requirements": [{"key": r.key, "op": r.operator,
+                          "values": list(r.values),
+                          "min_values": r.min_values}
+                         for r in nc.spec.requirements],
+        "requests": dict(nc.spec.resources_requests),
+        "taints": [taint_to_dict(t) for t in nc.spec.taints],
+        "startup_taints": [taint_to_dict(t) for t in nc.spec.startup_taints],
+        "expire_after": nc.spec.expire_after,
+        "termination_grace_period": nc.spec.termination_grace_period,
+    }
+
+
+def api_nodeclaim_from_dict(d: dict) -> NodeClaim:
+    from ..provisioning.scheduler import _SelectorReq
+    return NodeClaim(
+        metadata=ObjectMeta(
+            name=d["name"], namespace="", labels=dict(d["labels"]),
+            annotations=dict(d["annotations"]),
+            owner_refs=[OwnerReference(kind=o["kind"], name=o["name"],
+                                       uid=o["uid"], block_owner_deletion=True)
+                        for o in d["owner_refs"]]),
+        spec=NodeClaimSpec(
+            requirements=[_SelectorReq(r["key"], r["op"], tuple(r["values"]),
+                                       r["min_values"])
+                          for r in d["requirements"]],
+            resources_requests=dict(d["requests"]),
+            taints=[taint_from_dict(t) for t in d["taints"]],
+            startup_taints=[taint_from_dict(t) for t in d["startup_taints"]],
+            expire_after=d["expire_after"],
+            termination_grace_period=d["termination_grace_period"]))
+
+
+# -- request / response -----------------------------------------------------
+
+
+def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]],
+                         pods, state_nodes=(), daemonset_pods=()) -> bytes:
+    catalog: Dict[str, dict] = {}
+    per_pool: Dict[str, List[str]] = {}
+    for pool, its in instance_types.items():
+        per_pool[pool] = [it.name for it in its]
+        for it in its:
+            if it.name not in catalog:
+                catalog[it.name] = instance_type_to_dict(it)
+    payload = {
+        "nodepools": [nodepool_to_dict(np) for np in nodepools],
+        "catalog": list(catalog.values()),
+        "pool_instance_types": per_pool,
+        "pods": [pod_to_dict(p) for p in pods],
+        "state_nodes": [state_node_to_dict(sn) for sn in state_nodes],
+        "daemonset_pods": [pod_to_dict(p) for p in daemonset_pods],
+    }
+    return json.dumps(payload).encode()
+
+
+def decode_solve_request(data: bytes):
+    d = json.loads(data.decode())
+    catalog = {it["name"]: instance_type_from_dict(it) for it in d["catalog"]}
+    instance_types = {pool: [catalog[n] for n in names]
+                      for pool, names in d["pool_instance_types"].items()}
+    return (
+        [nodepool_from_dict(np) for np in d["nodepools"]],
+        instance_types,
+        [pod_from_dict(p) for p in d["pods"]],
+        [WireStateNode(sn) for sn in d["state_nodes"]],
+        [pod_from_dict(p) for p in d["daemonset_pods"]],
+    )
+
+
+def encode_solve_response(results, fallback_reason: str = "") -> bytes:
+    new_claims = []
+    for nc in results.new_nodeclaims:
+        nc.finalize()
+        api_nc = nc.to_nodeclaim()
+        new_claims.append({
+            "nodeclaim": api_nodeclaim_to_dict(api_nc),
+            "pod_uids": [p.uid for p in nc.pods],
+            # solver-state riders so the disruption price filter can run
+            # client-side (consolidation.go:169-221)
+            "requirements": reqs_to_list(nc.requirements),
+            "instance_type_names": [it.name for it in nc.instance_type_options],
+        })
+    payload = {
+        "new_nodeclaims": new_claims,
+        "existing_nodes": [{"name": en.name,
+                            "pod_uids": [p.uid for p in en.pods]}
+                           for en in results.existing_nodes],
+        "pod_errors": dict(results.pod_errors),
+        "fallback_reason": fallback_reason,
+    }
+    return json.dumps(payload).encode()
+
+
+def decode_solve_response(data: bytes) -> dict:
+    return json.loads(data.decode())

@@ -1,0 +1,68 @@
+"""Solver sidecar server: the accelerator process.
+
+The north-star deployment (BASELINE.json) keeps the controllers in their own
+process and calls the TPU solver through a gRPC boundary hidden behind the
+Scheduler interface. This server owns the TPU devices, keeps the jit cache
+warm across solves, and exposes one method:
+
+    /karpenter.v1.Solver/Solve   (bytes in, bytes out — codec.py JSON)
+
+Generic byte-level gRPC handlers keep the contract free of generated stubs;
+the message schema lives in codec.py.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..provisioning.tensor_scheduler import TensorScheduler
+from . import codec
+
+SERVICE = "karpenter.v1.Solver"
+
+
+def _solve(request: bytes, context=None) -> bytes:
+    nodepools, instance_types, pods, state_nodes, daemonset_pods = \
+        codec.decode_solve_request(request)
+    ts = TensorScheduler(nodepools, instance_types, state_nodes=state_nodes,
+                         daemonset_pods=daemonset_pods)
+    results = ts.solve(pods)
+    return codec.encode_solve_response(results, ts.fallback_reason)
+
+
+class SolverServicer(grpc.GenericRpcHandler):
+    def service(self, handler_call_details):
+        if handler_call_details.method == f"/{SERVICE}/Solve":
+            return grpc.unary_unary_rpc_method_handler(
+                _solve,
+                request_deserializer=None,   # raw bytes
+                response_serializer=None)
+        return None
+
+
+def serve(port: int = 0, max_workers: int = 4):
+    """Start the sidecar; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((SolverServicer(),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
+    parser.add_argument("--port", type=int, default=50551)
+    args = parser.parse_args(argv)
+    server, bound = serve(args.port)
+    print(f"solver sidecar listening on 127.0.0.1:{bound}", flush=True)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

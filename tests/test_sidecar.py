@@ -1,0 +1,93 @@
+"""Sidecar boundary: codec round-trip, remote solve parity, operator loop
+over the gRPC backend."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.sidecar import codec
+from karpenter_tpu.sidecar.client import RemoteScheduler
+from karpenter_tpu.sidecar.server import serve
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import (affinity_term, make_nodepool, make_pod, make_pods,
+                       spread_zone)
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    server, port = serve(port=0)
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+class TestCodec:
+    def test_pod_round_trip(self):
+        pod = make_pod(cpu="500m", memory="1Gi", labels={"app": "x"},
+                       node_selector={"zone": "a"},
+                       spread=[spread_zone(key="app", value="x")],
+                       pod_anti_affinity=[
+                           affinity_term(api_labels.LABEL_HOSTNAME,
+                                         key="app", value="x")])
+        d = codec.pod_to_dict(pod)
+        back = codec.pod_from_dict(d)
+        assert back.uid == pod.uid
+        assert back.requests() == pod.requests()
+        assert back.spec.node_selector == pod.spec.node_selector
+        assert len(back.spec.topology_spread_constraints) == 1
+        assert back.spec.affinity.pod_anti_affinity.required[0].topology_key \
+            == api_labels.LABEL_HOSTNAME
+        assert codec.pod_to_dict(back) == d
+
+    def test_instance_type_round_trip(self):
+        it = construct_instance_types()[0]
+        back = codec.instance_type_from_dict(codec.instance_type_to_dict(it))
+        assert back.name == it.name
+        assert back.capacity == it.capacity
+        assert len(back.offerings) == len(it.offerings)
+        assert back.allocatable() == it.allocatable()
+
+    def test_nodepool_round_trip(self):
+        pool = make_nodepool(name="p1", limits={"cpu": "100"}, weight=7)
+        back = codec.nodepool_from_dict(codec.nodepool_to_dict(pool))
+        assert back.name == "p1"
+        assert back.spec.limits == pool.spec.limits
+        assert back.spec.weight == 7
+
+
+class TestRemoteSolve:
+    def test_parity_with_local(self, sidecar):
+        its = construct_instance_types()[:48]
+        pool = make_nodepool(name="default")
+        pods = (make_pods(10, cpu="500m", memory="256Mi")
+                + make_pods(6, cpu="1000m", labels={"app": "s"},
+                            spread=[spread_zone(key="app", value="s")]))
+        local = TensorScheduler([pool], {"default": its}).solve(pods)
+        remote = RemoteScheduler(sidecar, [pool], {"default": its}).solve(pods)
+        assert len(remote.new_nodeclaims) == len(local.new_nodeclaims)
+        assert remote.pod_errors == local.pod_errors
+        # per-claim pod partitions match sizes
+        assert sorted(len(nc.pods) for nc in remote.new_nodeclaims) == \
+            sorted(len(nc.pods) for nc in local.new_nodeclaims)
+        # the emitted API claims carry instance-type requirements
+        api_nc = remote.new_nodeclaims[0].to_nodeclaim()
+        keys = {r.key for r in api_nc.spec.requirements}
+        assert api_labels.LABEL_INSTANCE_TYPE in keys
+
+    def test_operator_over_sidecar_backend(self, sidecar):
+        op = Operator(options=Options(solver_backend="sidecar",
+                                      solver_address=sidecar),
+                      clock=FakeClock())
+        op.store.create(make_nodepool(name="default"))
+        for p in make_pods(5, cpu="500m"):
+            op.store.create(p)
+        for _ in range(6):
+            op.step()
+            op.clock.step(1.1)
+        op.step()
+        assert all(p.spec.node_name for p in op.store.list(Pod))
+        assert op.store.list(Node)
