@@ -29,12 +29,14 @@ from karpenter_tpu.api.nodepool import (NodeClaimTemplate, NodeClaimTemplateSpec
 from karpenter_tpu.api.objects import (Affinity, LabelSelector, ObjectMeta, Pod,
                                        PodAffinity, PodAffinityTerm, PodSpec,
                                        TopologySpreadConstraint)
-from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.cloudprovider.kwok import (construct_catalog,
+                                              construct_instance_types)
 from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
 from karpenter_tpu.utils import resources as res
 
 N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
+N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 
 _CPUS = ["50m", "100m", "250m", "500m", "1000m"]
@@ -81,12 +83,16 @@ def _pods():
     return pods
 
 
+def _catalog():
+    return construct_catalog(N_ITS) if N_ITS else construct_instance_types()
+
+
 def _scheduler():
     nodepool = NodePool(
         metadata=ObjectMeta(name="default"),
         spec=NodePoolSpec(template=NodeClaimTemplate(
             spec=NodeClaimTemplateSpec())))
-    return TensorScheduler([nodepool], {"default": construct_instance_types()})
+    return TensorScheduler([nodepool], {"default": _catalog()})
 
 
 def main():
@@ -106,9 +112,10 @@ def main():
         best = min(best, time.perf_counter() - t0)
 
     pods_per_sec = len(pods) / best
+    n_its = N_ITS if N_ITS else 144
     print(json.dumps({
-        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x 144 "
-                   "instance types, reference benchmark pod mix"),
+        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
+                   f"{n_its} instance types, reference benchmark pod mix"),
         "value": round(pods_per_sec, 1),
         "unit": "pods/sec",
         "vs_baseline": round(pods_per_sec / 100.0, 2),

@@ -28,7 +28,7 @@ _CPU_SIZES = [1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256]
 _MEM_FACTORS = [2, 4, 8]
 _OSES = ["linux", "windows"]
 _ARCHES = [api_labels.ARCHITECTURE_AMD64, api_labels.ARCHITECTURE_ARM64]
-_FAMILY = {2: "c", 4: "s", 8: "m"}
+_FAMILY = {2: "c", 3: "cs", 4: "s", 6: "sm", 8: "m"}
 
 GROUP_INSTANCE_SIZE = "karpenter.kwok.sh/instance-size"
 GROUP_INSTANCE_FAMILY = "karpenter.kwok.sh/instance-family"
@@ -88,6 +88,26 @@ def make_instance_type(cpu: int, mem_factor: int, arch: str, os: str,
 def construct_instance_types(zones: Optional[List[str]] = None) -> "list[InstanceType]":
     return [make_instance_type(cpu, mf, arch, os, zones)
             for cpu in _CPU_SIZES for mf in _MEM_FACTORS for os in _OSES for arch in _ARCHES]
+
+
+def construct_catalog(n: int, zones: Optional[List[str]] = None) -> "list[InstanceType]":
+    """Synthetic catalog of exactly n instance types for scale testing (the
+    north-star 2k-type config, BASELINE.md): a denser cpu ladder crossed with
+    extra memory factors, same offering structure and price formula as the
+    kwok 144."""
+    import math
+    mfs = [2, 3, 4, 6, 8]
+    per_cpu = len(mfs) * len(_OSES) * len(_ARCHES)
+    cpu_sizes = range(1, math.ceil(n / per_cpu) + 1)
+    out = []
+    for cpu in cpu_sizes:
+        for mf in mfs:
+            for os in _OSES:
+                for arch in _ARCHES:
+                    if len(out) >= n:
+                        return out
+                    out.append(make_instance_type(cpu, mf, arch, os, zones))
+    return out
 
 
 class KwokCloudProvider(CloudProvider):

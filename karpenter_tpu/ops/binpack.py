@@ -104,6 +104,7 @@ class PackProblem:
     exist_zone: Optional[np.ndarray] = None          # int32 [N] zone idx or -1
     tol_exist: Optional[np.ndarray] = None           # bool [G, N]
     allow_undefined: Optional[np.ndarray] = None     # bool [K] well-known keys
+    off_price: Optional[np.ndarray] = None           # float32 [T, O] (inf absent)
 
 
 @dataclass
@@ -161,9 +162,15 @@ def precompute_kernel(group, template, it, group_req, daemon, alloc,
                & tol_template[:, :, None]
                & compat_tm.T[:, :, None]
                & (ppn >= 1))
-    it_ok_any = ok_base & off_ok_any.reshape(M, G, T).transpose(1, 0, 2)
     it_ok_z = (ok_base[:, :, :, None]
                & off_ok_z.reshape(M, G, T, Z).transpose(1, 0, 2, 3))
+    # pack the zone axis into a bitfield: one fetched word instead of Z+1
+    # bool planes (it_ok_any == any bit set, derived host-side)
+    pack_dtype = jnp.uint8 if Z <= 8 else (jnp.uint16 if Z <= 16 else jnp.uint32)
+    weights = (jnp.ones((), pack_dtype) << jnp.arange(Z, dtype=pack_dtype))
+    it_okz_packed = jnp.sum(
+        it_ok_z.astype(pack_dtype) * weights[None, None, None, :], axis=-1,
+        dtype=pack_dtype)
     zone_adm_gmz = zone_adm.reshape(M, G, Z).transpose(1, 0, 2)
 
     if has_exist:
@@ -179,8 +186,8 @@ def precompute_kernel(group, template, it, group_req, daemon, alloc,
         exist_ok = jnp.zeros((G, 1), dtype=bool)
         exist_cap = jnp.zeros((G, 1), dtype=jnp.int32)
 
-    return (compat_tm, it_ok_any, ppn.astype(jnp.int32), it_ok_z,
-            zone_adm_gmz, exist_ok, exist_cap)
+    ppn16 = jnp.clip(ppn, 0, 32767).astype(jnp.int16)
+    return (compat_tm, it_okz_packed, ppn16, zone_adm_gmz, exist_ok, exist_cap)
 
 
 _precompute_device = partial(jax.jit, static_argnames=(
@@ -232,7 +239,25 @@ def device_args(p: PackProblem):
 def precompute(p: PackProblem) -> PackTensors:
     args, statics = device_args(p)
     out = _precompute_device(*args, **statics)
-    return PackTensors(*(np.asarray(x) for x in out))
+    # one bulk fetch: per-array np.asarray pays a host<->device round trip
+    # per tensor, which dominates when the device sits behind a network
+    # tunnel (axon)
+    compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
+        jax.device_get(out)
+    return unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,
+                          exist_ok, exist_cap, p.zone_values.shape[0])
+
+
+def unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm, exist_ok,
+                   exist_cap, Z: int) -> PackTensors:
+    """Expand the packed zone bitfield back into the packer's bool views."""
+    bits = (it_okz_packed[..., None] >> np.arange(Z).astype(
+        it_okz_packed.dtype)) & 1
+    it_ok_z = bits.astype(bool)
+    return PackTensors(compat_tm=compat_tm, it_ok=it_okz_packed != 0,
+                       ppn=ppn.astype(np.int32), it_ok_z=it_ok_z,
+                       zone_adm=zone_adm, exist_ok=exist_ok,
+                       exist_cap=exist_cap)
 
 
 # --------------------------------------------------------------------------

@@ -210,17 +210,27 @@ def encode_requirements(vocab: Vocab, reqs: Requirements) -> EncodedRequirements
                                exempt=exempt, gt=gt.astype(np.int64), lt=lt.astype(np.int64))
 
 
+def _tail_mask(vocab: Vocab) -> np.ndarray:
+    """[K, W] uint32 mask keeping bits up to each key's OTHER slot; cached on
+    the vocab (valid once frozen; invalidated by key/value growth)."""
+    cached = getattr(vocab, "_tail_mask", None)
+    if cached is not None and cached.shape == (vocab.K, vocab.W):
+        return cached
+    K, W = vocab.K, vocab.W
+    ob = np.array([vocab.other_bit(k) for k in range(K)])[:, None]  # [K,1]
+    lo = (np.arange(W) * 32)[None, :]                               # [1,W]
+    keep = np.clip(ob + 1 - lo, 0, 32)
+    full = np.uint32(0xFFFFFFFF)
+    safe = np.minimum(keep, 31).astype(np.uint32)  # avoid UB shift by 32
+    mask = np.where(keep >= 32, full,
+                    (np.uint32(1) << safe) - np.uint32(1)).astype(np.uint32)
+    vocab._tail_mask = mask
+    return mask
+
+
 def _trim_tail_bits(vocab: Vocab, mask: np.ndarray) -> None:
     """Zero bits beyond each key's OTHER slot so popcounts stay meaningful."""
-    for k in range(vocab.K):
-        ob = vocab.other_bit(k)
-        for w in range(vocab.W):
-            lo_bit = w * 32
-            hi_bit = lo_bit + 32
-            if hi_bit <= ob:
-                continue
-            keep = max(0, ob + 1 - lo_bit)
-            mask[k, w] &= np.uint32((1 << keep) - 1) if keep < 32 else np.uint32(0xFFFFFFFF)
+    mask &= _tail_mask(vocab)
 
 
 def stack_encoded(items: Sequence[EncodedRequirements]) -> EncodedRequirements:

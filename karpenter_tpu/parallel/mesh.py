@@ -106,6 +106,8 @@ def pad_problem(p: binpack.PackProblem, g_mult: int, t_mult: int
         off_zone=_pad_to(p.off_zone, 0, Tp, fill=-1),
         off_captype=_pad_to(p.off_captype, 0, Tp, fill=-1),
         off_available=_pad_to(p.off_available, 0, Tp),
+        off_price=(_pad_to(p.off_price, 0, Tp, fill=np.inf)
+                   if p.off_price is not None else None),
         zone_key=p.zone_key, captype_key=p.captype_key,
         zone_values=p.zone_values,
         exist_enc=p.exist_enc, exist_avail=p.exist_avail,
@@ -147,9 +149,8 @@ def _out_shardings(mesh: Mesh):
     g0 = NamedSharding(mesh, P(GROUPS_AXIS))
     mg = NamedSharding(mesh, P(None, GROUPS_AXIS))
     gmt = NamedSharding(mesh, P(GROUPS_AXIS, None, CATALOG_AXIS))
-    gmtz = NamedSharding(mesh, P(GROUPS_AXIS, None, CATALOG_AXIS, None))
-    # (compat_tm, it_ok_any, ppn, it_ok_z, zone_adm, exist_ok, exist_cap)
-    return (mg, gmt, gmt, gmtz, g0, g0, g0)
+    # (compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap)
+    return (mg, gmt, gmt, g0, g0, g0)
 
 
 _sharded_cache = {}
@@ -172,13 +173,16 @@ def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensor
             out_shardings=_out_shardings(mesh))
         _sharded_cache[key] = fn
     out = fn(*args)
-    compat_tm, it_ok, ppn, it_ok_z, zone_adm, exist_ok, exist_cap = (
-        np.asarray(x) for x in out)
+    compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
+        jax.device_get(out)
+    t = binpack.unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,
+                               exist_ok, exist_cap,
+                               padded.zone_values.shape[0])
     return binpack.PackTensors(
-        compat_tm=compat_tm[:, :G],
-        it_ok=it_ok[:G, :, :T],
-        ppn=ppn[:G, :, :T],
-        it_ok_z=it_ok_z[:G, :, :T],
-        zone_adm=zone_adm[:G],
-        exist_ok=exist_ok[:G],
-        exist_cap=exist_cap[:G])
+        compat_tm=t.compat_tm[:, :G],
+        it_ok=t.it_ok[:G, :, :T],
+        ppn=t.ppn[:G, :, :T],
+        it_ok_z=t.it_ok_z[:G, :, :T],
+        zone_adm=t.zone_adm[:G],
+        exist_ok=t.exist_ok[:G],
+        exist_cap=t.exist_cap[:G])

@@ -119,27 +119,71 @@ def _classify_topology(pod: Pod) -> "Tuple[Optional[List[TopoSpec]], bool]":
     return specs, relaxable
 
 
+def _affinity_key(pod: Pod):
+    """Hashable structural key over the (frozen-dataclass) affinity terms."""
+    a = pod.spec.affinity
+    if a is None:
+        return None
+    parts = []
+    if a.node_affinity is not None:
+        parts.append(("node", tuple(a.node_affinity.required_terms),
+                      tuple(a.node_affinity.preferred)))
+    if a.pod_affinity is not None:
+        parts.append(("pod", tuple(a.pod_affinity.required),
+                      tuple(a.pod_affinity.preferred)))
+    if a.pod_anti_affinity is not None:
+        parts.append(("anti", tuple(a.pod_anti_affinity.required),
+                      tuple(a.pod_anti_affinity.preferred)))
+    return tuple(parts)
+
+
 def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
-    """Returns (groups, "") or (None, reason-for-host-fallback)."""
+    """Returns (groups, "") or (None, reason-for-host-fallback).
+
+    Two-phase: a cheap structural signature buckets the pods; the expensive
+    classification (Requirements construction, topology-shape analysis) runs
+    once per bucket — O(groups), not O(pods)."""
     groups: Dict = {}
     order: List = []
+    # structural tokens memoized by sub-object identity: pods stamped from one
+    # deployment share their spec sub-objects, so the expensive structural
+    # hashing runs once per deployment, not once per pod — and the per-pod
+    # signature is a tuple of small ints. Structural equality is preserved:
+    # distinct-but-equal objects resolve to the same token via struct_tokens.
+    id_memo: Dict[int, int] = {}
+    struct_tokens: Dict[object, int] = {}
+
+    def tok(obj, builder):
+        t = id_memo.get(id(obj))
+        if t is None:
+            k = builder(obj)
+            t = struct_tokens.setdefault(k, len(struct_tokens))
+            id_memo[id(obj)] = t
+        return t
+
+    ident = lambda o: o
+    items_key = lambda d: tuple(sorted(d.items()))
     for pod in pods:
-        specs, relaxable = _classify_topology(pod)
-        if specs is None:
-            return None, "unsupported topology constraint shape"
         if pod.spec.host_ports:
             return None, "host ports require per-pod conflict tracking"
-        reqs = pod_requirements(pod)
+        aff = pod.spec.affinity
         sig = (
-            _req_signature(reqs),
-            tuple(sorted(pod.requests().items())),
-            tuple(sorted(pod.spec.tolerations, key=repr)),
-            tuple(sorted(pod.labels.items())),
-            tuple((s.kind, s.max_skew, s.schedule_anyway) for s in specs),
+            tok(pod.spec.node_selector, items_key),
+            -1 if aff is None else tok(aff, lambda a, p=pod: _affinity_key(p)),
+            tuple(tok(c, ident)
+                  for c in pod.spec.topology_spread_constraints),
+            tuple(tok(t, ident) for t in pod.spec.tolerations),
+            tok(pod.metadata.labels, items_key),
+            tuple(tok(r, items_key) for r in pod.container_requests),
+            tuple(tok(r, items_key) for r in pod.init_container_requests),
         )
         g = groups.get(sig)
         if g is None:
-            g = PodGroup(pods=[], requirements=reqs, requests=pod.requests(),
+            specs, relaxable = _classify_topology(pod)
+            if specs is None:
+                return None, "unsupported topology constraint shape"
+            g = PodGroup(pods=[], requirements=pod_requirements(pod),
+                         requests=pod.requests(),
                          tolerations=tuple(pod.spec.tolerations),
                          labels=dict(pod.labels), topo=specs,
                          has_relaxable=relaxable or has_preferred_node_affinity(pod))

@@ -203,12 +203,14 @@ class TensorScheduler:
         off_zone = np.full((T, O), -1, dtype=np.int32)
         off_captype = np.full((T, O), -1, dtype=np.int32)
         off_available = np.zeros((T, O), dtype=bool)
+        off_price = np.full((T, O), np.inf, dtype=np.float32)
         it_price = np.full(T, np.inf, dtype=np.float32)
         for t, it in enumerate(catalog):
             for o, off in enumerate(it.offerings):
                 if not off.available:
                     continue
                 off_available[t, o] = True
+                off_price[t, o] = off.price
                 z = off.zone
                 ct = off.capacity_type
                 if z:
@@ -253,6 +255,7 @@ class TensorScheduler:
             it_capacity=it_capacity, it_price=it_price, template_its=template_its,
             off_zone=off_zone, off_captype=off_captype, off_available=off_available,
             zone_key=zone_key, captype_key=captype_key, zone_values=zone_values,
+            off_price=off_price,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined)
         return problem, templates, catalog
@@ -294,10 +297,36 @@ class TensorScheduler:
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
                                 initial_zone_counts=izc, exist_order=sn_order)
         pr = packer.pack()
-        return self._materialize(pr, groups, templates, catalog, vocab, zone_key)
+        return self._materialize(pr, problem, groups, templates, catalog,
+                                 vocab, zone_key)
 
-    def _materialize(self, pr: binpack.PackResult, groups, templates, catalog,
-                     vocab, zone_key) -> Results:
+    @staticmethod
+    def _cohort_price_order(problem, cohort) -> np.ndarray:
+        """Surviving instance types of a cohort ordered by cheapest admitted
+        offering — the vectorized OrderByPrice (types.go:117-134): an offering
+        counts when available and its zone/captype value is admitted by the
+        cohort's accumulated requirement mask."""
+        t_idx = np.where(cohort.it_set)[0]
+        if t_idx.size == 0:
+            return t_idx
+
+        def admits(key: int, vals: np.ndarray) -> np.ndarray:
+            mask = cohort.enc.mask[key]                    # [W] uint32
+            word = np.where(vals >= 0, vals // 32, 0)
+            bit = np.where(vals >= 0, vals % 32, 0).astype(np.uint32)
+            has = (mask[word] >> bit) & np.uint32(1)
+            return np.where(vals >= 0, has == 1, True)
+
+        off_zone = problem.off_zone[t_idx]
+        off_cap = problem.off_captype[t_idx]
+        ok = (problem.off_available[t_idx]
+              & admits(problem.zone_key, off_zone)
+              & admits(problem.captype_key, off_cap))
+        price = np.where(ok, problem.off_price[t_idx], np.inf).min(axis=1)
+        return t_idx[np.argsort(price, kind="stable")]
+
+    def _materialize(self, pr: binpack.PackResult, problem, groups, templates,
+                     catalog, vocab, zone_key) -> Results:
         # hand out pod objects per group in order
         cursors = [0] * len(groups)
 
@@ -308,23 +337,28 @@ class TensorScheduler:
 
         new_claims: List[TensorNodeClaim] = []
         for cohort in pr.cohorts:
-            its = [catalog[t] for t in np.where(cohort.it_set)[0]]
+            ordered = [catalog[t]
+                       for t in self._cohort_price_order(problem, cohort)]
+            base_reqs = Requirements(templates[cohort.m].requirements.values())
+            for g in cohort.pods_by_group:
+                base_reqs.add(*groups[g].requirements.values())
+            if cohort.zone is not None:
+                zone_name = vocab.values[zone_key][cohort.zone]
+                base_reqs.add(Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN,
+                                          [zone_name]))
+            # all pods of a group are identical: node requests = per-pod
+            # requests scaled by fill (no per-pod re-merge)
+            requests: dict = {}
+            for g, fill in cohort.pods_by_group.items():
+                for rname, v in groups[g].requests.items():
+                    requests[rname] = requests.get(rname, 0) + v * fill
             for _ in range(cohort.n):
-                reqs = Requirements(templates[cohort.m].requirements.values())
-                requests: dict = {}
+                reqs = Requirements(base_reqs.values())
                 pods: List[Pod] = []
                 for g, fill in cohort.pods_by_group.items():
-                    reqs.add(*groups[g].requirements.values())
-                    node_pods = take(g, fill)
-                    pods.extend(node_pods)
-                    requests = res.merge(requests,
-                                         *(p.requests() for p in node_pods))
-                if cohort.zone is not None:
-                    zone_name = vocab.values[zone_key][cohort.zone]
-                    reqs.add(Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [zone_name]))
-                ordered = order_by_price(its, reqs)
+                    pods.extend(take(g, fill))
                 new_claims.append(TensorNodeClaim(
-                    templates[cohort.m], reqs, ordered, pods, requests))
+                    templates[cohort.m], reqs, ordered, pods, dict(requests)))
         existing: List[TensorExistingNode] = []
         for n, fills in pr.existing.items():
             pods = []
