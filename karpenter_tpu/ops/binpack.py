@@ -164,13 +164,20 @@ def precompute_kernel(group, template, it, group_req, daemon, alloc,
                & (ppn >= 1))
     it_ok_z = (ok_base[:, :, :, None]
                & off_ok_z.reshape(M, G, T, Z).transpose(1, 0, 2, 3))
-    # pack the zone axis into a bitfield: one fetched word instead of Z+1
-    # bool planes (it_ok_any == any bit set, derived host-side)
+    # pack the zone axis into a bitfield: Wz fetched words instead of Z+1
+    # bool planes (it_ok_any == any bit set, derived host-side). Multi-word
+    # so Z > 32 packs losslessly.
     pack_dtype = jnp.uint8 if Z <= 8 else (jnp.uint16 if Z <= 16 else jnp.uint32)
-    weights = (jnp.ones((), pack_dtype) << jnp.arange(Z, dtype=pack_dtype))
+    word_bits = jnp.iinfo(pack_dtype).bits
+    Wz = -(-Z // word_bits)
+    z_pad = Wz * word_bits - Z
+    padded_ok = jnp.pad(it_ok_z, ((0, 0), (0, 0), (0, 0), (0, z_pad)))
+    weights = (jnp.ones((), pack_dtype)
+               << jnp.arange(word_bits, dtype=pack_dtype))
     it_okz_packed = jnp.sum(
-        it_ok_z.astype(pack_dtype) * weights[None, None, None, :], axis=-1,
-        dtype=pack_dtype)
+        padded_ok.reshape(G, M, T, Wz, word_bits).astype(pack_dtype)
+        * weights[None, None, None, None, :], axis=-1,
+        dtype=pack_dtype)                                    # [G,M,T,Wz]
     zone_adm_gmz = zone_adm.reshape(M, G, Z).transpose(1, 0, 2)
 
     if has_exist:
@@ -250,11 +257,15 @@ def precompute(p: PackProblem) -> PackTensors:
 
 def unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm, exist_ok,
                    exist_cap, Z: int) -> PackTensors:
-    """Expand the packed zone bitfield back into the packer's bool views."""
-    bits = (it_okz_packed[..., None] >> np.arange(Z).astype(
-        it_okz_packed.dtype)) & 1
-    it_ok_z = bits.astype(bool)
-    return PackTensors(compat_tm=compat_tm, it_ok=it_okz_packed != 0,
+    """Expand the packed zone bitfield [G,M,T,Wz] back into the packer's bool
+    views."""
+    word_bits = np.iinfo(it_okz_packed.dtype).bits
+    bits = (it_okz_packed[..., None] >> np.arange(word_bits).astype(
+        it_okz_packed.dtype)) & 1                      # [G,M,T,Wz,word_bits]
+    shape = it_okz_packed.shape[:3] + (-1,)
+    it_ok_z = bits.astype(bool).reshape(shape)[..., :Z]
+    return PackTensors(compat_tm=compat_tm,
+                       it_ok=np.any(it_okz_packed != 0, axis=-1),
                        ppn=ppn.astype(np.int32), it_ok_z=it_ok_z,
                        zone_adm=zone_adm, exist_ok=exist_ok,
                        exist_cap=exist_cap)

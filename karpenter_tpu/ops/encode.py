@@ -211,21 +211,28 @@ def encode_requirements(vocab: Vocab, reqs: Requirements) -> EncodedRequirements
 
 
 def _tail_mask(vocab: Vocab) -> np.ndarray:
-    """[K, W] uint32 mask keeping bits up to each key's OTHER slot; cached on
-    the vocab (valid once frozen; invalidated by key/value growth)."""
+    """[K, W] uint32 mask keeping bits up to each key's OTHER slot. Cached
+    only on a frozen vocab: an unfrozen vocab can grow a key's value count
+    without changing (K, W), which would silently zero the new OTHER bit."""
+    if not vocab._frozen:
+        return _build_tail_mask(vocab)
     cached = getattr(vocab, "_tail_mask", None)
     if cached is not None and cached.shape == (vocab.K, vocab.W):
         return cached
+    mask = _build_tail_mask(vocab)
+    vocab._tail_mask = mask
+    return mask
+
+
+def _build_tail_mask(vocab: Vocab) -> np.ndarray:
     K, W = vocab.K, vocab.W
     ob = np.array([vocab.other_bit(k) for k in range(K)])[:, None]  # [K,1]
     lo = (np.arange(W) * 32)[None, :]                               # [1,W]
     keep = np.clip(ob + 1 - lo, 0, 32)
     full = np.uint32(0xFFFFFFFF)
     safe = np.minimum(keep, 31).astype(np.uint32)  # avoid UB shift by 32
-    mask = np.where(keep >= 32, full,
+    return np.where(keep >= 32, full,
                     (np.uint32(1) << safe) - np.uint32(1)).astype(np.uint32)
-    vocab._tail_mask = mask
-    return mask
 
 
 def _trim_tail_bits(vocab: Vocab, mask: np.ndarray) -> None:

@@ -19,7 +19,7 @@ import numpy as np
 from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim as APINodeClaim, NodeClaimSpec
 from ..api.objects import ObjectMeta, OwnerReference, Pod
-from ..cloudprovider.types import InstanceType, order_by_price
+from ..cloudprovider.types import InstanceType
 from ..ops import binpack
 from ..ops import encode as enc
 from ..scheduling import taints as scheduling_taints
@@ -301,11 +301,12 @@ class TensorScheduler:
                                  vocab, zone_key)
 
     @staticmethod
-    def _cohort_price_order(problem, cohort) -> np.ndarray:
+    def _cohort_price_order(problem, cohort, it_names: np.ndarray) -> np.ndarray:
         """Surviving instance types of a cohort ordered by cheapest admitted
-        offering — the vectorized OrderByPrice (types.go:117-134): an offering
-        counts when available and its zone/captype value is admitted by the
-        cohort's accumulated requirement mask."""
+        offering with name tiebreak — the vectorized OrderByPrice
+        (types.go:117-134): an offering counts when available and its
+        zone/captype value is admitted by the cohort's accumulated
+        requirement mask."""
         t_idx = np.where(cohort.it_set)[0]
         if t_idx.size == 0:
             return t_idx
@@ -323,7 +324,8 @@ class TensorScheduler:
               & admits(problem.zone_key, off_zone)
               & admits(problem.captype_key, off_cap))
         price = np.where(ok, problem.off_price[t_idx], np.inf).min(axis=1)
-        return t_idx[np.argsort(price, kind="stable")]
+        # lexsort: price primary, name tiebreak (types.go:128-130)
+        return t_idx[np.lexsort((it_names[t_idx], price))]
 
     def _materialize(self, pr: binpack.PackResult, problem, groups, templates,
                      catalog, vocab, zone_key) -> Results:
@@ -336,9 +338,11 @@ class TensorScheduler:
             return out
 
         new_claims: List[TensorNodeClaim] = []
+        it_names = np.array([it.name for it in catalog])
         for cohort in pr.cohorts:
             ordered = [catalog[t]
-                       for t in self._cohort_price_order(problem, cohort)]
+                       for t in self._cohort_price_order(problem, cohort,
+                                                         it_names)]
             base_reqs = Requirements(templates[cohort.m].requirements.values())
             for g in cohort.pods_by_group:
                 base_reqs.add(*groups[g].requirements.values())

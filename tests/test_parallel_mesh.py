@@ -57,6 +57,36 @@ def test_sharded_precompute_nondivisible_padding():
     np.testing.assert_array_equal(sharded.it_ok, ref.it_ok)
 
 
+def test_many_zones_bitfield_packing():
+    """Regression: >32 zones must pack losslessly (multi-word bitfield)."""
+    zones = [f"zone-{i:02d}" for i in range(40)]
+    its = [construct_instance_types(zones=zones)[i] for i in range(8)]
+    pool = make_nodepool(name="default")
+    # pin pods to the last zone (index >= 32 in the vocab)
+    pods = make_pods(3, cpu="500m", node_selector={
+        api_labels.LABEL_TOPOLOGY_ZONE: zones[-1]})
+    ts = TensorScheduler([pool], {"default": its})
+    results = ts.solve(pods)
+    assert ts.fallback_reason == ""
+    assert not results.pod_errors, results.pod_errors
+    zone_req = results.new_nodeclaims[0].requirements.get(
+        api_labels.LABEL_TOPOLOGY_ZONE)
+    assert zone_req.has(zones[-1])
+
+
+def test_price_order_name_tiebreak():
+    """Equal-priced instance types order by name (types.go:128-130)."""
+    its = construct_instance_types()[:8]
+    pool = make_nodepool(name="default")
+    pods = make_pods(2, cpu="500m")
+    ts = TensorScheduler([pool], {"default": its})
+    results = ts.solve(pods)
+    assert ts.fallback_reason == ""
+    opts = results.new_nodeclaims[0].instance_type_options
+    keyed = [(min(o.price for o in it.offerings), it.name) for it in opts]
+    assert keyed == sorted(keyed)
+
+
 def test_disjoint_limit_resources_across_pools():
     """Regression: pool A limits only cpu, pool B limits only memory. A's
     absent memory limit must NOT be treated as 0 (nodepool.go Limits
