@@ -27,6 +27,7 @@ from .helpers import build_disruption_budget_mapping, get_candidates
 from .methods import (Drift, Emptiness, Method, MultiNodeConsolidation,
                       SingleNodeConsolidation)
 from .types import Command
+from .validation import CONSOLIDATION_TTL_SECONDS, validate_command
 
 POLL_INTERVAL_SECONDS = 10.0         # controller.go:68
 COMMAND_TIMEOUT_SECONDS = 10 * 60.0  # queue.go commandTimeout
@@ -120,10 +121,15 @@ class DisruptionController(SingletonController):
             SingleNodeConsolidation(cluster, provisioner, spot_to_spot_enabled),
         ]
         self.last_command: Optional[Command] = None
+        # command awaiting the consolidation-TTL re-validation
+        # (validation.go:83-215); (command, computed_at)
+        self.pending: Optional[tuple] = None
 
     def reconcile(self) -> Optional[Result]:
         if not self.cluster.synced():
             return Result(requeue_after=1.0)
+        if self.pending is not None:
+            return self._reconcile_pending()
         for method in self.methods:
             if getattr(method, "is_consolidated", None) and method.is_consolidated():
                 continue
@@ -133,6 +139,19 @@ class DisruptionController(SingletonController):
             if isinstance(method, (MultiNodeConsolidation,
                                    SingleNodeConsolidation)):
                 method.mark_consolidated()
+        return Result(requeue_after=POLL_INTERVAL_SECONDS)
+
+    def _reconcile_pending(self) -> Optional[Result]:
+        cmd, computed_at = self.pending
+        elapsed = self.clock.now() - computed_at
+        if elapsed < CONSOLIDATION_TTL_SECONDS:
+            return Result(
+                requeue_after=CONSOLIDATION_TTL_SECONDS - elapsed)
+        self.pending = None
+        disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
+        if validate_command(self.cluster, self.provisioner, cmd, cmd.reason,
+                            disrupting_provider_ids=disrupting):
+            self._execute(cmd)
         return Result(requeue_after=POLL_INTERVAL_SECONDS)
 
     def _disrupt(self, method: Method) -> bool:
@@ -148,6 +167,11 @@ class DisruptionController(SingletonController):
         cmd, results = method.compute_command(budgets, candidates)
         if cmd.is_empty():
             return False
+        # graceful methods revalidate after the consolidation TTL; eventual
+        # (drift) executes immediately (drift.go has no validation pass)
+        if method.disruption_class == "graceful":
+            self.pending = (cmd, self.clock.now())
+            return True
         self._execute(cmd)
         return True
 

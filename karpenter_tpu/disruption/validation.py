@@ -1,0 +1,85 @@
+"""Command validation after the consolidation TTL.
+
+Mirrors /root/reference/pkg/controllers/disruption/validation.go:83-215: a
+computed command executes only after a 15 s TTL (consolidation.go:44) and
+re-validation: the candidates must still be disruptable, the budgets must
+still admit them, and for replace commands a fresh simulation must produce
+at most one replacement whose instance types are a subset of the original
+options (so the cluster didn't move under the decision).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api.nodepool import NodePool
+from ..provisioning.provisioner import Provisioner
+from ..state.cluster import Cluster
+from .helpers import (build_disruption_budget_mapping, build_pdb_limits,
+                      get_candidates, pods_on_node, simulate_scheduling)
+from .types import Candidate, CandidateError, Command, new_candidate
+
+CONSOLIDATION_TTL_SECONDS = 15.0  # consolidation.go:44
+
+
+def validate_command(cluster: Cluster, provisioner: Provisioner,
+                     command: Command, reason: str,
+                     disrupting_provider_ids=()) -> bool:
+    """validation.go ValidateCandidates + ValidateCommand."""
+    now = cluster.clock.now()
+    nodepools = {np.name: np for np in cluster.store.list(NodePool)}
+    instance_types = {
+        name: {it.name: it
+               for it in provisioner.cloud_provider.get_instance_types(np)}
+        for name, np in nodepools.items()}
+    pdb_limits = build_pdb_limits(cluster)
+
+    fresh: List[Candidate] = []
+    for c in command.candidates:
+        sn = cluster.nodes.get(c.provider_id)
+        if sn is None:
+            return False
+        try:
+            fresh.append(new_candidate(
+                now, sn, pods_on_node(cluster, sn), pdb_limits, nodepools,
+                instance_types, disrupting_provider_ids))
+        except CandidateError:
+            return False
+
+    budgets = build_disruption_budget_mapping(cluster, reason)
+    per_pool: Dict[str, int] = {}
+    for c in fresh:
+        per_pool[c.nodepool_name] = per_pool.get(c.nodepool_name, 0) + 1
+    for pool, n in per_pool.items():
+        if n > budgets.get(pool, 0):
+            return False
+
+    if not command.replacements:
+        # delete-only: candidates must still pack onto the rest of the
+        # cluster with zero new nodes (emptiness: zero reschedulable pods)
+        if all(not c.reschedulable_pods for c in fresh):
+            return True
+        try:
+            results, sim_errors = simulate_scheduling(cluster, provisioner,
+                                                      fresh)
+        except CandidateError:
+            return False
+        return not sim_errors and not results.new_nodeclaims
+
+    # replace: the fresh sim must still want exactly one new node, and the
+    # command's (price-filtered) instance types must be a subset of the fresh
+    # (unfiltered) options — otherwise the cluster moved and the launch could
+    # be as or more expensive (validation.go:155-215)
+    try:
+        results, sim_errors = simulate_scheduling(cluster, provisioner, fresh)
+    except CandidateError:
+        return False
+    if sim_errors:
+        return False
+    if len(results.new_nodeclaims) != 1:
+        return False  # 0 => better option exists now; >1 => never valid
+    command_names = {it.name for r in command.replacements
+                     for it in r.instance_type_options}
+    fresh_names = {it.name
+                   for it in results.new_nodeclaims[0].instance_type_options}
+    return bool(command_names) and command_names.issubset(fresh_names)

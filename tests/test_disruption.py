@@ -60,11 +60,13 @@ def settle(env, rounds=6):
 
 
 def disrupt(env, rounds=8):
-    """One disruption pass plus enough loop rounds to land its fallout."""
+    """One disruption pass plus enough loop rounds to land its fallout
+    (graceful commands wait the 15 s validation TTL before executing)."""
     for _ in range(rounds):
         env.disruption.reconcile()
         env.queue.reconcile()
         settle(env, rounds=2)
+        env.clock.step(8)  # cover the consolidation validation TTL
 
 
 class TestEmptiness:
@@ -191,6 +193,49 @@ class TestConsolidation:
         before = {n.name for n in env.store.list(Node)}
         disrupt(env, rounds=2)
         assert {n.name for n in env.store.list(Node)} == before
+
+
+class TestValidation:
+    def test_stale_empty_command_dropped_when_pod_lands(self, env):
+        """A pod arriving during the 15s validation TTL invalidates the
+        emptiness decision (validation.go candidates re-check)."""
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        env.store.create(pod)
+        settle(env)
+        node = env.store.list(Node)[0]
+        env.store.delete(pod)
+        settle(env)
+        env.clock.step(21)
+        # compute the emptiness command; it is now pending validation
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        # cluster moves: a new pod lands on the candidate before the TTL
+        newpod = make_pod(cpu="500m")
+        newpod.spec.node_name = node.name
+        env.store.create(newpod)
+        env.clock.step(16)
+        env.disruption.reconcile()
+        settle(env, rounds=2)
+        # node survived: command was invalidated, nothing executed
+        assert env.store.get(Node, node.name) is not None
+        assert env.queue.items == []
+
+    def test_empty_command_executes_after_ttl_when_still_valid(self, env):
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        env.store.create(pod)
+        settle(env)
+        env.store.delete(pod)
+        settle(env)
+        env.clock.step(21)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=3)
+        assert env.store.list(Node) == []
 
 
 class TestDrift:
