@@ -1,0 +1,88 @@
+"""Storage objects: the PVC/PV/StorageClass/CSINode fields the volume
+tracking consumes (/root/reference/pkg/scheduling/volumeusage.go and
+provisioning/scheduling/volumetopology.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .objects import NodeSelectorTerm, ObjectMeta
+
+
+@dataclass
+class CSIVolumeSource:
+    driver: str = ""
+
+
+@dataclass
+class PersistentVolumeSpec:
+    csi: Optional[CSIVolumeSource] = None
+    # PV node affinity restricting where the volume attaches (zonal PVs)
+    node_affinity_terms: List[NodeSelectorTerm] = field(default_factory=list)
+    storage_class_name: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PVCSpec:
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""  # bound PV name ("" == unbound)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PVCSpec = field(default_factory=PVCSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class TopologySelector:
+    """StorageClass.allowedTopologies entry: key -> allowed values."""
+    key: str = ""
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    allowed_topologies: List[TopologySelector] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class CSINodeDriver:
+    name: str = ""
+    allocatable_count: Optional[int] = None  # attach limit
+
+
+@dataclass
+class CSINode:
+    """Attach limits per driver on one node (volumeusage.go:187-220)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: List[CSINodeDriver] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

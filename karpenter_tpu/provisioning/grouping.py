@@ -166,6 +166,8 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
     for pod in pods:
         if pod.spec.host_ports:
             return None, "host ports require per-pod conflict tracking"
+        if pod.spec.volumes:
+            return None, "persistent volumes require host-side limit tracking"
         aff = pod.spec.affinity
         sig = (
             tok(pod.spec.node_selector, items_key),

@@ -140,9 +140,20 @@ class Provisioner(SingletonController):
         self.batcher.trigger()
 
     def get_pending_pods(self) -> List[Pod]:
-        return [p for p in self.store.list(Pod)
-                if pod_utils.is_provisionable(p)
-                and f"{p.namespace}/{p.name}" not in self.nominations]
+        """provisioner.go:159-176: provisionable pods minus already-nominated
+        and PVC-invalid ones."""
+        from .volumetopology import validate_persistent_volume_claims
+        out = []
+        for p in self.store.list(Pod):
+            if not pod_utils.is_provisionable(p):
+                continue
+            if f"{p.namespace}/{p.name}" in self.nominations:
+                continue
+            if p.spec.volumes and \
+                    validate_persistent_volume_claims(self.store, p) is not None:
+                continue
+            out.append(p)
+        return out
 
     # -- main loop ----------------------------------------------------------
 
@@ -191,6 +202,9 @@ class Provisioner(SingletonController):
     def schedule_with(self, pods: List[Pod], state_nodes):
         """Solve against an explicit packable-node set; the disruption
         solver's SimulateScheduling entry point (helpers.go:49-113)."""
+        from .volumetopology import inject_volume_topology_requirements
+        pods = [inject_volume_topology_requirements(self.store, p)
+                if p.spec.volumes else p for p in pods]
         nodepools = order_by_weight(self.store.list(NodePool))
         instance_types = {np.name: self.cloud_provider.get_instance_types(np)
                           for np in nodepools}

@@ -184,7 +184,7 @@ class ExistingNode:
     """A live/in-flight node being packed (existingnode.go:31-128)."""
 
     def __init__(self, state_node, topology: Topology, taints: List[Taint],
-                 daemon_resources: dict):
+                 daemon_resources: dict, store=None):
         self.state_node = state_node
         self.cached_available = state_node.available()
         self.cached_taints = taints
@@ -197,6 +197,9 @@ class ExistingNode:
         topology.register(api_labels.LABEL_HOSTNAME, state_node.hostname())
         self.pods: List[Pod] = []
         self._host_port_usage = state_node.host_port_usage().copy()
+        self._store = store
+        vu = getattr(state_node, "volume_usage", None)
+        self._volume_usage = vu().copy() if vu is not None else None
 
     @property
     def name(self):
@@ -213,6 +216,17 @@ class ExistingNode:
         conflicts = self._host_port_usage.conflicts(pod, host_ports)
         if conflicts:
             return f"checking host port usage, {conflicts[0]}"
+        pod_vols = None
+        if self._store is not None and self._volume_usage is not None \
+                and pod.spec.volumes:
+            from ..scheduling.volumeusage import (get_volumes,
+                                                  node_volume_limits)
+            pod_vols = get_volumes(self._store, pod)
+            err = self._volume_usage.exceeds_limits(
+                pod_vols, node_volume_limits(self._store,
+                                             self.state_node.name()))
+            if err is not None:
+                return f"checking volume usage, {err}"
         requests = res.merge(self.requests, pod_requests)
         if not res.fits(requests, self.cached_available):
             return "exceeds node resources"
@@ -238,6 +252,8 @@ class ExistingNode:
         self.requirements = node_requirements
         self.topology.record(pod, node_requirements)
         self._host_port_usage.add(pod, host_ports)
+        if pod_vols and self._volume_usage is not None:
+            self._volume_usage.add(pod_vols)
         return None
 
 
@@ -380,6 +396,7 @@ class Scheduler:
 
     def _calculate_existing_nodes(self, state_nodes) -> None:
         """scheduler.go:317-353."""
+        store = getattr(self.topology.cluster, "store", None)
         for node in state_nodes:
             node_taints = node.taints()
             daemons = []
@@ -391,7 +408,8 @@ class Scheduler:
                 daemons.append(p)
             daemon_requests = res.merge(*(pp.requests() for pp in daemons)) if daemons else {}
             self.existing_nodes.append(
-                ExistingNode(node, self.topology, node_taints, daemon_requests))
+                ExistingNode(node, self.topology, node_taints, daemon_requests,
+                             store=store))
             pool = node.labels().get(api_labels.NODEPOOL_LABEL_KEY)
             if pool in self.remaining_resources:
                 self.remaining_resources[pool] = res.subtract(

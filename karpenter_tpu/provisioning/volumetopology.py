@@ -1,0 +1,80 @@
+"""Volume topology injection: PV/StorageClass zone constraints become pod
+node-affinity before the solve.
+
+Mirrors /root/reference/pkg/controllers/provisioning/scheduling/
+volumetopology.go: for each pod volume, a bound PV's node-affinity terms or
+an unbound PVC's StorageClass allowedTopologies are ANDed into the pod's
+required node affinity (:42-78); ValidatePersistentVolumeClaims rejects pods
+referencing missing PVCs/StorageClasses (:152-199).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+from ..api.objects import (Affinity, NodeAffinity, NodeSelectorRequirement,
+                           NodeSelectorTerm, Pod)
+from ..api.storage import (PersistentVolume, PersistentVolumeClaim,
+                           StorageClass)
+
+
+def _volume_requirements(store, pod: Pod) -> List[NodeSelectorRequirement]:
+    reqs: List[NodeSelectorRequirement] = []
+    for ref in pod.spec.volumes:
+        pvc = store.get(PersistentVolumeClaim, ref.claim_name, pod.namespace)
+        if pvc is None:
+            continue
+        if pvc.spec.volume_name:
+            pv = store.get(PersistentVolume, pvc.spec.volume_name)
+            if pv is not None:
+                for term in pv.spec.node_affinity_terms:
+                    reqs.extend(term.match_expressions)
+        elif pvc.spec.storage_class_name:
+            sc = store.get(StorageClass, pvc.spec.storage_class_name)
+            if sc is not None:
+                for topo in sc.allowed_topologies:
+                    reqs.append(NodeSelectorRequirement(
+                        topo.key, "In", tuple(topo.values)))
+    return reqs
+
+
+def inject_volume_topology_requirements(store, pod: Pod) -> Pod:
+    """volumetopology.go:42-78: AND the volume requirements into every
+    required node-affinity term (returns a copy; the stored pod is not
+    mutated)."""
+    reqs = _volume_requirements(store, pod)
+    if not reqs:
+        return pod
+    pod = copy.deepcopy(pod)
+    aff = pod.spec.affinity
+    if aff is None:
+        aff = Affinity()
+        pod.spec.affinity = aff
+    if aff.node_affinity is None:
+        aff.node_affinity = NodeAffinity()
+    na = aff.node_affinity
+    if not na.required_terms:
+        na.required_terms = [NodeSelectorTerm()]
+    na.required_terms = [
+        NodeSelectorTerm(match_expressions=tuple(term.match_expressions)
+                         + tuple(reqs))
+        for term in na.required_terms]
+    return pod
+
+
+def validate_persistent_volume_claims(store, pod: Pod) -> Optional[str]:
+    """volumetopology.go:152-199: a pod referencing a missing PVC or a PVC
+    with a missing StorageClass can't schedule."""
+    for ref in pod.spec.volumes:
+        pvc = store.get(PersistentVolumeClaim, ref.claim_name, pod.namespace)
+        if pvc is None:
+            return f'pvc "{pod.namespace}/{ref.claim_name}" not found'
+        if pvc.spec.volume_name:
+            if store.get(PersistentVolume, pvc.spec.volume_name) is None:
+                return f'volume "{pvc.spec.volume_name}" not found'
+            continue
+        sc_name = pvc.spec.storage_class_name
+        if sc_name and store.get(StorageClass, sc_name) is None:
+            return f'storageclass "{sc_name}" not found'
+    return None

@@ -143,7 +143,8 @@ class Cluster:
             self.bindings[key] = pod.spec.node_name
             sn = self._node_by_name(pod.spec.node_name)
             if sn is not None:
-                sn.update_pod(pod)
+                from ..scheduling.volumeusage import get_volumes
+                sn.update_pod(pod, get_volumes(self.store, pod))
             self.mark_pod_schedulable(pod)
         elif old_node:
             self._unbind(pod.uid, old_node)

@@ -16,6 +16,7 @@ from ..api.nodeclaim import COND_INITIALIZED, NodeClaim
 from ..api.objects import Node, Pod, Taint
 from ..scheduling.hostports import HostPortUsage, get_host_ports
 from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+from ..scheduling.volumeusage import Volumes, VolumeUsage
 from ..utils import resources as res
 
 
@@ -27,6 +28,8 @@ class StateNode:
         self.pod_limits: Dict[str, dict] = {}
         self.daemonset_pod_requests: Dict[str, dict] = {}
         self._host_port_usage = HostPortUsage()
+        self._volume_usage = VolumeUsage()
+        self.pod_volumes: Dict[str, Volumes] = {}
         self.mark_for_deletion = False
         self.nominated_until: float = 0.0
 
@@ -146,19 +149,31 @@ class StateNode:
 
     # --- pod tracking ------------------------------------------------------
 
-    def update_pod(self, pod: Pod) -> None:
+    def update_pod(self, pod: Pod, volumes: Optional[Volumes] = None) -> None:
         requests = pod.requests()
         self.pod_requests[pod.uid] = requests
         if pod.is_daemonset_pod:
             self.daemonset_pod_requests[pod.uid] = requests
         self._host_port_usage.delete_pod(pod.uid)
         self._host_port_usage.add(pod, get_host_ports(pod))
+        if volumes:
+            old = self.pod_volumes.pop(pod.uid, None)
+            if old:
+                self._volume_usage.delete_pod_volumes(old)
+            self.pod_volumes[pod.uid] = volumes
+            self._volume_usage.add(volumes)
 
     def cleanup_pod(self, pod_uid: str) -> None:
         self.pod_requests.pop(pod_uid, None)
         self.pod_limits.pop(pod_uid, None)
         self.daemonset_pod_requests.pop(pod_uid, None)
         self._host_port_usage.delete_pod(pod_uid)
+        old = self.pod_volumes.pop(pod_uid, None)
+        if old:
+            self._volume_usage.delete_pod_volumes(old)
+
+    def volume_usage(self) -> VolumeUsage:
+        return self._volume_usage
 
     # --- disruption gates --------------------------------------------------
 
@@ -183,6 +198,8 @@ class StateNode:
         out.pod_limits = dict(self.pod_limits)
         out.daemonset_pod_requests = dict(self.daemonset_pod_requests)
         out._host_port_usage = self._host_port_usage.copy()
+        out._volume_usage = self._volume_usage.copy()
+        out.pod_volumes = dict(self.pod_volumes)
         out.mark_for_deletion = self.mark_for_deletion
         out.nominated_until = self.nominated_until
         return out

@@ -1,0 +1,125 @@
+"""Volume topology + CSI attach-limit behavior
+(reference: volumetopology.go + volumeusage.go suites)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (Node, NodeSelectorRequirement,
+                                       NodeSelectorTerm, ObjectMeta, Pod, PVCRef)
+from karpenter_tpu.api.storage import (CSINode, CSINodeDriver, CSIVolumeSource,
+                                       PersistentVolume, PersistentVolumeClaim,
+                                       PersistentVolumeSpec, PVCSpec,
+                                       StorageClass, TopologySelector)
+from karpenter_tpu.cloudprovider.kwok import KWOK_ZONES, KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.mgr, e.provisioner = clock, store, mgr, provisioner
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def make_volume_pod(claim, cpu="500m", **kw):
+    pod = make_pod(cpu=cpu, **kw)
+    pod.spec.volumes.append(PVCRef(claim_name=claim))
+    return pod
+
+
+class TestVolumeTopology:
+    def test_bound_pv_zone_pins_pod(self, env):
+        zone = KWOK_ZONES[2]
+        env.store.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-1", namespace=""),
+            spec=PersistentVolumeSpec(
+                csi=CSIVolumeSource(driver="ebs.csi"),
+                node_affinity_terms=[NodeSelectorTerm(match_expressions=(
+                    NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE,
+                                            "In", (zone,)),))])))
+        env.store.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="pvc-1", namespace="default"),
+            spec=PVCSpec(volume_name="pv-1")))
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_volume_pod("pvc-1"))
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE] == zone
+
+    def test_storageclass_topology_pins_unbound_pvc(self, env):
+        zone = KWOK_ZONES[1]
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="zonal-sc", namespace=""),
+            provisioner="ebs.csi",
+            allowed_topologies=[TopologySelector(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, values=[zone])]))
+        env.store.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="pvc-1", namespace="default"),
+            spec=PVCSpec(storage_class_name="zonal-sc")))
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_volume_pod("pvc-1"))
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE] == zone
+
+    def test_missing_pvc_pod_not_provisioned(self, env):
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_volume_pod("ghost-pvc"))
+        settle(env)
+        assert env.store.list(Node) == []
+
+
+class TestAttachLimits:
+    def test_csi_attach_limit_forces_second_node(self, env):
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""), provisioner="ebs.csi"))
+        for i in range(3):
+            env.store.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"pvc-{i}", namespace="default"),
+                spec=PVCSpec(storage_class_name="sc")))
+        env.store.create(make_nodepool(name="default"))
+        # first pod lands and its node gets a 1-volume attach limit
+        env.store.create(make_volume_pod("pvc-0", cpu="100m"))
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        env.store.create(CSINode(
+            metadata=ObjectMeta(name=nodes[0].name, namespace=""),
+            drivers=[CSINodeDriver(name="ebs.csi", allocatable_count=1)]))
+        # second volume pod can't attach there; a new node appears
+        env.store.create(make_volume_pod("pvc-1", cpu="100m"))
+        settle(env)
+        assert len(env.store.list(Node)) == 2
+        for p in env.store.list(Pod):
+            assert p.spec.node_name
