@@ -1,0 +1,87 @@
+"""Metric-exporter controllers for pods and nodes.
+
+Mirrors /root/reference/pkg/controllers/metrics/{pod,node}/: pod phase
+gauges and scheduling latency histograms (pod/controller.go:208-404), node
+allocatable/used utilization gauges (node/controller.go:162-260). The
+nodepool exporter lives in nodepool_aux.NodePoolCounter.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import labels as api_labels
+from ..api.objects import Node, Pod
+from ..kube.store import Store
+from ..metrics.registry import REGISTRY
+from ..state.cluster import Cluster
+from ..utils.clock import Clock
+from .manager import Controller, Result
+
+POD_STATE = REGISTRY.gauge(
+    "karpenter_pods_state", "Pod count by phase/binding",
+    ("phase", "scheduled"))
+POD_SCHEDULING_DECISION = REGISTRY.histogram(
+    "karpenter_pods_provisioning_scheduling_decision_duration_seconds",
+    "Time from pod ack to scheduling decision")
+POD_BOUND_DURATION = REGISTRY.histogram(
+    "karpenter_pods_bound_duration_seconds",
+    "Time from pod creation to binding")
+NODE_ALLOCATABLE = REGISTRY.gauge(
+    "karpenter_nodes_allocatable", "Node allocatable per resource",
+    ("node_name", "nodepool", "resource_type"))
+NODE_USED = REGISTRY.gauge(
+    "karpenter_nodes_total_pod_requests", "Requested resources per node",
+    ("node_name", "nodepool", "resource_type"))
+
+
+class PodMetrics(Controller):
+    name = "metrics.pod"
+    kinds = (Pod,)
+
+    def __init__(self, store: Store, cluster: Cluster,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock or store.clock
+        self._bound_seen: set = set()
+
+    def reconcile(self, pod: Pod) -> Optional[Result]:
+        self._refresh_state_gauge()
+        key = f"{pod.namespace}/{pod.name}"
+        if pod.spec.node_name and pod.uid not in self._bound_seen:
+            self._bound_seen.add(pod.uid)
+            POD_BOUND_DURATION.observe(
+                self.clock.now() - pod.metadata.creation_timestamp)
+            decided = self.cluster.pod_scheduling_decisions.get(key)
+            acked = self.cluster.pod_acks.get(key)
+            if decided is not None and acked is not None:
+                POD_SCHEDULING_DECISION.observe(max(0.0, decided - acked))
+        return None
+
+    def _refresh_state_gauge(self) -> None:
+        counts: dict = {}
+        for p in self.store.list(Pod):
+            k = (p.status.phase, str(bool(p.spec.node_name)).lower())
+            counts[k] = counts.get(k, 0) + 1
+        for (phase, scheduled), n in counts.items():
+            POD_STATE.set(n, {"phase": phase, "scheduled": scheduled})
+
+
+class NodeMetrics(Controller):
+    name = "metrics.node"
+    kinds = (Node, Pod)
+
+    def __init__(self, store: Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+
+    def reconcile(self, obj) -> Optional[Result]:
+        for sn in self.cluster.state_nodes(deep_copy=False):
+            labels = {"node_name": sn.name(),
+                      "nodepool": sn.nodepool_name()}
+            for rname, v in sn.allocatable().items():
+                NODE_ALLOCATABLE.set(v, {**labels, "resource_type": rname})
+            for rname, v in sn.pod_request_total().items():
+                NODE_USED.set(v, {**labels, "resource_type": rname})
+        return None

@@ -47,16 +47,36 @@ class NodeTermination(Controller):
         if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
             return None
         # delete owning NodeClaims so instance teardown starts in parallel
+        owning = None
         for nc in self.store.list(NodeClaim):
-            if nc.status.node_name == node.name and \
-                    nc.metadata.deletion_timestamp is None:
-                self.store.delete(nc)
+            if nc.status.node_name == node.name:
+                owning = nc
+                if nc.metadata.deletion_timestamp is None:
+                    self.store.delete(nc)
         self._taint(node)
+        self._annotate_termination_time(node, owning)
         remaining = self._drain(node)
         if remaining:
             return Result(requeue_after=1.0)
         self.store.remove_finalizer(node, api_labels.TERMINATION_FINALIZER)
         return None
+
+    def _annotate_termination_time(self, node: Node, nc) -> None:
+        """controller.go: stamp the hard deadline from the claim's
+        terminationGracePeriod so the drain can force-expire."""
+        key = api_labels.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+        if key in node.metadata.annotations:
+            return
+        tgp = nc.spec.termination_grace_period if nc is not None else None
+        if tgp is not None:
+            node.metadata.annotations[key] = str(
+                node.metadata.deletion_timestamp + tgp)
+            self.store.update(node)
+
+    def _termination_time(self, node: Node) -> Optional[float]:
+        raw = node.metadata.annotations.get(
+            api_labels.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY)
+        return float(raw) if raw else None
 
     def _taint(self, node: Node) -> None:
         if not any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
@@ -67,8 +87,30 @@ class NodeTermination(Controller):
         return self.store.list(Pod, predicate=lambda p: p.spec.node_name == node.name)
 
     def _drain(self, node: Node) -> int:
-        """Evict in priority groups; returns evictable pods still bound."""
+        """Evict in priority groups; returns evictable pods still bound.
+
+        PDB-blocked and do-not-disrupt pods are retried (the Eviction API's
+        429 path, terminator/eviction.go) until the TerminationGracePeriod
+        deadline, after which everything is force-deleted
+        (terminator.go:140-177)."""
+        now = self.clock.now()
+        term_time = self._termination_time(node)
+        expired = term_time is not None and now >= term_time
         pods = [p for p in self._pods_on(node) if pod_utils.is_evictable(p)]
+
+        # TGP preemptive deletes: pods whose own grace period no longer fits
+        # before the node deadline start terminating immediately
+        if term_time is not None and not expired:
+            for p in list(pods):
+                grace = p.spec.termination_grace_period_seconds or 0
+                if now + grace >= term_time:
+                    self._force_delete(p)
+                    pods.remove(p)
+
+        from ..utils.pdb import Limits
+        from ..api.policy import PodDisruptionBudget
+        limits = Limits(self.store.list(PodDisruptionBudget),
+                        self.store.list(Pod))
         groups = ([p for p in pods if not self._critical(p) and not p.is_daemonset_pod],
                   [p for p in pods if not self._critical(p) and p.is_daemonset_pod],
                   [p for p in pods if self._critical(p) and not p.is_daemonset_pod],
@@ -77,10 +119,26 @@ class NodeTermination(Controller):
             if not group:
                 continue
             for p in group:
+                if expired:
+                    self._force_delete(p)
+                    continue
+                if not pod_utils.is_disruptable(p):
+                    continue  # do-not-disrupt: wait for the TGP deadline
+                ok, _ = limits.can_evict(p)
+                if not ok:
+                    continue  # PDB 429: retry next pass
                 self._evict(p)
             # one priority group per pass (terminator.go:119-138)
             break
         return len([p for p in self._pods_on(node) if pod_utils.is_evictable(p)])
+
+    def _force_delete(self, pod: Pod) -> None:
+        if pod_utils.is_reschedulable(pod):
+            pod.spec.node_name = ""
+            pod.status.nominated_node_name = ""
+            self.store.update(pod)
+        else:
+            self.store.delete(pod)
 
     def _critical(self, pod: Pod) -> bool:
         return (pod.spec.priority or 0) >= CRITICAL_PRIORITY or \
@@ -88,9 +146,6 @@ class NodeTermination(Controller):
                                              "system-node-critical")
 
     def _evict(self, pod: Pod) -> None:
-        if pod_utils.is_reschedulable(pod):
-            pod.spec.node_name = ""
-            pod.status.nominated_node_name = ""
-            self.store.update(pod)
-        else:
-            self.store.delete(pod)
+        # mechanically identical to force-delete in the standalone runtime;
+        # the distinction is the caller's gates (PDB / do-not-disrupt)
+        self._force_delete(pod)

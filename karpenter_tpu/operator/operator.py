@@ -14,6 +14,7 @@ from typing import Optional
 
 from ..cloudprovider.kwok import KwokCloudProvider
 from ..controllers.manager import Manager
+from ..controllers.metrics_exporters import NodeMetrics, PodMetrics
 from ..controllers.node_health import NodeHealth
 from ..controllers.node_termination import NodeTermination
 from ..controllers.nodeclaim_aux import (Consistency, Expiration,
@@ -85,6 +86,8 @@ class Operator:
             NodePoolCounter(self.store, self.cluster),
             NodePoolValidation(self.store),
             NodePoolReadiness(self.store, self.cloud_provider),
+            PodMetrics(self.store, self.cluster, self.clock),
+            NodeMetrics(self.store, self.cluster),
         ]
         if gates.node_repair:
             controllers.append(NodeHealth(self.store, self.cluster,

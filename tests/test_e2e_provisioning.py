@@ -107,6 +107,52 @@ class TestProvisioningE2E:
         for p in env.store.list(Pod):
             assert p.spec.node_name in live_nodes
 
+    def test_pdb_blocks_drain_until_removed(self, env):
+        from karpenter_tpu.api.objects import LabelSelector, ObjectMeta
+        from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m", labels={"app": "guarded"})
+        env.store.create(pod)
+        settle(env)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "guarded"}),
+                         max_unavailable="0")))
+        node = env.store.list(Node)[0]
+        env.store.delete(node)
+        settle(env, rounds=3)
+        # drain is blocked: node still present, pod still bound there
+        live = env.store.get(Node, node.name)
+        assert live is not None
+        assert env.store.get(Pod, pod.name, pod.namespace).spec.node_name \
+            == node.name
+        # removing the PDB unblocks the drain
+        env.store.delete(env.store.get(
+            PodDisruptionBudget, "pdb", "default"))
+        settle(env, rounds=4)
+        assert env.store.get(Node, node.name) is None
+
+    def test_termination_grace_period_forces_drain(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.template.spec.termination_grace_period = 60.0
+        env.store.create(pool)
+        pod = make_pod(cpu="500m")
+        pod.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.create(pod)
+        settle(env)
+        node = env.store.list(Node)[0]
+        env.store.delete(node)
+        settle(env, rounds=2)
+        # do-not-disrupt blocks the graceful drain
+        assert env.store.get(Node, node.name) is not None
+        env.clock.step(61)  # past the TGP deadline
+        settle(env, rounds=4)
+        assert env.store.get(Node, node.name) is None
+        # pod rescheduled onto replacement capacity
+        live = env.store.get(Pod, pod.name, pod.namespace)
+        assert live is not None and live.spec.node_name
+
     def test_existing_capacity_reused(self, env):
         env.store.create(make_nodepool(name="default"))
         env.store.create(make_pod(cpu="100m", memory="64Mi"))

@@ -86,6 +86,8 @@ class TestOperator:
         text = op.metrics_text()
         assert "karpenter_nodeclaims_created_total" in text
         assert "karpenter_provisioner_scheduling_duration_seconds_count" in text
+        assert "karpenter_pods_bound_duration_seconds" in text
+        assert "karpenter_nodes_allocatable" in text
 
 
 class TestNodeRepair:
