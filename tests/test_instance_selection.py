@@ -1,0 +1,173 @@
+"""Instance selection, minValues, Gt/Lt, and relaxation behaviors
+(reference shapes: instance_selection_test.go + suite_test.go scenarios)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.cloudprovider.kwok import (construct_instance_types,
+                                              make_instance_type, price_for)
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.scheduling.requirement import GT, IN, LT, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+
+from factories import (make_nodepool, make_pod, make_pods, make_scheduler,
+                       spread_zone)
+
+
+class _MinValuesReq:
+    def __init__(self, key, operator, values, min_values):
+        self.key = key
+        self.operator = operator
+        self.values = tuple(values)
+        self.min_values = min_values
+
+
+class TestInstanceSelection:
+    def test_cheapest_type_heads_launch_list(self):
+        its = construct_instance_types()[:48]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        assert not r.pod_errors
+        opts = r.new_nodeclaims[0].instance_type_options
+        prices = [min(o.price for o in it.offerings) for it in opts]
+        assert prices[0] == min(prices)
+
+    def test_on_demand_selector_excludes_spot_pricing(self):
+        its = construct_instance_types()[:24]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m", node_selector={
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND})])
+        assert not r.pod_errors
+        reqs = r.new_nodeclaims[0].requirements
+        ct = reqs.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        assert ct.has(api_labels.CAPACITY_TYPE_ON_DEMAND)
+        assert not ct.has(api_labels.CAPACITY_TYPE_SPOT)
+
+    def test_gt_requirement_on_numeric_label(self):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("company.io/generation", "Gt", ("3",))])
+        its = []
+        for gen in (2, 4):
+            it = make_instance_type(4, 2, api_labels.ARCHITECTURE_AMD64, "linux")
+            it.name = f"gen{gen}-4x"
+            it.requirements.add(Requirement(api_labels.LABEL_INSTANCE_TYPE,
+                                            IN, [it.name]))
+            it.requirements.add(Requirement("company.io/generation", IN,
+                                            [str(gen)]))
+            its.append(it)
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        assert not r.pod_errors
+        names = {it.name for it in r.new_nodeclaims[0].instance_type_options}
+        assert names == {"gen4-4x"}
+
+    def test_lt_requirement(self):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("company.io/generation", "Lt", ("3",))])
+        its = []
+        for gen in (2, 4):
+            it = make_instance_type(4, 2, api_labels.ARCHITECTURE_AMD64, "linux")
+            it.name = f"gen{gen}-4x"
+            it.requirements.add(Requirement(api_labels.LABEL_INSTANCE_TYPE,
+                                            IN, [it.name]))
+            it.requirements.add(Requirement("company.io/generation", IN,
+                                            [str(gen)]))
+            its.append(it)
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        assert not r.pod_errors
+        names = {it.name for it in r.new_nodeclaims[0].instance_type_options}
+        assert names == {"gen2-4x"}
+
+    def test_min_values_keeps_flexibility(self):
+        """NodeSelectorRequirementWithMinValues: launch list must retain >= N
+        distinct instance types (nodeclaim.go SatisfiesMinValues)."""
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (), 5)])
+        its = construct_instance_types()[:48]
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        assert not r.pod_errors
+        nc = r.new_nodeclaims[0]
+        assert len(nc.instance_type_options) >= 5
+        r.truncate_instance_types(10)
+        assert len(r.new_nodeclaims[0].instance_type_options) <= 10
+        assert len(r.new_nodeclaims[0].instance_type_options) >= 5
+
+    def test_min_values_unsatisfiable_errors(self):
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (), 500)])
+        its = construct_instance_types()[:24]
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        assert r.pod_errors
+
+
+class TestRelaxation:
+    def test_preferred_zone_honored_when_possible(self):
+        its = construct_instance_types()[:24]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m", preferred_affinity=[
+            (1, [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE,
+                                         "In", ("test-zone-b",))])])])
+        assert not r.pod_errors
+        zone = r.new_nodeclaims[0].requirements.get(
+            api_labels.LABEL_TOPOLOGY_ZONE)
+        assert zone.has("test-zone-b") and zone.values_list() == ["test-zone-b"]
+
+    def test_impossible_preferred_zone_relaxed(self):
+        its = construct_instance_types()[:24]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m", preferred_affinity=[
+            (1, [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE,
+                                         "In", ("zone-on-the-moon",))])])])
+        assert not r.pod_errors
+
+    def test_schedule_anyway_spread_relaxes(self):
+        from karpenter_tpu.api.objects import (LabelSelector,
+                                               TopologySpreadConstraint)
+        its = construct_instance_types()[:24]
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE, "In",
+                                    ("test-zone-a",))])
+        s = make_scheduler([pool], its, [])
+        # spread over zones is impossible with one zone; ScheduleAnyway lets
+        # all pods land in zone-a
+        pods = make_pods(4, cpu="500m", labels={"app": "x"}, spread=[
+            TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                label_selector=LabelSelector(match_labels={"app": "x"}),
+                when_unsatisfiable="ScheduleAnyway")])
+        r = s.solve(pods)
+        assert not r.pod_errors
+
+
+class TestExistingNodeOrder:
+    def test_initialized_nodes_fill_first(self):
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+        from karpenter_tpu.state.statenode import StateNode
+        from karpenter_tpu.utils import resources as res
+
+        def node(name, initialized):
+            labels = {api_labels.LABEL_HOSTNAME: name,
+                      api_labels.NODEPOOL_LABEL_KEY: "default"}
+            if initialized:
+                labels[api_labels.NODE_INITIALIZED_LABEL_KEY] = "true"
+            alloc = res.parse_list({"cpu": "4", "memory": "8Gi", "pods": "110"})
+            return StateNode(node=Node(
+                metadata=ObjectMeta(name=name, namespace="", labels=labels),
+                spec=NodeSpec(provider_id=f"t://{name}"),
+                status=NodeStatus(capacity=dict(alloc), allocatable=alloc)))
+
+        uninit = node("a-uninit", False)
+        init = node("b-init", True)
+        its = construct_instance_types()[:24]
+        s = make_scheduler([make_nodepool()], its, [],
+                           state_nodes=[uninit, init])
+        r = s.solve([make_pod(cpu="500m")])
+        assert not r.pod_errors
+        placed = [en for en in r.existing_nodes if en.pods]
+        assert [en.name for en in placed] == ["b-init"]
