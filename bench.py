@@ -38,6 +38,8 @@ N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
 N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+MODE = os.environ.get("BENCH_MODE", "provisioning")  # provisioning|consolidation
+N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 
 _CPUS = ["50m", "100m", "250m", "500m", "1000m"]
 _MEMS = ["64Mi", "128Mi", "256Mi", "512Mi", "1Gi"]
@@ -95,7 +97,105 @@ def _scheduler():
     return TensorScheduler([nodepool], {"default": _catalog()})
 
 
+def bench_consolidation():
+    """BASELINE config #4: multi-node consolidation over N_NODES
+    underutilized nodes. Builds a live cluster (kwok), then times one
+    MultiNodeConsolidation.compute_command pass (cost sort + budget trim +
+    100-candidate binary-search prefix simulation, multinodeconsolidation.go
+    :79-162). Reference bound: <=100 candidates / 1-minute timeout."""
+    from karpenter_tpu.api import labels as api_labels
+    from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_INITIALIZED,
+                                             COND_LAUNCHED, COND_REGISTERED,
+                                             NodeClaim, NodeClaimSpec)
+    from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                           ObjectMeta, PodSpec)
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.disruption.helpers import (
+        build_disruption_budget_mapping, get_candidates)
+    from karpenter_tpu.disruption.methods import MultiNodeConsolidation
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.provisioning.provisioner import Provisioner
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    catalog = _catalog()
+    provider = KwokCloudProvider(instance_types=catalog, store=store)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    pool = NodePool(metadata=ObjectMeta(name="default"),
+                    spec=NodePoolSpec(template=NodeClaimTemplate(
+                        spec=NodeClaimTemplateSpec())))
+    store.create(pool)
+    big = next(it for it in catalog
+               if it.capacity.get("cpu") == 4000
+               and "amd64-linux" in it.name)
+    # fabricate N underutilized 4-cpu nodes, one 200m pod each
+    for i in range(N_NODES):
+        name = f"bench-node-{i:05d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: big.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a",
+            api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"bench-nc-{i:05d}",
+                                           namespace="", labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"bench://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED,
+                     COND_CONSOLIDATABLE):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"bench://{i}"),
+            status=NodeStatus(capacity=dict(big.capacity),
+                              allocatable=big.allocatable())))
+        pod = Pod(metadata=ObjectMeta(name=f"bench-pod-{i}",
+                                      namespace="default"),
+                  spec=PodSpec(node_name=name),
+                  container_requests=[res.parse_list(
+                      {"cpu": "200m", "memory": "128Mi"})])
+        store.create(pod)
+
+    method = MultiNodeConsolidation(cluster, provisioner)
+
+    def one_pass():
+        candidates = get_candidates(cluster, provisioner, method.should_disrupt)
+        budgets = {"default": N_NODES}  # lift the budget: measure the search
+        cmd, _ = method.compute_command(budgets, candidates)
+        return candidates, cmd
+
+    candidates, cmd = one_pass()  # warmup: populate the jit cache
+    assert len(candidates) == N_NODES, len(candidates)
+    assert cmd.candidates, "no consolidation decision found"
+    best = float("inf")
+    for _ in range(max(1, REPEATS - 1)):
+        t0 = time.perf_counter()
+        one_pass()
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": (f"multi-node consolidation decision, {N_NODES} "
+                   f"underutilized nodes x {len(catalog)} instance types"),
+        "value": round(best, 3),
+        "unit": "seconds",
+        # reference bound: 60 s timeout for the batched search
+        "vs_baseline": round(60.0 / best, 2),
+    }))
+
+
 def main():
+    if MODE == "consolidation":
+        bench_consolidation()
+        return
     pods = _pods()
     # warmup: populate the jit cache at the exact shapes of the timed run
     ts = _scheduler()

@@ -22,6 +22,16 @@ from ..utils.pdb import Limits
 from .types import Candidate, CandidateError, new_candidate
 
 
+def pods_by_node(cluster: Cluster) -> Dict[str, List[Pod]]:
+    """One store pass -> node name -> active pods (avoids the O(nodes x pods)
+    scan the per-node lookup would cost at 5k nodes)."""
+    out: Dict[str, List[Pod]] = {}
+    for p in cluster.store.list(Pod):
+        if p.spec.node_name and pod_utils.is_active(p):
+            out.setdefault(p.spec.node_name, []).append(p)
+    return out
+
+
 def pods_on_node(cluster: Cluster, sn) -> List[Pod]:
     from ..api.objects import Pod as PodKind
     return cluster.store.list(
@@ -46,10 +56,12 @@ def get_candidates(cluster: Cluster, provisioner: Provisioner,
                for it in provisioner.cloud_provider.get_instance_types(np)}
         for name, np in nodepools.items()}
     pdb_limits = build_pdb_limits(cluster)
+    by_node = pods_by_node(cluster)
     out: List[Candidate] = []
-    for sn in cluster.state_nodes():
+    # no deep copy here: new_candidate deep-copies the accepted nodes
+    for sn in cluster.state_nodes(deep_copy=False):
         try:
-            cand = new_candidate(now, sn, pods_on_node(cluster, sn),
+            cand = new_candidate(now, sn, by_node.get(sn.name(), []),
                                  pdb_limits, nodepools, instance_types,
                                  disrupting_provider_ids, disruption_class)
         except CandidateError:
@@ -88,7 +100,11 @@ def simulate_scheduling(cluster: Cluster, provisioner: Provisioner,
         sn = cluster.nodes.get(c.provider_id)
         if sn is None or sn.deleting():
             raise CandidateError("candidate is deleting")
-    state_nodes = [sn for sn in cluster.state_nodes()
+    # read-only view: the solve never mutates StateNodes and the dispatch
+    # loop is single-threaded, so the reference's defensive deep copy
+    # (cluster.go:188-195) is unnecessary here — it costs O(nodes) per
+    # consolidation probe
+    state_nodes = [sn for sn in cluster.state_nodes(deep_copy=False)
                    if not sn.deleting() and sn.provider_id not in candidate_ids]
     pods = provisioner.get_pending_pods()
     # pods already being rescheduled from deleting nodes ride along

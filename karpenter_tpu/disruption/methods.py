@@ -157,6 +157,11 @@ class consolidation(Method):
                 self.cluster, self.provisioner, candidates)
         except CandidateError:
             return Command(reason=self.reason), None
+        return self.decide(candidates, results, sim_errors)
+
+    def decide(self, candidates: List[Candidate], results, sim_errors
+               ) -> Tuple[Command, object]:
+        """The post-simulation decision (consolidation.go:144-222)."""
         if sim_errors:
             return Command(reason=self.reason), None
         if not results.new_nodeclaims:
@@ -228,28 +233,41 @@ class MultiNodeConsolidation(consolidation):
 
     The reference binary-searches the largest prefix of cost-sorted candidates
     replaceable by ≤1 node, paying a full scheduling simulation per probe
-    (O(log N) sims, each rebuilding scheduler state). Here every probe's
-    simulation runs on the tensor path where the feasibility precompute is
-    jit-cached across probes — the prefixes share pod groups and catalog, so
-    successive probes hit the same compiled program and the search is
-    dominated by one device program + cheap host greedy replays. Same
-    decision, amortized device work.
+    (O(log N) sims, each rebuilding scheduler state). Here the probes share
+    ONE device feasibility program (disruption/prefix.py PrefixSimulator):
+    prefixes differ only in which nodes are excluded and which pods are
+    pending — host-side packer inputs — so the search costs one precompute
+    plus O(log N) host greedy replays. Same decision, amortized device work;
+    batches the kernel can't express fall back to per-probe simulation.
     """
 
     consolidation_type = "multi"
 
     def compute_command(self, budgets, candidates):
+        from .prefix import PrefixFallback, PrefixSimulator
         candidates = sorted(candidates, key=lambda c: c.disruption_cost)
         candidates = _within_budget(budgets, candidates)
         candidates = candidates[:MULTI_NODE_CONSOLIDATION_CANDIDATES]
         if not candidates:
+            return Command(reason=self.reason), None
+        sim = None
+        try:
+            sim = PrefixSimulator(self.cluster, self.provisioner, candidates)
+        except PrefixFallback:
+            pass
+        except CandidateError:
             return Command(reason=self.reason), None
         # binary search on prefix size (multinodeconsolidation.go:110-162)
         lo, hi = 1, len(candidates)
         best: Tuple[Command, object] = (Command(reason=self.reason), None)
         while lo <= hi:
             mid = (lo + hi) // 2
-            cmd, results = self.compute_consolidation(candidates[:mid])
+            if sim is not None:
+                results, sim_errors = sim.simulate(mid)
+                cmd, results = self.decide(candidates[:mid], results,
+                                           sim_errors)
+            else:
+                cmd, results = self.compute_consolidation(candidates[:mid])
             if cmd.is_empty():
                 hi = mid - 1
                 continue

@@ -1,0 +1,157 @@
+"""Shared-precompute prefix simulation for multi-node consolidation.
+
+The reference's binary search (multinodeconsolidation.go:110-162) pays a
+full scheduling simulation per probe — scheduler construction, per-pod
+refiltering, the works. The TPU design runs ONE device feasibility program
+covering every candidate's pods and every packable node, then evaluates each
+prefix with a host-greedy replay over shared tensors:
+
+- the feasibility tensors depend on group *signatures* and the node batch,
+  both identical across prefixes — only the pod *counts* per group and the
+  excluded-node set vary, and those live entirely on the host side of the
+  packer;
+- excluding candidates[0:mid] = dropping their indices from the packer's
+  existing-node order; marking their pods pending = restricting each group's
+  pod list to the prefix.
+
+Net: O(log N) probes cost one device program + O(log N) host replays instead
+of O(log N) full simulations (SURVEY.md §7 layer 7).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..api.nodepool import NodePool, order_by_weight
+from ..ops import binpack
+from ..provisioning.grouping import PodGroup, group_pods
+from ..provisioning.provisioner import Provisioner, StateClusterView
+from ..provisioning.tensor_scheduler import TensorScheduler, _FallbackError
+from ..state.cluster import Cluster
+from ..utils import pod as pod_utils
+from .types import Candidate, CandidateError
+
+
+class PrefixFallback(Exception):
+    """Batch not expressible in the tensor kernel: probe-per-sim instead."""
+
+
+class PrefixSimulator:
+    def __init__(self, cluster: Cluster, provisioner: Provisioner,
+                 candidates: List[Candidate]):
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.candidates = candidates
+        for c in candidates:
+            sn = cluster.nodes.get(c.provider_id)
+            if sn is None or sn.deleting():
+                raise CandidateError("candidate is deleting")
+
+        base_pods = provisioner.get_pending_pods()
+        from .helpers import pods_by_node
+        by_node = pods_by_node(cluster)
+        for sn in cluster.deleting_nodes():
+            for p in by_node.get(sn.name(), []):
+                if pod_utils.is_reschedulable(p):
+                    base_pods.append(p)
+        self.base_uids: Set[str] = {p.uid for p in base_pods}
+        self.pod_uids_by_candidate = [
+            {p.uid for p in c.reschedulable_pods} for c in candidates]
+        sim_pods = [p for c in candidates for p in c.reschedulable_pods]
+        all_pods = base_pods + sim_pods
+
+        nodepools = order_by_weight(cluster.store.list(NodePool))
+        instance_types = {
+            np_.name: provisioner.cloud_provider.get_instance_types(np_)
+            for np_ in nodepools}
+        nodepools = [np_ for np_ in nodepools if instance_types.get(np_.name)]
+        state_nodes = [sn for sn in cluster.state_nodes(deep_copy=False)
+                       if not sn.deleting()]
+        self.ts = TensorScheduler(
+            nodepools, instance_types, state_nodes=state_nodes,
+            daemonset_pods=cluster.daemonset_pod_list(),
+            cluster=StateClusterView(cluster.store, cluster))
+
+        groups, reason = group_pods(all_pods)
+        if groups is None:
+            raise PrefixFallback(reason)
+        if any(g.has_relaxable for g in groups):
+            # relaxation interplay is host-path territory
+            raise PrefixFallback("relaxable preferences in batch")
+        self.groups = groups
+        try:
+            self.problem, self.templates, self.catalog = \
+                self.ts.build_problem(groups)
+        except _FallbackError as e:
+            raise PrefixFallback(str(e))
+        self.tensors = binpack.precompute(self.problem)
+        self.node_index = {sn.name(): i
+                           for i, sn in enumerate(self.ts.state_nodes)}
+
+    # -- per-probe host replay ---------------------------------------------
+
+    def simulate(self, prefix_len: int):
+        """Evaluate candidates[:prefix_len]; returns (results, sim_errors)
+        like helpers.simulate_scheduling."""
+        prefix = self.candidates[:prefix_len]
+        allowed: Set[str] = set(self.base_uids)
+        excluded_nodes: Set[str] = set()
+        for i, c in enumerate(prefix):
+            allowed |= self.pod_uids_by_candidate[i]
+            excluded_nodes.add(c.state_node.name())
+
+        probe_groups: List[PodGroup] = []
+        for g in self.groups:
+            pods = [p for p in g.pods if p.uid in allowed]
+            probe_groups.append(PodGroup(
+                pods=pods, requirements=g.requirements, requests=g.requests,
+                tolerations=g.tolerations, labels=g.labels, topo=g.topo,
+                has_relaxable=g.has_relaxable))
+
+        exist_order = [
+            i for i in sorted(
+                range(len(self.ts.state_nodes)),
+                key=lambda i: (not self.ts.state_nodes[i].initialized(),
+                               self.ts.state_nodes[i].name()))
+            if self.ts.state_nodes[i].name() not in excluded_nodes]
+
+        limits, limit_resources = self._limits(excluded_nodes)
+        Z = len(self.problem.zone_values)
+        izc = np.zeros((len(probe_groups), Z), dtype=np.int64)
+        packer = binpack.Packer(self.problem, self.tensors, probe_groups,
+                                limits, limit_resources,
+                                initial_zone_counts=izc,
+                                exist_order=exist_order)
+        pr = packer.pack()
+        results = self.ts._materialize(
+            pr, self.problem, probe_groups, self.templates, self.catalog,
+            self.problem.vocab, self.problem.zone_key)
+        sim_uids = allowed - self.base_uids
+        sim_errors = {uid: e for uid, e in results.pod_errors.items()
+                      if uid in sim_uids}
+        return results, sim_errors
+
+    def _limits(self, excluded_nodes: Set[str]):
+        from ..api import labels as api_labels
+        from ..ops import encode as enc
+        from ..utils import resources as res
+        limits: List[Optional[dict]] = []
+        for nct in self.templates:
+            np_obj = next(p for p in self.ts.nodepools
+                          if p.name == nct.nodepool_name)
+            if not np_obj.spec.limits:
+                limits.append(None)
+                continue
+            rem = dict(np_obj.spec.limits)
+            for sn in self.ts.state_nodes:
+                if sn.name() in excluded_nodes:
+                    continue
+                if sn.labels().get(api_labels.NODEPOOL_LABEL_KEY) == \
+                        nct.nodepool_name:
+                    rem = res.subtract(rem, sn.capacity())
+            limits.append({k: enc.scale_capacity(k, v)
+                           for k, v in rem.items()})
+        limit_resources = sorted({k for lm in limits if lm for k in lm})
+        return limits, limit_resources

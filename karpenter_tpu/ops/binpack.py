@@ -579,6 +579,8 @@ class Packer:
     def _pack_group(self, g: int) -> None:
         group = self.groups[g]
         c = group.count
+        if c == 0:
+            return
         topo = group.topo[0] if group.topo else None
         kind = topo.kind if topo else "none"
 

@@ -112,8 +112,12 @@ class Vocab:
         for name in rl:
             self.add_resource(name)
 
-    def freeze(self) -> None:
+    def freeze(self, domain_bucket: Optional[int] = None) -> None:
+        """domain_bucket rounds the mask domain width up to a multiple, so
+        solves whose value counts differ only within a bucket share jit
+        shapes (SURVEY.md §7 'bucketed padding and recompile management')."""
         self._frozen = True
+        self._domain_bucket = domain_bucket
 
     @property
     def K(self) -> int:
@@ -122,7 +126,11 @@ class Vocab:
     @property
     def D(self) -> int:
         """Padded per-key domain width including the OTHER slot."""
-        return (max((len(v) for v in self.values), default=0)) + 1
+        d = (max((len(v) for v in self.values), default=0)) + 1
+        bucket = getattr(self, "_domain_bucket", None)
+        if bucket:
+            d = -(-d // bucket) * bucket
+        return d
 
     @property
     def W(self) -> int:

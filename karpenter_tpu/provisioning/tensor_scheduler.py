@@ -35,6 +35,14 @@ from .topology import ClusterView, Topology
 _name_seq = itertools.count(1)
 
 
+def _pow2_bucket(n: int, minimum: int) -> int:
+    """Next power of two >= max(n, minimum): bounded distinct jit shapes."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
 class TensorNodeClaim:
     """A launch decision produced by the tensor packer; interface-compatible
     with provisioning.scheduler.InFlightNodeClaim for downstream consumers."""
@@ -171,10 +179,25 @@ class TensorScheduler:
         for g in groups:
             vocab.observe_requirements(g.requirements)
             vocab.observe_resources(g.requests)
+        # Existing nodes only contribute VALUES for keys some group/template/
+        # instance type already defines. A key defined solely by nodes (e.g.
+        # kubernetes.io/hostname with one distinct value per node) can never
+        # fail a compatibility check — the checked set is
+        # a.defined & b.defined, and undefined-key violations only fire for
+        # pod-side-defined keys (requirements.go:175-187) — so admitting it
+        # would just blow the mask domain up to O(nodes) for nothing.
         for sn in self.state_nodes:
-            vocab.observe_requirements(label_requirements(sn.labels()))
+            reqs = label_requirements(sn.labels())
+            for key in reqs:
+                norm = api_labels.NORMALIZED_LABELS.get(key, key)
+                if norm in vocab.key_idx:
+                    for v in reqs.get(key).values:
+                        vocab.add_value(norm, v)
             vocab.observe_resources(sn.allocatable())
-        vocab.freeze()
+        # power-of-two domain bucket: consolidation's prefix probes vary the
+        # value counts per simulation; bucketing keeps mask shapes (and so
+        # the jit cache) stable across probes
+        vocab.freeze(domain_bucket=_pow2_bucket(vocab.D, 64))
 
         group_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, g.requirements) for g in groups])
@@ -234,7 +257,11 @@ class TensorScheduler:
             tol_exist = np.zeros((G, len(self.state_nodes)), dtype=bool)
             for i, sn in enumerate(self.state_nodes):
                 reqs = label_requirements(sn.labels())
-                encs.append(enc.encode_requirements(vocab, reqs))
+                known = Requirements(
+                    r for r in reqs.values()
+                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
+                    in vocab.key_idx)
+                encs.append(enc.encode_requirements(vocab, known))
                 node_daemons = _node_remaining_daemons(sn, templates, self.daemonset_pods)
                 avail = res.subtract(sn.available(), node_daemons)
                 avails.append(enc.encode_resource_vector(vocab, avail, capacity=True))
@@ -246,6 +273,22 @@ class TensorScheduler:
             exist_enc = enc.stack_encoded(encs)
             exist_avail = np.stack(avails)
             exist_zone = np.array(zones, dtype=np.int32)
+            # bucket the node-batch axis: padded rows have undefined masks and
+            # zero capacity, so they are never packable (exist_cap < 1)
+            N = len(self.state_nodes)
+            Np = _pow2_bucket(N, 16)
+            if Np > N:
+                pad = Np - N
+                zero = enc.encode_requirements(vocab, Requirements())
+                exist_enc = enc.stack_encoded(
+                    encs + [zero] * pad)
+                exist_avail = np.concatenate(
+                    [exist_avail, np.zeros((pad,) + exist_avail.shape[1:],
+                                           exist_avail.dtype)])
+                exist_zone = np.concatenate(
+                    [exist_zone, np.full(pad, -1, np.int32)])
+                tol_exist = np.concatenate(
+                    [tol_exist, np.zeros((G, pad), bool)], axis=1)
 
         problem = binpack.PackProblem(
             vocab=vocab, group_enc=group_enc, group_req=group_req,

@@ -195,6 +195,42 @@ class TestConsolidation:
         assert {n.name for n in env.store.list(Node)} == before
 
 
+class TestPrefixSimulator:
+    def test_prefix_sim_matches_full_simulation(self, env):
+        """PrefixSimulator must reproduce simulate_scheduling's results for
+        every prefix length."""
+        from karpenter_tpu.disruption.helpers import (get_candidates,
+                                                      simulate_scheduling)
+        from karpenter_tpu.disruption.prefix import PrefixSimulator
+        od = {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND}
+        env.store.create(make_nodepool(name="default"))
+        for i in range(3):
+            env.store.create(make_pod(cpu="2500m", node_selector=od,
+                                      name=f"b-{i}"))
+            env.store.create(make_pod(cpu="1000m", node_selector=od,
+                                      name=f"s-{i}"))
+            settle(env)
+        for i in range(3):
+            env.store.delete(env.store.get(Pod, f"b-{i}", "default"))
+        settle(env)
+        env.clock.step(21)
+        method = env.disruption.methods[2]  # multi-node
+        candidates = get_candidates(env.cluster, env.provisioner,
+                                    method.should_disrupt)
+        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
+        assert len(candidates) == 3
+        sim = PrefixSimulator(env.cluster, env.provisioner, candidates)
+        for mid in (1, 2, 3):
+            fast, fast_err = sim.simulate(mid)
+            slow, slow_err = simulate_scheduling(env.cluster, env.provisioner,
+                                                 candidates[:mid])
+            assert len(fast.new_nodeclaims) == len(slow.new_nodeclaims), mid
+            assert fast_err == slow_err, mid
+            fast_fill = sorted(len(nc.pods) for nc in fast.new_nodeclaims)
+            slow_fill = sorted(len(nc.pods) for nc in slow.new_nodeclaims)
+            assert fast_fill == slow_fill, mid
+
+
 class TestValidation:
     def test_stale_empty_command_dropped_when_pod_lands(self, env):
         """A pod arriving during the 15s validation TTL invalidates the
