@@ -13,6 +13,7 @@ import time
 from typing import Optional
 
 from ..cloudprovider.kwok import KwokCloudProvider
+from ..controllers.hydration import NodeClaimHydration, NodeHydration
 from ..controllers.manager import Manager
 from ..controllers.metrics_exporters import NodeMetrics, PodMetrics
 from ..controllers.node_health import NodeHealth
@@ -88,7 +89,11 @@ class Operator:
             NodePoolReadiness(self.store, self.cloud_provider),
             PodMetrics(self.store, self.cluster, self.clock),
             NodeMetrics(self.store, self.cluster),
+            NodeClaimHydration(self.store),
+            NodeHydration(self.store),
         ]
+        if self.options.enable_profiling:
+            self.provisioner.profile_dir = "/tmp/karpenter-tpu-profile"
         if gates.node_repair:
             controllers.append(NodeHealth(self.store, self.cluster,
                                           self.cloud_provider, self.clock))

@@ -133,6 +133,9 @@ class Provisioner(SingletonController):
         # pod key -> nodeclaim name, consumed by the Binder
         self.nominations: Dict[str, str] = {}
         self.last_results = None
+        # --enable-profiling analog (operator.go:159-175): jax profiler trace
+        # captured around each solve when set
+        self.profile_dir: Optional[str] = None
 
     # -- trigger path (provisioning/controller.go:38-119) -------------------
 
@@ -179,7 +182,12 @@ class Provisioner(SingletonController):
                     deleting_pods.append(p)
         from ..metrics import registry as metrics
         done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
-        results = self.schedule(pods + deleting_pods)
+        if self.profile_dir:
+            import jax
+            with jax.profiler.trace(self.profile_dir):
+                results = self.schedule(pods + deleting_pods)
+        else:
+            results = self.schedule(pods + deleting_pods)
         done()
         metrics.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
         self.last_results = results
