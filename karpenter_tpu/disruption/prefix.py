@@ -86,7 +86,7 @@ class PrefixSimulator:
                 self.ts.build_problem(groups)
         except _FallbackError as e:
             raise PrefixFallback(str(e))
-        self.tensors = binpack.precompute(self.problem)
+        self.tensors = self.ts.precompute(self.problem)
         self.node_index = {sn.name(): i
                            for i, sn in enumerate(self.ts.state_nodes)}
 

@@ -104,7 +104,8 @@ class TensorScheduler:
     def __init__(self, nodepools, instance_types: Dict[str, List[InstanceType]],
                  state_nodes=(), daemonset_pods: List[Pod] = (),
                  cluster: Optional[ClusterView] = None,
-                 initial_zone_counts=None, force_tensor: bool = False):
+                 initial_zone_counts=None, force_tensor: bool = False,
+                 mesh=None):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -112,6 +113,9 @@ class TensorScheduler:
         self.cluster = cluster or ClusterView()
         self.initial_zone_counts = initial_zone_counts  # callable (group, zones)->counts
         self.force_tensor = force_tensor
+        # optional jax.sharding.Mesh: run the feasibility precompute sharded
+        # over a multi-chip mesh (parallel/mesh.py) instead of single-device
+        self.mesh = mesh
         self.fallback_reason: str = ""
 
     # -- public -------------------------------------------------------------
@@ -140,6 +144,15 @@ class TensorScheduler:
         return host.solve(pods)
 
     # -- tensor path ----------------------------------------------------------
+
+    def precompute(self, problem) -> binpack.PackTensors:
+        """Device feasibility precompute, sharded over self.mesh when set.
+        Shared by the provisioning solve and the consolidation prefix
+        simulator (disruption/prefix.py), so one mesh knob scales both."""
+        if self.mesh is not None:
+            from ..parallel.mesh import sharded_precompute
+            return sharded_precompute(problem, self.mesh)
+        return binpack.precompute(problem)
 
     def build_problem(self, groups: List[PodGroup]):
         """Encode groups + catalog + state into a PackProblem; returns
@@ -309,7 +322,7 @@ class TensorScheduler:
         vocab = problem.vocab
         zone_key = problem.zone_key
 
-        tensors = binpack.precompute(problem)
+        tensors = self.precompute(problem)
 
         # nodepool limits (scaled), minus existing node capacity per pool
         limits: List[Optional[dict]] = []
