@@ -139,5 +139,9 @@ class Consistency(Controller):
             node_taints = {(t.key, t.effect) for t in node.spec.taints}
             for t in nc.spec.taints:
                 if (t.key, t.effect) not in node_taints:
-                    continue  # taint present: consistent
+                    self.recorder.publish(Event(
+                        object_kind="NodeClaim", object_name=nc.name,
+                        type="Warning", reason="FailedConsistencyCheck",
+                        message=f"expected taint \"{t.key}:{t.effect}\" "
+                                "didn't register on the node"))
         return None

@@ -117,8 +117,10 @@ class DisruptionController(SingletonController):
         self.methods: List[Method] = [
             Drift(cluster, provisioner),
             Emptiness(cluster, provisioner),
-            MultiNodeConsolidation(cluster, provisioner, spot_to_spot_enabled),
-            SingleNodeConsolidation(cluster, provisioner, spot_to_spot_enabled),
+            MultiNodeConsolidation(cluster, provisioner, spot_to_spot_enabled,
+                                   clock=self.clock),
+            SingleNodeConsolidation(cluster, provisioner, spot_to_spot_enabled,
+                                    clock=self.clock),
         ]
         self.last_command: Optional[Command] = None
         # command awaiting the consolidation-TTL re-validation
@@ -133,12 +135,11 @@ class DisruptionController(SingletonController):
         for method in self.methods:
             if getattr(method, "is_consolidated", None) and method.is_consolidated():
                 continue
+            # consolidation methods self-memoize inside compute_command
+            # (skipped when budget-constrained — consolidation.go:89-96)
             executed = self._disrupt(method)
             if executed:
                 return Result(requeue_after=POLL_INTERVAL_SECONDS)
-            if isinstance(method, (MultiNodeConsolidation,
-                                   SingleNodeConsolidation)):
-                method.mark_consolidated()
         return Result(requeue_after=POLL_INTERVAL_SECONDS)
 
     def _reconcile_pending(self) -> Optional[Result]:
@@ -156,15 +157,23 @@ class DisruptionController(SingletonController):
 
     def _disrupt(self, method: Method) -> bool:
         """controller.go:155-190."""
+        from ..metrics import registry as metrics
         disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
         candidates = get_candidates(
             self.cluster, self.provisioner, method.should_disrupt,
             disrupting_provider_ids=disrupting,
             disruption_class=method.disruption_class)
+        metrics.DISRUPTION_ELIGIBLE_NODES.set(
+            len(candidates), {"reason": method.reason})
         if not candidates:
             return False
         budgets = build_disruption_budget_mapping(self.cluster, method.reason)
+        started = self.clock.now()
         cmd, results = method.compute_command(budgets, candidates)
+        metrics.DISRUPTION_EVAL_DURATION.observe(
+            self.clock.now() - started,
+            {"method": getattr(method, "consolidation_type", "") or
+             method.reason})
         if cmd.is_empty():
             return False
         # graceful methods revalidate after the consolidation TTL; eventual

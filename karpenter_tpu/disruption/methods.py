@@ -24,6 +24,8 @@ from .types import Candidate, CandidateError, Command
 
 MULTI_NODE_CONSOLIDATION_CANDIDATES = 100   # multinodeconsolidation.go:35
 MIN_SPOT_TO_SPOT_INSTANCE_TYPES = 15        # consolidation.go:47
+MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0     # multinodeconsolidation.go:35
+SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0   # singlenodeconsolidation.go:30
 
 
 class Method:
@@ -120,16 +122,56 @@ class Drift(Method):
         return Command(reason=self.reason), None
 
 
+def filter_out_same_type(replacement, candidates: List[Candidate]):
+    """multinodeconsolidation.go:180-217: when the replacement's instance-type
+    options include a type currently being deleted, drop every option at or
+    above the cheapest such type's current price. Replacing [2xlarge, 2xlarge,
+    small] with one `small` is really just deleting the two 2xlarges — the
+    consolidation must be rejected (or constrained to strictly cheaper types).
+    Returns the surviving instance-type options (possibly empty)."""
+    from ..scheduling.requirements import label_requirements
+
+    existing_types = set()
+    price_by_type: Dict[str, float] = {}
+    for c in candidates:
+        if c.instance_type is None:
+            continue
+        existing_types.add(c.instance_type.name)
+        offs = c.instance_type.offerings.compatible(
+            label_requirements(c.state_node.labels()))
+        if not offs:
+            continue
+        p = offs.cheapest().price
+        if p < price_by_type.get(c.instance_type.name, float("inf")):
+            price_by_type[c.instance_type.name] = p
+
+    max_price = float("inf")
+    for it in replacement.instance_type_options:
+        if it.name in existing_types and \
+                price_by_type.get(it.name, float("inf")) < max_price:
+            max_price = price_by_type[it.name]
+    filtered, err = replacement.remove_instance_types_by_price_and_min_values(
+        replacement.requirements, max_price)
+    if err is not None or filtered is None:
+        return []
+    return filtered.instance_type_options
+
+
 class consolidation(Method):
     """consolidation.go:77-302 shared base."""
 
     reason = REASON_UNDERUTILIZED
 
     def __init__(self, cluster: Cluster, provisioner,
-                 spot_to_spot_enabled: bool = False):
+                 spot_to_spot_enabled: bool = False, clock=None):
         self.cluster = cluster
         self.provisioner = provisioner
         self.spot_to_spot_enabled = spot_to_spot_enabled
+        self.clock = clock or cluster.clock
+        # per-method memoized cluster token (consolidation.go:60): each
+        # method tracks the last cluster state IT found nothing in, so one
+        # method marking consolidated never suppresses the others
+        self._last_state: Optional[float] = None
 
     def should_disrupt(self, c: Candidate) -> bool:
         if c.nodepool.spec.disruption.consolidation_policy != \
@@ -141,12 +183,35 @@ class consolidation(Method):
         return nc is not None and nc.conditions.is_true(COND_CONSOLIDATABLE)
 
     def is_consolidated(self) -> bool:
-        """Memoization off the cluster consolidation token
-        (consolidation.go:77-84)."""
-        return self.cluster.consolidation_state() != 0.0
+        """True when nothing changed since this method last found nothing
+        (consolidation.go:76-79)."""
+        return self._last_state is not None and \
+            self._last_state == self.cluster.consolidation_state()
 
     def mark_consolidated(self) -> None:
-        self.cluster.mark_consolidated()
+        """Record (not set) the cluster token (consolidation.go:81-84)."""
+        self._last_state = self.cluster.consolidation_state()
+
+    def _filter_disruptable(self, budgets: Dict[str, int],
+                            candidates: List[Candidate]):
+        """The shared pre-filter (multinodeconsolidation.go:59-77,
+        singlenodeconsolidation.go:55-68): drop candidates whose nodepool
+        budget is exhausted (order-preserving, decrementing as we go) and
+        empty candidates (an empty node here means Emptiness was budget-
+        blocked; consolidating it would bypass the `empty` budget). Returns
+        (disruptable, constrained_by_budgets)."""
+        remaining = dict(budgets)
+        out: List[Candidate] = []
+        constrained = False
+        for c in candidates:
+            if remaining.get(c.nodepool_name, 0) <= 0:
+                constrained = True
+                continue
+            if not c.reschedulable_pods:
+                continue
+            remaining[c.nodepool_name] -= 1
+            out.append(c)
+        return out, constrained
 
     # -- core decision (consolidation.go:131-222) ---------------------------
 
@@ -244,11 +309,25 @@ class MultiNodeConsolidation(consolidation):
     consolidation_type = "multi"
 
     def compute_command(self, budgets, candidates):
-        from .prefix import PrefixFallback, PrefixSimulator
         candidates = sorted(candidates, key=lambda c: c.disruption_cost)
-        candidates = _within_budget(budgets, candidates)
+        candidates, constrained = self._filter_disruptable(budgets, candidates)
         candidates = candidates[:MULTI_NODE_CONSOLIDATION_CANDIDATES]
-        if not candidates:
+        cmd, results = self._first_n_consolidation_option(candidates)
+        if cmd.is_empty() and not constrained:
+            # budget-blocked candidates may free up next pass: only memoize
+            # a genuine nothing-to-do (multinodeconsolidation.go:89-96)
+            self.mark_consolidated()
+        return cmd, results
+
+    def _first_n_consolidation_option(self, candidates: List[Candidate]
+                                      ) -> Tuple[Command, object]:
+        """multinodeconsolidation.go:110-162 with shared-precompute probes."""
+        from ..metrics import registry as metrics
+        from .prefix import PrefixFallback, PrefixSimulator
+
+        # single candidates are SingleNodeConsolidation's job: always operate
+        # on >= 2 at once (multinodeconsolidation.go:111-115)
+        if len(candidates) < 2:
             return Command(reason=self.reason), None
         sim = None
         try:
@@ -257,10 +336,19 @@ class MultiNodeConsolidation(consolidation):
             pass
         except CandidateError:
             return Command(reason=self.reason), None
-        # binary search on prefix size (multinodeconsolidation.go:110-162)
-        lo, hi = 1, len(candidates)
+        deadline = self.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
+        # binary search on prefix size (multinodeconsolidation.go:110-162);
+        # floor of 2 per the >= 2 rule above
+        lo, hi = 2, len(candidates)
         best: Tuple[Command, object] = (Command(reason=self.reason), None)
         while lo <= hi:
+            if self.clock.now() > deadline:
+                # the shared-precompute probes are fast, but inexpressible
+                # batches fall back to full per-probe simulation — bound it
+                # (multinodeconsolidation.go:123-135)
+                metrics.CONSOLIDATION_TIMEOUTS.inc(
+                    {"consolidation_type": self.consolidation_type})
+                return best
             mid = (lo + hi) // 2
             if sim is not None:
                 results, sim_errors = sim.simulate(mid)
@@ -268,28 +356,62 @@ class MultiNodeConsolidation(consolidation):
                                            sim_errors)
             else:
                 cmd, results = self.compute_consolidation(candidates[:mid])
+            if not cmd.is_empty() and cmd.replacements:
+                # a replacement whose type is already being deleted must be
+                # strictly cheaper, else this "replace" is a worse "delete"
+                cmd.replacements[0].instance_type_options = \
+                    filter_out_same_type(cmd.replacements[0],
+                                         candidates[:mid])
+                if not cmd.replacements[0].instance_type_options:
+                    cmd = Command(reason=self.reason)
             if cmd.is_empty():
                 hi = mid - 1
                 continue
-            # accept only if strictly cheaper than what the prefix costs now
             best = (cmd, results)
             lo = mid + 1
         return best
 
-    def should_disrupt(self, c: Candidate) -> bool:
-        return super().should_disrupt(c)
-
 
 class SingleNodeConsolidation(consolidation):
-    """singlenodeconsolidation.go:44-101: linear scan, first win."""
+    """singlenodeconsolidation.go:44-101: linear scan, first win, 3-min
+    timeout. Candidates are interleaved round-robin across nodepools (each
+    pool's own candidates stay cost-ordered) so that when the timeout fires,
+    every nodepool got a fair share of the evaluation window instead of the
+    cheapest pool starving the rest."""
 
     consolidation_type = "single"
 
+    @staticmethod
+    def _fair_order(candidates: List[Candidate]) -> List[Candidate]:
+        by_pool: Dict[str, List[Candidate]] = {}
+        for c in sorted(candidates, key=lambda c: c.disruption_cost):
+            by_pool.setdefault(c.nodepool_name, []).append(c)
+        # pools ordered by their cheapest candidate; then round-robin
+        pools = sorted(by_pool.values(), key=lambda cs: cs[0].disruption_cost)
+        out: List[Candidate] = []
+        for i in range(max((len(cs) for cs in pools), default=0)):
+            out.extend(cs[i] for cs in pools if i < len(cs))
+        return out
+
     def compute_command(self, budgets, candidates):
-        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
-        candidates = _within_budget(budgets, candidates)
-        for c in candidates:
+        from ..metrics import registry as metrics
+        remaining = dict(budgets)
+        deadline = self.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        constrained = False
+        for c in self._fair_order(candidates):
+            if remaining.get(c.nodepool_name, 0) <= 0:
+                constrained = True
+                continue
+            if not c.reschedulable_pods:
+                # empty nodes are Emptiness' (budget-gated) job
+                continue
+            if self.clock.now() > deadline:
+                metrics.CONSOLIDATION_TIMEOUTS.inc(
+                    {"consolidation_type": self.consolidation_type})
+                return Command(reason=self.reason), None
             cmd, results = self.compute_consolidation([c])
             if not cmd.is_empty():
                 return cmd, results
+        if not constrained:
+            self.mark_consolidated()
         return Command(reason=self.reason), None

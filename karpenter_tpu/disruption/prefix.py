@@ -22,8 +22,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
-import numpy as np
-
 from ..api.nodepool import NodePool, order_by_weight
 from ..ops import binpack
 from ..provisioning.grouping import PodGroup, group_pods
@@ -89,6 +87,17 @@ class PrefixSimulator:
         self.tensors = self.ts.precompute(self.problem)
         self.node_index = {sn.name(): i
                            for i, sn in enumerate(self.ts.state_nodes)}
+        self.zone_names = self.problem.vocab.values[self.problem.zone_key]
+        # conservative coupling check: any scheduled cluster pod (including
+        # candidates' own pods, which stay scheduled in short prefixes)
+        # matching a host-kind/anti topology selector means host-path
+        # semantics; exclude only the base pending set so every probe's
+        # countable superset is covered
+        try:
+            self.ts.cluster_zone_counts(groups, self.zone_names,
+                                        self.base_uids)
+        except _FallbackError as e:
+            raise PrefixFallback(str(e))
 
     # -- per-probe host replay ---------------------------------------------
 
@@ -118,8 +127,11 @@ class PrefixSimulator:
             if self.ts.state_nodes[i].name() not in excluded_nodes]
 
         limits, limit_resources = self._limits(excluded_nodes)
-        Z = len(self.problem.zone_values)
-        izc = np.zeros((len(probe_groups), Z), dtype=np.int64)
+        # per-probe zone occupancy: cluster pods matching each group's
+        # topology selector that are NOT pending in this probe still count
+        # (non-prefix candidates' pods among them) — host countDomains parity
+        izc = self.ts.cluster_zone_counts(probe_groups, self.zone_names,
+                                          allowed)
         packer = binpack.Packer(self.problem, self.tensors, probe_groups,
                                 limits, limit_resources,
                                 initial_zone_counts=izc,

@@ -196,6 +196,10 @@ DISRUPTION_DECISIONS = REGISTRY.counter(
 DISRUPTION_ELIGIBLE_NODES = REGISTRY.gauge(
     "karpenter_voluntary_disruption_eligible_nodes",
     "Nodes eligible for disruption", ("reason",))
+CONSOLIDATION_TIMEOUTS = REGISTRY.counter(
+    "karpenter_voluntary_disruption_consolidation_timeouts_total",
+    "Consolidation searches abandoned at their timeout",
+    ("consolidation_type",))
 NODEPOOL_USAGE = REGISTRY.gauge(
     "karpenter_nodepools_usage", "In-use resources per nodepool",
     ("nodepool", "resource_type"))

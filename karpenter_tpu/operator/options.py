@@ -83,8 +83,12 @@ def parse_options(argv: Optional[List[str]] = None) -> Options:
             else:
                 default = env
         if isinstance(default, bool):
-            parser.add_argument(flag, action="store_true" if not default
-                                else "store_false", dest=f.name)
+            # --flag / --no-flag always mean what they say; env only moves
+            # the default (a store_false flip would make e.g.
+            # KARPENTER_ENABLE_PROFILING=true + --enable-profiling DISABLE
+            # profiling)
+            parser.add_argument(flag, action=argparse.BooleanOptionalAction,
+                                default=default, dest=f.name)
         else:
             parser.add_argument(flag, type=type(default), default=default,
                                 dest=f.name)

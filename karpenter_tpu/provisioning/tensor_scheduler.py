@@ -316,6 +316,59 @@ class TensorScheduler:
             tol_exist=tol_exist, allow_undefined=allow_undefined)
         return problem, templates, catalog
 
+    def _group_selector(self, g: PodGroup):
+        """The (single) self-selecting topology selector of a group, from its
+        probe pod (grouping enforces <= 1 topology constraint per group)."""
+        probe = g.pods[0]
+        for tsc in probe.spec.topology_spread_constraints:
+            return tsc.label_selector
+        aff = probe.spec.affinity
+        if aff is not None:
+            for pa in (aff.pod_affinity, aff.pod_anti_affinity):
+                if pa is not None and pa.required:
+                    return pa.required[0].label_selector
+        return None
+
+    def cluster_zone_counts(self, groups: List[PodGroup], zone_names,
+                            exclude_uids) -> np.ndarray:
+        """The tensor twin of Topology countDomains (topology.go:268-321):
+        initial per-zone occupancy from scheduled cluster pods matching each
+        group's topology selector, excluding the batch itself. Zone-spread
+        and zone-affinity groups consume these counts directly; hostname or
+        anti-affinity groups coupled to live cluster pods are host-path
+        territory (per-node/per-conflict state) and raise _FallbackError."""
+        from .grouping import AFFINITY_ZONE, SPREAD_ZONE
+        from .topology import TopologyNodeFilter, ignored_for_topology
+
+        zone_idx = {z: i for i, z in enumerate(zone_names)}
+        izc = np.zeros((len(groups), len(zone_names)), dtype=np.int64)
+        for gi, g in enumerate(groups):
+            # prefix probes can empty a group (all its pods belong to
+            # non-prefix candidates); nothing pending means nothing to place
+            if not g.topo or not g.pods:
+                continue
+            sel = self._group_selector(g)
+            if sel is None:
+                continue
+            probe = g.pods[0]
+            node_filter = TopologyNodeFilter.for_pod(probe)
+            matched = False
+            for p in self.cluster.list_pods(probe.namespace, sel):
+                if p.uid in exclude_uids or ignored_for_topology(p):
+                    continue
+                labels = self.cluster.node_labels(p.spec.node_name)
+                if labels is None or not node_filter.matches_labels(labels):
+                    continue
+                matched = True
+                zone = labels.get(api_labels.LABEL_TOPOLOGY_ZONE)
+                if zone in zone_idx:
+                    izc[gi, zone_idx[zone]] += 1
+            if matched and g.topo[0].kind not in (SPREAD_ZONE, AFFINITY_ZONE):
+                raise _FallbackError(
+                    f"scheduled cluster pods couple to {g.topo[0].kind} "
+                    "topology")
+        return izc
+
     def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
         self.fallback_reason = ""
         problem, templates, catalog = self.build_problem(groups)
@@ -339,13 +392,19 @@ class TensorScheduler:
         limit_resources = sorted({k for lm in limits if lm for k in lm})
 
         Z = len(problem.zone_values)
-        izc = np.zeros((len(groups), Z), dtype=np.int64)
+        zone_names = vocab.values[zone_key]
         if self.initial_zone_counts is not None:
-            zone_names = vocab.values[zone_key]
+            izc = np.zeros((len(groups), Z), dtype=np.int64)
             for gi, g in enumerate(groups):
                 counts = self.initial_zone_counts(g, zone_names)
                 for z, cnt in enumerate(counts):
                     izc[gi, z] = cnt
+        else:
+            # default: count scheduled cluster pods matching each group's
+            # topology selector so a deployment scale-up spreads against its
+            # existing replicas exactly like the host path does
+            izc = self.cluster_zone_counts(
+                groups, zone_names, {p.uid for p in pods})
 
         sn_order = sorted(range(len(self.state_nodes)),
                           key=lambda i: (not self.state_nodes[i].initialized(),

@@ -88,13 +88,18 @@ class RemoteScheduler:
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
         self.daemonset_pods = list(daemonset_pods)
+        # topology's cluster view: serialized into every request so the
+        # server counts existing spread/anti-affinity domain occupancy the
+        # same way an in-process solve would (topology.go:268-321)
+        self.cluster = cluster
         self.fallback_reason = ""
         self._channel = channel or grpc.insecure_channel(address)
 
     def solve(self, pods: List[Pod]) -> RemoteResults:
         request = codec.encode_solve_request(
             self.nodepools, self.instance_types, pods,
-            state_nodes=self.state_nodes, daemonset_pods=self.daemonset_pods)
+            state_nodes=self.state_nodes, daemonset_pods=self.daemonset_pods,
+            cluster=self.cluster)
         call = self._channel.unary_unary(
             f"/{SERVICE}/Solve",
             request_serializer=None, response_deserializer=None)

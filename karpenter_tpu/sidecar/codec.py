@@ -190,6 +190,7 @@ def pod_to_dict(p: Pod) -> dict:
         "host_ports": [{"port": hp.port, "protocol": hp.protocol,
                         "host_ip": hp.host_ip} for hp in p.spec.host_ports],
         "priority": p.spec.priority,
+        "node_name": p.spec.node_name,
         "requests": [dict(r) for r in p.container_requests],
         "init_requests": [dict(r) for r in p.init_container_requests],
         "daemonset": p.is_daemonset_pod,
@@ -216,7 +217,8 @@ def pod_from_dict(d: dict) -> Pod:
             host_ports=[HostPort(port=hp["port"], protocol=hp["protocol"],
                                  host_ip=hp["host_ip"])
                         for hp in d["host_ports"]],
-            priority=d["priority"]),
+            priority=d["priority"],
+            node_name=d.get("node_name", "")),
         container_requests=[dict(r) for r in d["requests"]],
         init_container_requests=[dict(r) for r in d["init_requests"]],
         is_daemonset_pod=d["daemonset"])
@@ -417,8 +419,79 @@ def api_nodeclaim_from_dict(d: dict) -> NodeClaim:
 # -- request / response -----------------------------------------------------
 
 
+def cluster_view_to_dict(cluster, pods) -> dict:
+    """Topology-relevant snapshot of the live cluster for the wire
+    (topology.go countDomains inputs): scheduled cluster pods matching any
+    (namespace, selector) pair referenced by the batch's spread/affinity
+    constraints, every scheduled pod with required anti-affinity, and the
+    labels of the nodes hosting them. WireClusterView rebuilds the
+    ClusterView contract from this server-side, so sidecar solves count
+    existing domain occupancy exactly like in-process ones."""
+    pairs = []  # (namespace, selector)
+    for p in pods:
+        for tsc in p.spec.topology_spread_constraints:
+            pairs.append((p.namespace, tsc.label_selector))
+        aff = p.spec.affinity
+        if aff is None:
+            continue
+        terms = []
+        for pa in (aff.pod_affinity, aff.pod_anti_affinity):
+            if pa is not None:
+                terms += list(pa.required)
+                terms += [wt.term for wt in pa.preferred]
+        for term in terms:
+            for ns in (set(term.namespaces) or {p.namespace}):
+                pairs.append((ns, term.label_selector))
+    snapshot: Dict[str, object] = {}
+    for ns, sel in pairs:
+        if sel is None:
+            continue
+        for cp in cluster.list_pods(ns, sel):
+            snapshot[cp.uid] = cp
+    anti_uids = []
+    for cp, _labels in cluster.for_pods_with_anti_affinity():
+        snapshot[cp.uid] = cp
+        anti_uids.append(cp.uid)
+    node_labels: Dict[str, dict] = {}
+    for cp in snapshot.values():
+        nn = cp.spec.node_name
+        if nn and nn not in node_labels:
+            labels = cluster.node_labels(nn)
+            if labels is not None:
+                node_labels[nn] = dict(labels)
+    return {"pods": [pod_to_dict(cp) for cp in snapshot.values()],
+            "anti_affinity_uids": anti_uids,
+            "node_labels": node_labels}
+
+
+class WireClusterView:
+    """provisioning.topology.ClusterView over a cluster_view_to_dict
+    snapshot."""
+
+    def __init__(self, d: Optional[dict]):
+        d = d or {"pods": [], "anti_affinity_uids": [], "node_labels": {}}
+        self._pods = [pod_from_dict(p) for p in d["pods"]]
+        self._anti = set(d["anti_affinity_uids"])
+        self._node_labels = {n: dict(l) for n, l in d["node_labels"].items()}
+
+    def list_pods(self, namespace: str, selector):
+        return [p for p in self._pods
+                if p.namespace == namespace and selector.matches(p.labels)]
+
+    def node_labels(self, node_name: str):
+        return self._node_labels.get(node_name)
+
+    def for_pods_with_anti_affinity(self):
+        for p in self._pods:
+            if p.uid in self._anti:
+                labels = self._node_labels.get(p.spec.node_name)
+                if labels is not None:
+                    yield p, labels
+
+
 def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]],
-                         pods, state_nodes=(), daemonset_pods=()) -> bytes:
+                         pods, state_nodes=(), daemonset_pods=(),
+                         cluster=None) -> bytes:
     catalog: Dict[str, dict] = {}
     per_pool: Dict[str, List[str]] = {}
     for pool, its in instance_types.items():
@@ -433,6 +506,8 @@ def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]
         "pods": [pod_to_dict(p) for p in pods],
         "state_nodes": [state_node_to_dict(sn) for sn in state_nodes],
         "daemonset_pods": [pod_to_dict(p) for p in daemonset_pods],
+        "cluster": (cluster_view_to_dict(cluster, pods)
+                    if cluster is not None else None),
     }
     return json.dumps(payload).encode()
 
@@ -448,6 +523,7 @@ def decode_solve_request(data: bytes):
         [pod_from_dict(p) for p in d["pods"]],
         [WireStateNode(sn) for sn in d["state_nodes"]],
         [pod_from_dict(p) for p in d["daemonset_pods"]],
+        WireClusterView(d.get("cluster")),
     )
 
 

@@ -25,10 +25,10 @@ SERVICE = "karpenter.v1.Solver"
 
 
 def _solve(request: bytes, context=None) -> bytes:
-    nodepools, instance_types, pods, state_nodes, daemonset_pods = \
+    nodepools, instance_types, pods, state_nodes, daemonset_pods, cluster = \
         codec.decode_solve_request(request)
     ts = TensorScheduler(nodepools, instance_types, state_nodes=state_nodes,
-                         daemonset_pods=daemonset_pods)
+                         daemonset_pods=daemonset_pods, cluster=cluster)
     results = ts.solve(pods)
     return codec.encode_solve_response(results, ts.fallback_reason)
 

@@ -45,7 +45,9 @@ class Cluster:
         self.pod_to_nominated_node: Dict[str, str] = {}
         self._anti_affinity_pods: Dict[str, Pod] = {}  # pod key -> pod
         self._unsynced_start: Optional[float] = None
-        self._consolidated_at: float = 0.0             # 0 == unconsolidated
+        # timestamp of the last consolidation-relevant cluster change
+        # (cluster.go clusterState); methods memoize it per-method
+        self._cluster_state: float = 0.0
 
     # -- sync ---------------------------------------------------------------
 
@@ -242,19 +244,21 @@ class Cluster:
         self.pod_to_nominated_node[_pod_key(pod)] = node_name
 
     def consolidation_state(self) -> float:
-        """Monotonic timestamp token; 0 while unconsolidated. Forced
-        revalidation after 5 min (cluster.go:397-423)."""
-        if self._consolidated_at and \
-                self.clock.since(self._consolidated_at) > CONSOLIDATION_TIMEOUT_SECONDS:
-            self._consolidated_at = 0.0
-        return self._consolidated_at
+        """Timestamp of the last time the cluster changed with respect to
+        consolidation. Consolidation methods memoize this token per-method
+        and skip work while it's unchanged; after 5 minutes of no change the
+        token is force-bumped so watchers revalidate against external drift
+        (e.g. instance-type availability) we can't observe
+        (cluster.go:404-423)."""
+        if self.clock.since(self._cluster_state) < CONSOLIDATION_TIMEOUT_SECONDS:
+            return self._cluster_state
+        return self.mark_unconsolidated()
 
-    def mark_consolidated(self) -> float:
-        self._consolidated_at = self.clock.now()
-        return self._consolidated_at
-
-    def mark_unconsolidated(self) -> None:
-        self._consolidated_at = 0.0
+    def mark_unconsolidated(self) -> float:
+        """Called on any change that could make the cluster consolidatable
+        (cluster.go:394-403)."""
+        self._cluster_state = self.clock.now()
+        return self._cluster_state
 
     # -- views --------------------------------------------------------------
 

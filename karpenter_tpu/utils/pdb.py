@@ -49,7 +49,8 @@ class Limits:
         return expected
 
     def can_evict(self, pod: Pod) -> Tuple[bool, Optional[PodDisruptionBudget]]:
-        """pdb.go CanEvictPods: blocked when a matching PDB has no headroom.
+        """pdb.go CanEvictPods: blocked when ANY matching PDB has no headroom
+        (pdb.go:56-86) — a pod covered by several PDBs must clear all of them.
         Fully-blocking PDBs (maxUnavailable 0/0%) block even unhealthy pods."""
         for pdb in self.pdbs:
             if pdb.namespace != pod.namespace:
@@ -59,5 +60,4 @@ class Limits:
                 continue
             if self.disruptions_allowed(pdb) <= 0:
                 return False, pdb
-            return True, None
         return True, None

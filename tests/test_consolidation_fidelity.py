@@ -1,0 +1,256 @@
+"""Consolidation-method fidelity: filterOutSameType, timeouts, the >= 2
+candidate floor, per-method consolidation memoization, single-node nodepool
+fairness, and multi-PDB eviction blocking.
+
+Reference shapes: disruption/multinodeconsolidation.go:110-217,
+singlenodeconsolidation.go:44-101, consolidation.go:60-84, utils/pdb.go:56-86.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import COND_CONSOLIDATABLE, NodeClaim
+from karpenter_tpu.api.objects import LabelSelector, Node, ObjectMeta, Pod
+from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+from karpenter_tpu.disruption.methods import (MultiNodeConsolidation,
+                                              SingleNodeConsolidation,
+                                              filter_out_same_type)
+from karpenter_tpu.metrics.registry import CONSOLIDATION_TIMEOUTS
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.cloudprovider.types import (InstanceType, Offering,
+                                               Offerings)
+from karpenter_tpu.utils.pdb import Limits
+
+from factories import make_pod
+
+ZONE = "test-zone-1"
+
+
+def make_it(name, price, cpu=4):
+    from karpenter_tpu.utils import resources as res
+    return InstanceType(
+        name=name,
+        requirements=Requirements([
+            Requirement(api_labels.LABEL_INSTANCE_TYPE, IN, [name]),
+            Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [ZONE]),
+            Requirement(api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+                        [api_labels.CAPACITY_TYPE_ON_DEMAND]),
+        ]),
+        offerings=Offerings([Offering(
+            requirements=Requirements([
+                Requirement(api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+                            [api_labels.CAPACITY_TYPE_ON_DEMAND]),
+                Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [ZONE]),
+            ]),
+            price=price)]),
+        capacity=res.parse_list({"cpu": str(cpu), "memory": "8Gi",
+                                 "pods": "110"}))
+
+
+class FakeStateNode:
+    def __init__(self, it_name):
+        self._labels = {
+            api_labels.LABEL_INSTANCE_TYPE: it_name,
+            api_labels.LABEL_TOPOLOGY_ZONE: ZONE,
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+
+    def labels(self):
+        return dict(self._labels)
+
+
+class FakeCandidate:
+    """Just enough Candidate surface for filter_out_same_type/_fair_order."""
+
+    def __init__(self, it, cost=1.0, pool="default", pods=("p",)):
+        self.instance_type = it
+        self.state_node = FakeStateNode(it.name if it else "")
+        self.disruption_cost = cost
+        self.nodepool_name = pool
+        self.reschedulable_pods = list(pods)
+
+
+class FakeReplacement:
+    def __init__(self, its):
+        self.instance_type_options = list(its)
+        self.requirements = Requirements([
+            Requirement(api_labels.CAPACITY_TYPE_LABEL_KEY, IN,
+                        [api_labels.CAPACITY_TYPE_ON_DEMAND]),
+            Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN, [ZONE]),
+        ])
+
+    def remove_instance_types_by_price_and_min_values(self, reqs, max_price):
+        from karpenter_tpu.cloudprovider.types import satisfies_min_values
+        self.instance_type_options = [
+            it for it in self.instance_type_options
+            if it.offerings.available().worst_launch_price(reqs) < max_price]
+        _, err = satisfies_min_values(self.instance_type_options, reqs)
+        if err is not None:
+            return None, err
+        return self, None
+
+
+class TestFilterOutSameType:
+    """multinodeconsolidation.go:164-217 comment scenarios, t3a pricing."""
+
+    def setup_method(self):
+        self.nano = make_it("t3a.nano", 0.0047)
+        self.small = make_it("t3a.small", 0.0188)
+        self.xlarge = make_it("t3a.xlarge", 0.1504)
+        self.twoxl = make_it("t3a.2xlarge", 0.3008)
+
+    def test_replacement_including_deleted_type_rejected(self):
+        # [2xlarge, 2xlarge, small] -> 1 of {small, xlarge, 2xlarge}: this is
+        # really "delete the two 2xlarges" — no valid replacement remains
+        candidates = [FakeCandidate(self.twoxl), FakeCandidate(self.twoxl),
+                      FakeCandidate(self.small)]
+        surviving = filter_out_same_type(
+            FakeReplacement([self.small, self.xlarge, self.twoxl]), candidates)
+        assert surviving == []
+
+    def test_cheaper_option_survives(self):
+        # [2xlarge, 2xlarge, small] -> 1 of {nano, small, xlarge, 2xlarge}:
+        # only types strictly cheaper than the deleted small survive
+        candidates = [FakeCandidate(self.twoxl), FakeCandidate(self.twoxl),
+                      FakeCandidate(self.small)]
+        surviving = filter_out_same_type(
+            FakeReplacement([self.nano, self.small, self.xlarge, self.twoxl]),
+            candidates)
+        assert [it.name for it in surviving] == ["t3a.nano"]
+
+    def test_no_overlap_keeps_everything(self):
+        candidates = [FakeCandidate(self.twoxl), FakeCandidate(self.xlarge)]
+        surviving = filter_out_same_type(
+            FakeReplacement([self.nano, self.small]), candidates)
+        assert [it.name for it in surviving] == ["t3a.nano", "t3a.small"]
+
+
+class TestSingleNodeFairness:
+    def test_round_robin_across_nodepools(self):
+        its = [make_it(f"it-{i}", 0.1) for i in range(6)]
+        cands = [
+            FakeCandidate(its[0], cost=1.0, pool="a"),
+            FakeCandidate(its[1], cost=2.0, pool="a"),
+            FakeCandidate(its[2], cost=3.0, pool="a"),
+            FakeCandidate(its[3], cost=1.5, pool="b"),
+            FakeCandidate(its[4], cost=2.5, pool="b"),
+            FakeCandidate(its[5], cost=4.0, pool="c"),
+        ]
+        order = SingleNodeConsolidation._fair_order(cands)
+        pools = [c.nodepool_name for c in order]
+        # first round visits every pool (cheapest-pool-first), then wraps
+        assert pools == ["a", "b", "c", "a", "b", "a"]
+        costs_a = [c.disruption_cost for c in order if c.nodepool_name == "a"]
+        assert costs_a == sorted(costs_a)
+
+
+class TestMultiPDBBlocking:
+    """pdb.go:56-86: ANY matching PDB without headroom blocks eviction, even
+    when another matching PDB allows it."""
+
+    def _pdb(self, name, max_unavailable):
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "x"}),
+                         max_unavailable=max_unavailable))
+
+    def test_blocking_pdb_after_permissive_still_blocks(self):
+        pod = make_pod(labels={"app": "x"})
+        pod.spec.node_name = "n1"
+        limits = Limits([self._pdb("permissive", "1"),
+                         self._pdb("blocking", "0")], [pod])
+        ok, pdb = limits.can_evict(pod)
+        assert not ok
+        assert pdb.name == "blocking"
+
+    def test_all_permissive_allows(self):
+        pod = make_pod(labels={"app": "x"})
+        pod.spec.node_name = "n1"
+        limits = Limits([self._pdb("p1", "1"), self._pdb("p2", "2")], [pod])
+        ok, pdb = limits.can_evict(pod)
+        assert ok and pdb is None
+
+
+class _JumpClock:
+    """now() leaps far forward on every call — forces any in-loop deadline."""
+
+    def __init__(self, step=120.0):
+        self.t = 0.0
+        self.step_size = step
+
+    def now(self):
+        self.t += self.step_size
+        return self.t
+
+
+class _FakeCluster:
+    def __init__(self):
+        self.state = 1.0
+        self.clock = _JumpClock(0.0)
+
+    def consolidation_state(self):
+        return self.state
+
+    def mark_unconsolidated(self):
+        self.state += 1.0
+        return self.state
+
+
+class TestPerMethodMemoization:
+    """consolidation.go:60-84: one method marking consolidated must not
+    suppress the others; a cluster change re-enables everyone."""
+
+    def test_methods_memoize_independently(self):
+        cluster = _FakeCluster()
+        multi = MultiNodeConsolidation(cluster, provisioner=None)
+        single = SingleNodeConsolidation(cluster, provisioner=None)
+        assert not multi.is_consolidated()
+        assert not single.is_consolidated()
+        multi.mark_consolidated()
+        assert multi.is_consolidated()
+        assert not single.is_consolidated()   # the ADVICE regression
+        single.mark_consolidated()
+        assert single.is_consolidated()
+        cluster.mark_unconsolidated()
+        assert not multi.is_consolidated()
+        assert not single.is_consolidated()
+
+
+class TestFloorsAndTimeouts:
+    def test_multi_node_needs_two_candidates(self):
+        cluster = _FakeCluster()
+        multi = MultiNodeConsolidation(cluster, provisioner=None)
+        it = make_it("only", 0.1)
+        cmd, results = multi._first_n_consolidation_option(
+            [FakeCandidate(it)])
+        assert cmd.is_empty()
+
+    def test_single_node_timeout_counts_metric(self):
+        cluster = _FakeCluster()
+        single = SingleNodeConsolidation(cluster, provisioner=None,
+                                         clock=_JumpClock(200.0))
+        it = make_it("a", 0.1)
+        cands = [FakeCandidate(it, cost=float(i)) for i in range(4)]
+        before = CONSOLIDATION_TIMEOUTS.value({"consolidation_type": "single"})
+        cmd, results = single.compute_command({"default": 10}, cands)
+        assert cmd.is_empty()
+        after = CONSOLIDATION_TIMEOUTS.value({"consolidation_type": "single"})
+        assert after == before + 1
+
+
+class TestEmptyProbeGroup:
+    def test_cluster_zone_counts_skips_empty_groups(self):
+        """Prefix probes empty a group when all its pods belong to
+        non-prefix candidates; counting must skip it, not crash."""
+        from karpenter_tpu.provisioning.grouping import (SPREAD_ZONE,
+                                                         PodGroup, TopoSpec)
+        from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+        ts = TensorScheduler([], {})
+        g = PodGroup(pods=[], requirements=Requirements(), requests={},
+                     tolerations=(), labels={"app": "x"},
+                     topo=[TopoSpec(SPREAD_ZONE)])
+        izc = ts.cluster_zone_counts([g], ["z1", "z2"], set())
+        assert izc.shape == (1, 2) and not izc.any()

@@ -173,3 +173,57 @@ class TestMetrics:
         text = reg.expose()
         assert 'test_total{l="x"} 3' in text
         assert "test_seconds_bucket" in text
+
+
+class TestBooleanFlags:
+    """Flags must always mean what they say; env only moves the default
+    (ADVICE round-1: store_false flip made --enable-profiling disable when
+    KARPENTER_ENABLE_PROFILING=true)."""
+
+    def test_flag_agrees_with_env(self, monkeypatch):
+        from karpenter_tpu.operator.options import parse_options
+        monkeypatch.setenv("KARPENTER_ENABLE_PROFILING", "true")
+        assert parse_options(["--enable-profiling"]).enable_profiling is True
+        assert parse_options([]).enable_profiling is True
+
+    def test_no_flag_disables(self, monkeypatch):
+        from karpenter_tpu.operator.options import parse_options
+        monkeypatch.setenv("KARPENTER_ENABLE_PROFILING", "true")
+        assert parse_options(["--no-enable-profiling"]).enable_profiling \
+            is False
+
+
+class TestConsistencyTaintCheck:
+    def test_missing_taint_publishes_event(self):
+        from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED,
+                                                 COND_LAUNCHED,
+                                                 COND_REGISTERED, NodeClaim,
+                                                 NodeClaimSpec)
+        from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                               ObjectMeta, Taint)
+        from karpenter_tpu.controllers.nodeclaim_aux import Consistency
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils import resources as res
+
+        clock = FakeClock()
+        store = Store(clock)
+        recorder = Recorder(clock)
+        alloc = res.parse_list({"cpu": "4"})
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""),
+                       spec=NodeClaimSpec(
+                           taints=[Taint(key="dedicated", value="x",
+                                         effect="NoSchedule")]))
+        nc.status.node_name = "n1"
+        nc.status.allocatable = dict(alloc)
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.conditions.set_true(cond)
+        store.create(nc)
+        store.create(Node(metadata=ObjectMeta(name="n1", namespace=""),
+                          spec=NodeSpec(),  # taint missing on the node
+                          status=NodeStatus(capacity=dict(alloc),
+                                            allocatable=dict(alloc))))
+        Consistency(store, recorder, clock).reconcile(store.get(NodeClaim, "nc1"))
+        msgs = [e.message for e in recorder.for_object("nc1")]
+        assert any("taint" in m for m in msgs), msgs

@@ -91,3 +91,90 @@ class TestRemoteSolve:
         op.step()
         assert all(p.spec.node_name for p in op.store.list(Pod))
         assert op.store.list(Node)
+
+
+class StaticClusterView:
+    """ClusterView stub: scheduled pods pinned to named nodes with labels."""
+
+    def __init__(self, pods_on_nodes, node_labels):
+        self._pods = list(pods_on_nodes)
+        self._node_labels = dict(node_labels)
+
+    def list_pods(self, namespace, selector):
+        return [p for p in self._pods
+                if p.namespace == namespace and selector.matches(p.labels)]
+
+    def node_labels(self, node_name):
+        return self._node_labels.get(node_name)
+
+    def for_pods_with_anti_affinity(self):
+        return []
+
+
+def _scaleup_fixture():
+    """A deployment scale-up: 4 replicas of app=s already running in
+    test-zone-a, 8 new spread-constrained replicas pending. The solver must
+    count the existing replicas (topology.go:268-321) and skew new pods
+    toward the other zones."""
+    its = construct_instance_types()[:48]
+    pool = make_nodepool(name="default")
+    existing = make_pods(4, cpu="500m", labels={"app": "s"},
+                         spread=[spread_zone(key="app", value="s")])
+    for i, p in enumerate(existing):
+        p.spec.node_name = "existing-node-a"
+        p.status.phase = "Running"
+    view = StaticClusterView(existing, {
+        "existing-node-a": {api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a"}})
+    pending = make_pods(8, cpu="500m", labels={"app": "s"},
+                        spread=[spread_zone(key="app", value="s")])
+    return its, pool, view, pending
+
+
+def _zones_of(results):
+    zones = []
+    for nc in results.new_nodeclaims:
+        req = nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE)
+        zs = sorted(req.values_list())
+        zones.extend(zs * len(nc.pods) if len(zs) == 1 else [])
+    return sorted(zones)
+
+
+class TestClusterViewOverWire:
+    def test_cluster_counts_shift_the_solution(self, sidecar):
+        its, pool, view, pending = _scaleup_fixture()
+        with_view = TensorScheduler([pool], {"default": its},
+                                    cluster=view).solve(pending)
+        assert not with_view.pod_errors
+        # existing 4 pods in zone a: new 8 must backfill b/c/d first --
+        # zone a receives strictly fewer new pods than the other zones' max
+        zones = _zones_of(with_view)
+        assert zones, "expected zonal placements"
+        count_a = zones.count("test-zone-a")
+        others = [zones.count(z) for z in
+                  ("test-zone-b", "test-zone-c", "test-zone-d")]
+        assert count_a < max(others)
+        # host-oracle parity: same per-zone fill multiset (tie-break zone
+        # naming may differ, as in the reference's map iteration)
+        from factories import make_scheduler
+        host = make_scheduler([pool], {"default": its}, pending, cluster=view)
+        host_zones = _zones_of(host.solve(pending))
+        multiset = lambda zs: sorted(
+            zs.count(z) for z in set(zs))
+        assert multiset(zones) == multiset(host_zones)
+
+    def test_remote_matches_local_with_cluster_view(self, sidecar):
+        its, pool, view, pending = _scaleup_fixture()
+        local = TensorScheduler([pool], {"default": its},
+                                cluster=view).solve(pending)
+        remote = RemoteScheduler(sidecar, [pool], {"default": its},
+                                 cluster=view).solve(pending)
+        assert remote.pod_errors == local.pod_errors
+        assert len(remote.new_nodeclaims) == len(local.new_nodeclaims)
+        # zone assignment parity: the wire snapshot must carry the counts
+        local_zones = _zones_of(local)
+        remote_zones = []
+        for nc in remote.new_nodeclaims:
+            req = nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE)
+            zs = sorted(req.values_list())
+            remote_zones.extend(zs * len(nc.pods) if len(zs) == 1 else [])
+        assert sorted(remote_zones) == local_zones

@@ -130,18 +130,20 @@ class TestCluster:
 
     def test_mark_for_deletion_and_consolidation_state(self, store, cluster, clock):
         store.create(make_node("n1"))
-        t = cluster.mark_consolidated()
-        assert cluster.consolidation_state() == t
+        t = cluster.consolidation_state()
+        clock.step(1)
         cluster.mark_for_deletion("test://n1")
-        assert cluster.consolidation_state() == 0.0
+        assert cluster.consolidation_state() != t  # change bumped the token
         assert cluster.nodes["test://n1"].deleting()
         cluster.unmark_for_deletion("test://n1")
         assert not cluster.nodes["test://n1"].deleting()
 
     def test_consolidation_state_forced_revalidation(self, cluster, clock):
-        cluster.mark_consolidated()
+        t = cluster.consolidation_state()
+        clock.step(100)
+        assert cluster.consolidation_state() == t  # quiet cluster: stable
         clock.step(301)
-        assert cluster.consolidation_state() == 0.0
+        assert cluster.consolidation_state() != t  # 5-min forced bump
 
     def test_nomination_window(self, store, cluster, clock):
         store.create(make_node("n1"))
