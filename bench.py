@@ -85,16 +85,17 @@ def _pods():
     return pods
 
 
-def _catalog():
-    return construct_catalog(N_ITS) if N_ITS else construct_instance_types()
+def _catalog(n_its=None):
+    n = N_ITS if n_its is None else n_its
+    return construct_catalog(n) if n else construct_instance_types()
 
 
-def _scheduler():
+def _scheduler(n_its=None):
     nodepool = NodePool(
         metadata=ObjectMeta(name="default"),
         spec=NodePoolSpec(template=NodeClaimTemplate(
             spec=NodeClaimTemplateSpec())))
-    return TensorScheduler([nodepool], {"default": _catalog()})
+    return TensorScheduler([nodepool], {"default": _catalog(n_its)})
 
 
 def bench_consolidation():
@@ -291,6 +292,33 @@ def bench_spot_repack():
     }))
 
 
+def bench_provisioning(pods, n_its):
+    """One provisioning config; returns the JSON-line dict."""
+    # warmup: populate the jit cache at the exact shapes of the timed run
+    ts = _scheduler(n_its)
+    r = ts.solve(pods)
+    assert ts.fallback_reason == "", f"tensor path fell back: {ts.fallback_reason}"
+    scheduled = len(pods) - len(r.pod_errors)
+    assert scheduled > 0, "nothing scheduled"
+
+    best = float("inf")
+    for _ in range(REPEATS):
+        ts = _scheduler(n_its)
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        best = min(best, time.perf_counter() - t0)
+
+    pods_per_sec = len(pods) / best
+    return {
+        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
+                   f"{n_its or 144} instance types, reference benchmark pod mix"),
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(pods_per_sec / 100.0, 2),
+        "seconds": round(len(pods) / pods_per_sec, 3),
+    }
+
+
 def main():
     if MODE == "consolidation":
         bench_consolidation()
@@ -299,29 +327,14 @@ def main():
         bench_spot_repack()
         return
     pods = _pods()
-    # warmup: populate the jit cache at the exact shapes of the timed run
-    ts = _scheduler()
-    r = ts.solve(pods)
-    assert ts.fallback_reason == "", f"tensor path fell back: {ts.fallback_reason}"
-    scheduled = len(pods) - len(r.pod_errors)
-    assert scheduled > 0, "nothing scheduled"
-
-    best = float("inf")
-    for _ in range(REPEATS):
-        ts = _scheduler()
-        t0 = time.perf_counter()
-        ts.solve(pods)
-        best = min(best, time.perf_counter() - t0)
-
-    pods_per_sec = len(pods) / best
-    n_its = N_ITS if N_ITS else 144
-    print(json.dumps({
-        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
-                   f"{n_its} instance types, reference benchmark pod mix"),
-        "value": round(pods_per_sec, 1),
-        "unit": "pods/sec",
-        "vs_baseline": round(pods_per_sec / 100.0, 2),
-    }))
+    if N_ITS:
+        print(json.dumps(bench_provisioning(pods, N_ITS)))
+        return
+    # default: the kwok-catalog config first, the BASELINE north star
+    # (50k pods x 2000 instance types < 1 s on v5e-1) LAST so the driver's
+    # tail parse records it as the headline
+    print(json.dumps(bench_provisioning(pods, 0)))
+    print(json.dumps(bench_provisioning(pods, 2000)))
 
 
 if __name__ == "__main__":

@@ -105,6 +105,10 @@ class PackProblem:
     tol_exist: Optional[np.ndarray] = None           # bool [G, N]
     allow_undefined: Optional[np.ndarray] = None     # bool [K] well-known keys
     off_price: Optional[np.ndarray] = None           # float32 [T, O] (inf absent)
+    # shared mutable slot (from the catalog-encoding cache): device-resident
+    # copies of the catalog-side arrays, so repeat solves against the same
+    # instance-type catalog skip the host->device upload entirely
+    device_cache: Optional[dict] = None
 
 
 @dataclass
@@ -231,12 +235,22 @@ def device_args(p: PackProblem):
                          lt=jnp.zeros((1, K), jnp.int32))
         exist_avail = jnp.zeros((1, p.group_req.shape[1]), jnp.int32)
         tol_exist = jnp.zeros((p.group_req.shape[0], 1), bool)
-    args = (dev(p.group_enc), dev(p.template_enc), dev(p.it_enc),
+    cache = p.device_cache
+    it_side = cache.get("it_side") if cache is not None else None
+    if it_side is None:
+        it_side = (dev(p.it_enc), i32(p.it_alloc), jnp.asarray(p.off_zone),
+                   jnp.asarray(p.off_captype), jnp.asarray(p.off_available),
+                   jnp.asarray(p.zone_values), jnp.asarray(p.allow_undefined))
+        if cache is not None:
+            cache["it_side"] = it_side
+    (it_enc_d, it_alloc_d, off_zone_d, off_captype_d, off_avail_d,
+     zone_values_d, allow_undef_d) = it_side
+    args = (dev(p.group_enc), dev(p.template_enc), it_enc_d,
             i32(p.group_req), i32(p.daemon_overhead),
-            i32(p.it_alloc), jnp.asarray(p.template_its),
-            jnp.asarray(p.off_zone), jnp.asarray(p.off_captype),
-            jnp.asarray(p.off_available), jnp.asarray(p.zone_values),
-            jnp.asarray(p.allow_undefined), jnp.asarray(p.tol_template),
+            it_alloc_d, jnp.asarray(p.template_its),
+            off_zone_d, off_captype_d,
+            off_avail_d, zone_values_d,
+            allow_undef_d, jnp.asarray(p.tol_template),
             exist, exist_avail, tol_exist)
     statics = dict(zone_key=p.zone_key, captype_key=p.captype_key,
                    has_exist=has_exist)
@@ -364,6 +378,23 @@ class Packer:
         self.exist_avail = (p.exist_avail.copy() if p.exist_avail is not None
                             else np.zeros((0, p.group_req.shape[1]), dtype=np.int64))
         self.result = PackResult()
+        # per-group nonzero request columns + per-(m,g) daemon-adjusted
+        # allocatable slices, so the per-probe capacity math touches only the
+        # resources the group actually requests (hot path: _cohort_capacity)
+        self._req_nz = [np.nonzero(p.group_req[g])[0] for g in range(self.G)]
+        self._req_vals = [p.group_req[g][self._req_nz[g]] for g in range(self.G)]
+        self._alloc_nz_cache: Dict[tuple, np.ndarray] = {}
+
+    def _alloc_nz(self, m: int, g: int) -> np.ndarray:
+        """[T, nnz(g)] allocatable minus template daemon overhead, restricted
+        to group g's requested resources."""
+        key = (m, g)
+        out = self._alloc_nz_cache.get(key)
+        if out is None:
+            nz = self._req_nz[g]
+            out = self.p.it_alloc[:, nz] - self.p.daemon_overhead[m][nz]
+            self._alloc_nz_cache[key] = out
+        return out
 
     # -- helpers ------------------------------------------------------------
 
@@ -455,20 +486,20 @@ class Packer:
             enc=cohort_enc, pods_by_group={g: fill}))
 
     def _cohort_capacity(self, g: int, cohort: Cohort) -> Tuple[int, np.ndarray]:
-        """Max additional pods of group g per cohort node + surviving it set."""
+        """Max additional pods of group g per cohort node + surviving it set.
+        Negative free capacity floors the per-IT min below zero, which the
+        callers' cap<=0 check treats identically to the old clamp-to-zero."""
         it_ok = (self.t.it_ok_z[g, cohort.m, :, cohort.zone] if cohort.zone is not None
                  else self.t.it_ok[g, cohort.m])
         ts = cohort.it_set & it_ok
         if not ts.any():
             return 0, ts
-        req = self.p.group_req[g]
-        free = self.p.it_alloc[ts] - self.p.daemon_overhead[cohort.m] - cohort.requests
-        free = np.maximum(free, 0)
-        with np.errstate(divide="ignore"):
-            per = np.where(req[None, :] > 0, free // np.maximum(req[None, :], 1),
-                           INT32_MAX)
-        cap = int(per.min(axis=1).max()) if per.size else 0
-        return cap, ts
+        nz = self._req_nz[g]
+        if nz.size == 0:
+            return INT32_MAX, ts
+        per = ((self._alloc_nz(cohort.m, g) - cohort.requests[nz])
+               // self._req_vals[g]).min(axis=1)
+        return int(per[ts].max()), ts
 
     def _fill_cohorts(self, g: int, remaining: int, zone: Optional[int],
                       per_node_cap: int) -> int:
@@ -477,8 +508,9 @@ class Packer:
         if remaining <= 0:
             return 0
         allow = self.p.allow_undefined
-        order = sorted(range(len(self.result.cohorts)),
-                       key=lambda i: sum(self.result.cohorts[i].pods_by_group.values()))
+        cohorts = self.result.cohorts
+        fills = [sum(c.pods_by_group.values()) for c in cohorts]
+        order = sorted(range(len(cohorts)), key=fills.__getitem__)
         placed_total = 0
         for ci in order:
             if remaining <= 0:

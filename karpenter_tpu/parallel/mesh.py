@@ -84,12 +84,16 @@ def pad_problem(p: binpack.PackProblem, g_mult: int, t_mult: int
     Padded groups have empty masks (never compatible); padded instance types
     are excluded via template_its=False. Returns (padded, G, T) with the
     original sizes for un-padding results."""
+    import dataclasses
+
     G = p.group_req.shape[0]
     T = p.it_alloc.shape[0]
     Gp = math.ceil(G / g_mult) * g_mult
     Tp = math.ceil(T / t_mult) * t_mult
     if Gp == G and Tp == T:
-        return p, G, T
+        # drop the single-device catalog cache: sharded dispatch must not
+        # receive arrays already committed to one device
+        return dataclasses.replace(p, device_cache=None), G, T
     q = binpack.PackProblem(
         vocab=p.vocab,
         group_enc=_pad_enc(p.group_enc, 0, Gp),

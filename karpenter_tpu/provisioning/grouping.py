@@ -150,33 +150,46 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
     # hashing runs once per deployment, not once per pod — and the per-pod
     # signature is a tuple of small ints. Structural equality is preserved:
     # distinct-but-equal objects resolve to the same token via struct_tokens.
+    # The loop body is manually inlined: at 50k pods the per-call overhead of
+    # a tok() helper is itself a top-line cost.
     id_memo: Dict[int, int] = {}
     struct_tokens: Dict[object, int] = {}
+    id_get = id_memo.get
+    tok_setdefault = struct_tokens.setdefault
 
     def tok(obj, builder):
-        t = id_memo.get(id(obj))
+        t = id_get(id(obj))
         if t is None:
-            k = builder(obj)
-            t = struct_tokens.setdefault(k, len(struct_tokens))
+            t = tok_setdefault(builder(obj), len(struct_tokens))
             id_memo[id(obj)] = t
         return t
 
     ident = lambda o: o
     items_key = lambda d: tuple(sorted(d.items()))
     for pod in pods:
-        if pod.spec.host_ports:
+        spec = pod.spec
+        if spec.host_ports:
             return None, "host ports require per-pod conflict tracking"
-        if pod.spec.volumes:
+        if spec.volumes:
             return None, "persistent volumes require host-side limit tracking"
-        aff = pod.spec.affinity
+        aff = spec.affinity
+        # labels + requests dicts are distinct objects per pod (stamped
+        # metadata), so their id-memo never hits: key directly by content
+        labels = pod.metadata.labels
+        lt = tok_setdefault(tuple(sorted(labels.items())) if len(labels) > 1
+                            else tuple(labels.items()), len(struct_tokens))
+        reqs = pod.container_requests
+        rt = (tok(reqs[0], items_key) if len(reqs) == 1
+              else tuple(tok(r, items_key) for r in reqs))
+        spread = spec.topology_spread_constraints
         sig = (
-            tok(pod.spec.node_selector, items_key),
+            tok(spec.node_selector, items_key),
             -1 if aff is None else tok(aff, lambda a, p=pod: _affinity_key(p)),
-            tuple(tok(c, ident)
-                  for c in pod.spec.topology_spread_constraints),
-            tuple(tok(t, ident) for t in pod.spec.tolerations),
-            tok(pod.metadata.labels, items_key),
-            tuple(tok(r, items_key) for r in pod.container_requests),
+            tok(spread[0], ident) if len(spread) == 1
+            else tuple(tok(c, ident) for c in spread),
+            tuple(tok(t, ident) for t in spec.tolerations),
+            lt,
+            rt,
             tuple(tok(r, items_key) for r in pod.init_container_requests),
         )
         g = groups.get(sig)

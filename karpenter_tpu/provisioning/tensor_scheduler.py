@@ -43,6 +43,49 @@ def _pow2_bucket(n: int, minimum: int) -> int:
     return b
 
 
+@dataclass
+class _CatalogEncoding:
+    """Catalog-side tensors shared across solves. The instance-type catalog
+    is stable between reconcile passes (providers refresh it on the order of
+    minutes), while the solver runs every batch window — so the vocabulary,
+    the encoded IT requirement masks, the offering tensors, AND their
+    device-resident copies are all reusable. Reuse is only legal when the
+    new solve introduces no vocabulary entries (checked by _fits_vocab):
+    complement-encoded masks enumerate the value universe, so any new value
+    would invalidate every cached row."""
+    vocab: object
+    zone_key: int
+    captype_key: int
+    it_enc: object
+    it_alloc: np.ndarray
+    it_capacity: np.ndarray
+    it_price: np.ndarray
+    off_zone: np.ndarray
+    off_captype: np.ndarray
+    off_available: np.ndarray
+    off_price: np.ndarray
+    zone_values: np.ndarray
+    allow_undefined: np.ndarray
+    device_cache: dict
+
+
+_CATALOG_CACHE: "Dict[tuple, _CatalogEncoding]" = {}
+_CATALOG_CACHE_MAX = 4
+
+
+def _catalog_cache_key(catalog: List[InstanceType]) -> tuple:
+    """Content key over the facts the encoding depends on. Requirements are
+    assumed stable for a given instance-type NAME (true of real catalogs,
+    where a name identifies a SKU); offerings (zone/captype/price/
+    availability) and capacity churn, so they are part of the key."""
+    return tuple(
+        (it.name, tuple(sorted(it.allocatable().items())),
+         tuple(sorted(it.capacity.items())),
+         tuple((o.zone, o.capacity_type, o.price, o.available)
+               for o in it.offerings))
+        for it in catalog)
+
+
 class TensorNodeClaim:
     """A launch decision produced by the tensor packer; interface-compatible
     with provisioning.scheduler.InFlightNodeClaim for downstream consumers."""
@@ -179,45 +222,28 @@ class TensorScheduler:
         M = len(templates)
         G = len(groups)
 
-        vocab = enc.Vocab()
-        zone_key = vocab.add_key(api_labels.LABEL_TOPOLOGY_ZONE)
-        captype_key = vocab.add_key(api_labels.CAPACITY_TYPE_LABEL_KEY)
-        for it in catalog:
-            vocab.observe_requirements(it.requirements)
-            vocab.observe_resources(it.capacity)
-            for off in it.offerings:
-                vocab.observe_requirements(off.requirements)
-        for nct in templates:
-            vocab.observe_requirements(nct.requirements)
-        for g in groups:
-            vocab.observe_requirements(g.requirements)
-            vocab.observe_resources(g.requests)
-        # Existing nodes only contribute VALUES for keys some group/template/
-        # instance type already defines. A key defined solely by nodes (e.g.
-        # kubernetes.io/hostname with one distinct value per node) can never
-        # fail a compatibility check — the checked set is
-        # a.defined & b.defined, and undefined-key violations only fire for
-        # pod-side-defined keys (requirements.go:175-187) — so admitting it
-        # would just blow the mask domain up to O(nodes) for nothing.
-        for sn in self.state_nodes:
-            reqs = label_requirements(sn.labels())
-            for key in reqs:
-                norm = api_labels.NORMALIZED_LABELS.get(key, key)
-                if norm in vocab.key_idx:
-                    for v in reqs.get(key).values:
-                        vocab.add_value(norm, v)
-            vocab.observe_resources(sn.allocatable())
-        # power-of-two domain bucket: consolidation's prefix probes vary the
-        # value counts per simulation; bucketing keeps mask shapes (and so
-        # the jit cache) stable across probes
-        vocab.freeze(domain_bucket=_pow2_bucket(vocab.D, 64))
+        ckey = _catalog_cache_key(catalog)
+        ce = _CATALOG_CACHE.get(ckey)
+        if ce is not None and not self._fits_vocab(ce.vocab, templates, groups):
+            ce = None
+        if ce is None:
+            ce = self._encode_catalog(catalog, templates, groups)
+            if ckey not in _CATALOG_CACHE and \
+                    len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
+                _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
+            _CATALOG_CACHE[ckey] = ce
+        vocab = ce.vocab
+        zone_key, captype_key = ce.zone_key, ce.captype_key
+        it_enc, it_alloc, it_capacity = ce.it_enc, ce.it_alloc, ce.it_capacity
+        it_price = ce.it_price
+        off_zone, off_captype = ce.off_zone, ce.off_captype
+        off_available, off_price = ce.off_available, ce.off_price
+        zone_values, allow_undefined = ce.zone_values, ce.allow_undefined
 
         group_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, g.requirements) for g in groups])
         template_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, t.requirements) for t in templates])
-        it_enc = enc.stack_encoded(
-            [enc.encode_requirements(vocab, it.requirements) for it in catalog])
 
         group_req = np.stack([enc.encode_resource_vector(vocab, g.requests, capacity=False)
                               for g in groups])
@@ -225,37 +251,10 @@ class TensorScheduler:
             enc.encode_resource_vector(vocab, _daemon_overhead(t, self.daemonset_pods),
                                        capacity=False)
             for t in templates])
-        it_alloc = np.stack([enc.encode_resource_vector(vocab, it.allocatable(), capacity=True)
-                             for it in catalog])
-        it_capacity = np.stack([enc.encode_resource_vector(vocab, it.capacity, capacity=True)
-                                for it in catalog])
         template_its = np.zeros((M, T), dtype=bool)
         for m, nct in enumerate(templates):
             for it in nct.instance_type_options:
                 template_its[m, it_index[it.name]] = True
-
-        # offerings
-        O = max((len(it.offerings) for it in catalog), default=1)
-        off_zone = np.full((T, O), -1, dtype=np.int32)
-        off_captype = np.full((T, O), -1, dtype=np.int32)
-        off_available = np.zeros((T, O), dtype=bool)
-        off_price = np.full((T, O), np.inf, dtype=np.float32)
-        it_price = np.full(T, np.inf, dtype=np.float32)
-        for t, it in enumerate(catalog):
-            for o, off in enumerate(it.offerings):
-                if not off.available:
-                    continue
-                off_available[t, o] = True
-                off_price[t, o] = off.price
-                z = off.zone
-                ct = off.capacity_type
-                if z:
-                    off_zone[t, o] = vocab.value_idx[zone_key].get(z, -1)
-                if ct:
-                    off_captype[t, o] = vocab.value_idx[captype_key].get(ct, -1)
-                it_price[t] = min(it_price[t], off.price)
-        zone_values = np.arange(len(vocab.values[zone_key]), dtype=np.int32)
-        allow_undefined = np.array([k in ALLOW_UNDEFINED_WELL_KNOWN for k in vocab.keys])
 
         # taints: host-checked per (group, template) and (group, existing node)
         tol_template = np.zeros((G, M), dtype=bool)
@@ -313,8 +312,123 @@ class TensorScheduler:
             zone_key=zone_key, captype_key=captype_key, zone_values=zone_values,
             off_price=off_price,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
-            tol_exist=tol_exist, allow_undefined=allow_undefined)
+            tol_exist=tol_exist, allow_undefined=allow_undefined,
+            device_cache=ce.device_cache)
         return problem, templates, catalog
+
+    def _fits_vocab(self, vocab, templates, groups) -> bool:
+        """True when this solve introduces NO new vocabulary entry — the
+        cache-reuse condition: every key/value a fresh build would observe
+        from templates, groups, and state nodes is already present, so the
+        cached masks (incl. complement rows, which enumerate the value
+        universe) stay exact."""
+        def reqs_fit(reqs: Requirements) -> bool:
+            for key in reqs:
+                norm = api_labels.NORMALIZED_LABELS.get(key, key)
+                k = vocab.key_idx.get(norm)
+                if k is None:
+                    return False
+                vi = vocab.value_idx[k]
+                for v in reqs.get(key).values:
+                    if v not in vi:
+                        return False
+            return True
+
+        for nct in templates:
+            if not reqs_fit(nct.requirements):
+                return False
+        for g in groups:
+            if not reqs_fit(g.requirements):
+                return False
+            if any(r not in vocab.resource_idx for r in g.requests):
+                return False
+        for sn in self.state_nodes:
+            reqs = label_requirements(sn.labels())
+            for key in reqs:
+                norm = api_labels.NORMALIZED_LABELS.get(key, key)
+                k = vocab.key_idx.get(norm)
+                if k is None:
+                    continue  # node-only keys are never admitted (see below)
+                vi = vocab.value_idx[k]
+                for v in reqs.get(key).values:
+                    if v not in vi:
+                        return False
+            if any(r not in vocab.resource_idx for r in sn.allocatable()):
+                return False
+        return True
+
+    def _encode_catalog(self, catalog, templates, groups) -> _CatalogEncoding:
+        """Fresh vocabulary + catalog-side tensors (the cacheable part of
+        build_problem)."""
+        vocab = enc.Vocab()
+        zone_key = vocab.add_key(api_labels.LABEL_TOPOLOGY_ZONE)
+        captype_key = vocab.add_key(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        for it in catalog:
+            vocab.observe_requirements(it.requirements)
+            vocab.observe_resources(it.capacity)
+            for off in it.offerings:
+                vocab.observe_requirements(off.requirements)
+        for nct in templates:
+            vocab.observe_requirements(nct.requirements)
+        for g in groups:
+            vocab.observe_requirements(g.requirements)
+            vocab.observe_resources(g.requests)
+        # Existing nodes only contribute VALUES for keys some group/template/
+        # instance type already defines. A key defined solely by nodes (e.g.
+        # kubernetes.io/hostname with one distinct value per node) can never
+        # fail a compatibility check — the checked set is
+        # a.defined & b.defined, and undefined-key violations only fire for
+        # pod-side-defined keys (requirements.go:175-187) — so admitting it
+        # would just blow the mask domain up to O(nodes) for nothing.
+        for sn in self.state_nodes:
+            reqs = label_requirements(sn.labels())
+            for key in reqs:
+                norm = api_labels.NORMALIZED_LABELS.get(key, key)
+                if norm in vocab.key_idx:
+                    for v in reqs.get(key).values:
+                        vocab.add_value(norm, v)
+            vocab.observe_resources(sn.allocatable())
+        # power-of-two domain bucket: consolidation's prefix probes vary the
+        # value counts per simulation; bucketing keeps mask shapes (and so
+        # the jit cache) stable across probes
+        vocab.freeze(domain_bucket=_pow2_bucket(vocab.D, 64))
+
+        T = len(catalog)
+        it_enc = enc.stack_encoded(
+            [enc.encode_requirements(vocab, it.requirements) for it in catalog])
+        it_alloc = np.stack([enc.encode_resource_vector(vocab, it.allocatable(), capacity=True)
+                             for it in catalog])
+        it_capacity = np.stack([enc.encode_resource_vector(vocab, it.capacity, capacity=True)
+                                for it in catalog])
+        O = max((len(it.offerings) for it in catalog), default=1)
+        off_zone = np.full((T, O), -1, dtype=np.int32)
+        off_captype = np.full((T, O), -1, dtype=np.int32)
+        off_available = np.zeros((T, O), dtype=bool)
+        off_price = np.full((T, O), np.inf, dtype=np.float32)
+        it_price = np.full(T, np.inf, dtype=np.float32)
+        for t, it in enumerate(catalog):
+            for o, off in enumerate(it.offerings):
+                if not off.available:
+                    continue
+                off_available[t, o] = True
+                off_price[t, o] = off.price
+                z = off.zone
+                ct = off.capacity_type
+                if z:
+                    off_zone[t, o] = vocab.value_idx[zone_key].get(z, -1)
+                if ct:
+                    off_captype[t, o] = vocab.value_idx[captype_key].get(ct, -1)
+                it_price[t] = min(it_price[t], off.price)
+        zone_values = np.arange(len(vocab.values[zone_key]), dtype=np.int32)
+        allow_undefined = np.array([k in ALLOW_UNDEFINED_WELL_KNOWN
+                                    for k in vocab.keys])
+        return _CatalogEncoding(
+            vocab=vocab, zone_key=zone_key, captype_key=captype_key,
+            it_enc=it_enc, it_alloc=it_alloc, it_capacity=it_capacity,
+            it_price=it_price, off_zone=off_zone, off_captype=off_captype,
+            off_available=off_available, off_price=off_price,
+            zone_values=zone_values, allow_undefined=allow_undefined,
+            device_cache={})
 
     def _group_selector(self, g: PodGroup):
         """The (single) self-selecting topology selector of a group, from its
@@ -454,10 +568,19 @@ class TensorScheduler:
 
         new_claims: List[TensorNodeClaim] = []
         it_names = np.array([it.name for it in catalog])
+        # cohorts from one solve overwhelmingly share (it_set, zone/captype
+        # admission) — memoize the ordering per distinct key
+        order_cache: dict = {}
         for cohort in pr.cohorts:
-            ordered = [catalog[t]
-                       for t in self._cohort_price_order(problem, cohort,
-                                                         it_names)]
+            okey = (cohort.it_set.tobytes(),
+                    cohort.enc.mask[problem.zone_key].tobytes(),
+                    cohort.enc.mask[problem.captype_key].tobytes())
+            ordered = order_cache.get(okey)
+            if ordered is None:
+                ordered = [catalog[t]
+                           for t in self._cohort_price_order(problem, cohort,
+                                                             it_names)]
+                order_cache[okey] = ordered
             base_reqs = Requirements(templates[cohort.m].requirements.values())
             for g in cohort.pods_by_group:
                 base_reqs.add(*groups[g].requirements.values())
