@@ -138,7 +138,24 @@ def _affinity_key(pod: Pod):
 
 
 def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
-    """Returns (groups, "") or (None, reason-for-host-fallback).
+    """All-or-nothing view of partition_pods: (groups, "") when EVERY pod is
+    tensor-eligible, else (None, reason). Callers that can't mix solver
+    paths per pod (the consolidation prefix simulator, the dryrun) use this;
+    the provisioning solve uses partition_pods directly."""
+    groups, leftover, reason = partition_pods(pods)
+    if leftover:
+        return None, reason
+    return groups, ""
+
+
+def partition_pods(pods: List[Pod]):
+    """Returns (groups, leftover_pods, reason): every pod lands on exactly
+    one side. `groups` are tensor-eligible equivalence classes; `leftover`
+    pods carry constraint shapes only the host oracle understands (host
+    ports, volumes, unsupported topology forms) PLUS any group whose
+    topology counts couple to a leftover pod or another group (shared
+    selector domains must be counted by one solver). `reason` describes the
+    first leftover cause (empty when leftover is empty).
 
     Two-phase: a cheap structural signature buckets the pods; the expensive
     classification (Requirements construction, topology-shape analysis) runs
@@ -166,12 +183,9 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
 
     ident = lambda o: o
     items_key = lambda d: tuple(sorted(d.items()))
+    reasons: Dict[int, str] = {}  # id(bucket) -> why it's host-path
     for pod in pods:
         spec = pod.spec
-        if spec.host_ports:
-            return None, "host ports require per-pod conflict tracking"
-        if spec.volumes:
-            return None, "persistent volumes require host-side limit tracking"
         aff = spec.affinity
         # labels + requests dicts are distinct objects per pod (stamped
         # metadata), so their id-memo never hits: key directly by content
@@ -191,40 +205,83 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
             lt,
             rt,
             tuple(tok(r, items_key) for r in pod.init_container_requests),
+            (not spec.host_ports, not spec.volumes),
         )
         g = groups.get(sig)
         if g is None:
+            reason = ""
+            if spec.host_ports:
+                reason = "host ports require per-pod conflict tracking"
+            elif spec.volumes:
+                reason = "persistent volumes require host-side limit tracking"
             specs, relaxable = _classify_topology(pod)
-            if specs is None:
-                return None, "unsupported topology constraint shape"
+            if specs is None and not reason:
+                reason = "unsupported topology constraint shape"
             g = PodGroup(pods=[], requirements=pod_requirements(pod),
                          requests=pod.requests(),
                          tolerations=tuple(pod.spec.tolerations),
-                         labels=dict(pod.labels), topo=specs,
+                         labels=dict(pod.labels), topo=specs or [],
                          has_relaxable=relaxable or has_preferred_node_affinity(pod))
+            if reason:
+                reasons[id(g)] = reason
             groups[sig] = g
             order.append(g)
         g.pods.append(pod)
 
-    # cross-group selector coupling: any group's topology selector matching
-    # another group's labels means shared domain counts -> host path
-    for gi in order:
-        if not gi.topo:
-            continue
-        sel_sources = []
-        for p in (gi.pods[0],):
-            for tsc in p.spec.topology_spread_constraints:
-                sel_sources.append(tsc.label_selector)
-            aff = p.spec.affinity
-            if aff is not None:
-                for term in (aff.pod_affinity.required if aff.pod_affinity else []):
-                    sel_sources.append(term.label_selector)
-                for term in (aff.pod_anti_affinity.required if aff.pod_anti_affinity else []):
-                    sel_sources.append(term.label_selector)
-        for gj in order:
-            if gi is gj:
-                continue
-            for sel in sel_sources:
-                if sel is not None and sel.matches(gj.labels):
-                    return None, "topology selector couples multiple pod groups"
-    return order, ""
+    # cross-group selector coupling: a topology selector matching another
+    # bucket's labels means shared domain counts — both sides must be solved
+    # by ONE solver. Any bucket coupled (transitively) to a host-path bucket
+    # or to another eligible bucket is demoted to the host side.
+    sels: Dict[int, list] = {}
+    for g in order:
+        out = []
+        p = g.pods[0]
+        for tsc in p.spec.topology_spread_constraints:
+            if tsc.label_selector is not None:
+                out.append(tsc.label_selector)
+        aff = p.spec.affinity
+        if aff is not None:
+            for pa in (aff.pod_affinity, aff.pod_anti_affinity):
+                if pa is None:
+                    continue
+                for term in pa.required:
+                    if term.label_selector is not None:
+                        out.append(term.label_selector)
+                for wt in pa.preferred:
+                    if wt.term.label_selector is not None:
+                        out.append(wt.term.label_selector)
+        sels[id(g)] = out
+
+    eligible = [g for g in order if id(g) not in reasons]
+    host_side = [g for g in order if id(g) in reasons]
+    changed = True
+    while changed:
+        changed = False
+        still = []
+        for g in eligible:
+            demote = ""
+            # a host-side pod inside my selector domains (or vice versa)
+            for h in host_side:
+                if any(s.matches(h.labels) for s in sels[id(g)]) or \
+                        any(s.matches(g.labels) for s in sels[id(h)]):
+                    demote = "topology selector couples to host-path pods"
+                    break
+            if not demote and sels[id(g)]:
+                # eligible-to-eligible coupling: the kernel counts each
+                # group's domains independently, so shared counts demote both
+                for g2 in eligible:
+                    if g2 is not g and any(s.matches(g2.labels)
+                                           for s in sels[id(g)]):
+                        demote = "topology selector couples multiple pod groups"
+                        break
+            if demote:
+                reasons[id(g)] = demote
+                host_side.append(g)
+                changed = True
+            else:
+                still.append(g)
+        eligible = still
+
+    leftover = [p for g in order if id(g) in reasons for p in g.pods]
+    reason = next((reasons[id(g)] for g in order if id(g) in reasons), "")
+    return [g for g in order if id(g) not in reasons], leftover, reason

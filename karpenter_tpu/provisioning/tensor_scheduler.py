@@ -27,7 +27,7 @@ from ..scheduling.requirement import IN, Requirement
 from ..scheduling.requirements import (ALLOW_UNDEFINED_WELL_KNOWN, Requirements,
                                        label_requirements)
 from ..utils import resources as res
-from .grouping import PodGroup, group_pods
+from .grouping import PodGroup, group_pods, partition_pods
 from .scheduler import (MAX_INSTANCE_TYPES, NodeClaimTemplate, Results, Scheduler,
                         _daemon_overhead, _req_to_selector)
 from .topology import ClusterView, Topology
@@ -160,30 +160,82 @@ class TensorScheduler:
         # over a multi-chip mesh (parallel/mesh.py) instead of single-device
         self.mesh = mesh
         self.fallback_reason: str = ""
+        # (pods solved on the tensor path, pods handed to the host pass)
+        self.partition = (0, 0)
 
     # -- public -------------------------------------------------------------
 
     def solve(self, pods: List[Pod]) -> Results:
-        groups, reason = group_pods(pods)
-        if groups is None:
+        groups, leftover, reason = partition_pods(pods)
+        self.partition = (sum(g.count for g in groups), len(leftover))
+        if not groups:
             return self._host_solve(pods, reason)
+        eligible = [p for g in groups for p in g.pods]
         try:
-            results = self._tensor_solve(groups, pods)
+            results = self._tensor_solve(groups, eligible)
         except _FallbackError as e:
             return self._host_solve(pods, str(e))
-        if results.pod_errors and not self.force_tensor and any(
-                g.has_relaxable for g in groups):
-            return self._host_solve(pods, "unscheduled pods with relaxable preferences")
-        return results
+        if not leftover:
+            if results.pod_errors and not self.force_tensor and any(
+                    g.has_relaxable for g in groups):
+                return self._host_solve(
+                    pods, "unscheduled pods with relaxable preferences")
+            return results
+        # partitioned: the tensor bulk is committed; stragglers (plus any
+        # eligible pods the packer couldn't place — they get the host's
+        # relaxation ladder) run through a host scheduler seeded with the
+        # tensor placements, so capacity and in-flight nodes are shared
+        # (scheduler.go:267-283 semantics: existing -> in-flight -> new)
+        retry = [p for p in eligible if p.uid in results.pod_errors]
+        return self._host_solve_remainder(leftover + retry, results)
 
     def _host_solve(self, pods: List[Pod], reason: str) -> Results:
         self.fallback_reason = reason
+        return self._make_host(pods).solve(pods)
+
+    def _make_host(self, pods: List[Pod]) -> Scheduler:
         from .domains import build_topology_domains
         domains = build_topology_domains(self.nodepools, self.instance_types)
         topo = Topology(self.cluster, domains, pods)
-        host = Scheduler(self.nodepools, self.instance_types, topo,
+        return Scheduler(self.nodepools, self.instance_types, topo,
                          state_nodes=self.state_nodes,
                          daemonset_pods=self.daemonset_pods)
+
+    def _host_solve_remainder(self, pods: List[Pod], tensor_results: Results
+                              ) -> Results:
+        """Run the host oracle over the straggler pods with the tensor bulk's
+        placements already committed: existing-node usage is seeded so
+        capacity isn't double-booked, and the tensor launch decisions become
+        in-flight claims the host greedy can keep packing
+        (scheduler.go:267-283). Topology interaction between the halves is
+        impossible by construction — partition_pods demotes any group whose
+        selectors couple to host-side pods."""
+        from .scheduler import InFlightNodeClaim, _subtract_max
+        host = self._make_host(pods)
+        by_name = {en.name: en for en in host.existing_nodes}
+        for ten in tensor_results.existing_nodes:
+            en = by_name.get(ten.name)
+            if en is None or not ten.pods:
+                continue
+            en.pods.extend(ten.pods)
+            en.requests = res.merge(en.requests,
+                                    *(p.requests() for p in ten.pods))
+        tmpl_idx = {t.nodepool_name: i for i, t in enumerate(host.templates)}
+        for tnc in tensor_results.new_nodeclaims:
+            i = tmpl_idx.get(tnc.template.nodepool_name)
+            if i is None:
+                continue
+            nct = host.templates[i]
+            nc = InFlightNodeClaim(nct, host.topology, host.daemon_overhead[i],
+                                   tnc.instance_type_options)
+            nc.requirements.add(*tnc.requirements.values())
+            nc.pods = list(tnc.pods)
+            nc.requests = res.merge(nc.requests, tnc.requests)
+            host.new_nodeclaims.append(nc)
+            remaining = host.remaining_resources.get(nct.nodepool_name)
+            if remaining is not None:
+                host.remaining_resources[nct.nodepool_name] = _subtract_max(
+                    remaining, nc.instance_type_options)
         return host.solve(pods)
 
     # -- tensor path ----------------------------------------------------------
