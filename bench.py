@@ -16,7 +16,6 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
-import itertools
 import json
 import os
 import sys

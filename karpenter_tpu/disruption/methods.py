@@ -147,9 +147,13 @@ def filter_out_same_type(replacement, candidates: List[Candidate]):
 
     max_price = float("inf")
     for it in replacement.instance_type_options:
-        if it.name in existing_types and \
-                price_by_type.get(it.name, float("inf")) < max_price:
-            max_price = price_by_type[it.name]
+        if it.name in existing_types:
+            # a candidate type with no compatible offering recorded (e.g. a
+            # spot offering just pulled) prices at 0 in the reference's map
+            # lookup, forcing rejection — mirror that, not +inf
+            p = price_by_type.get(it.name, 0.0)
+            if p < max_price:
+                max_price = p
     filtered, err = replacement.remove_instance_types_by_price_and_min_values(
         replacement.requirements, max_price)
     if err is not None or filtered is None:

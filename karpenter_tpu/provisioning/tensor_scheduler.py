@@ -73,13 +73,20 @@ _CATALOG_CACHE: "Dict[tuple, _CatalogEncoding]" = {}
 _CATALOG_CACHE_MAX = 4
 
 
+def _reqs_digest(reqs) -> tuple:
+    return tuple(sorted(
+        (r.key, r.complement, frozenset(r.values), r.greater_than, r.less_than)
+        for r in reqs.values()))
+
+
 def _catalog_cache_key(catalog: List[InstanceType]) -> tuple:
-    """Content key over the facts the encoding depends on. Requirements are
-    assumed stable for a given instance-type NAME (true of real catalogs,
-    where a name identifies a SKU); offerings (zone/captype/price/
-    availability) and capacity churn, so they are part of the key."""
+    """Content key over every fact the encoding depends on: name, requirement
+    set, capacity/allocatable, and offerings. Requirements are keyed
+    explicitly (not assumed stable per name) so a provider mutating an IT's
+    requirement set in place can never reuse stale complement-encoded masks."""
     return tuple(
-        (it.name, tuple(sorted(it.allocatable().items())),
+        (it.name, _reqs_digest(it.requirements),
+         tuple(sorted(it.allocatable().items())),
          tuple(sorted(it.capacity.items())),
          tuple((o.zone, o.capacity_type, o.price, o.available)
                for o in it.offerings))
@@ -205,11 +212,15 @@ class TensorScheduler:
                               ) -> Results:
         """Run the host oracle over the straggler pods with the tensor bulk's
         placements already committed: existing-node usage is seeded so
-        capacity isn't double-booked, and the tensor launch decisions become
+        capacity isn't double-booked, the tensor launch decisions become
         in-flight claims the host greedy can keep packing
-        (scheduler.go:267-283). Topology interaction between the halves is
-        impossible by construction — partition_pods demotes any group whose
-        selectors couple to host-side pods."""
+        (scheduler.go:267-283), and every tensor-placed pod is recorded into
+        the host Topology's domain counts. The recording matters for RETRY
+        pods — tensor-eligible pods the packer failed to place share labels
+        and self-selecting spread/affinity selectors with their tensor-placed
+        groupmates, so the host solve's skew arithmetic must see the tensor
+        half. (Leftover pods can't couple by construction — partition_pods
+        demotes any group whose selectors touch host-side pods.)"""
         from .scheduler import InFlightNodeClaim, _subtract_max
         host = self._make_host(pods)
         by_name = {en.name: en for en in host.existing_nodes}
@@ -220,6 +231,8 @@ class TensorScheduler:
             en.pods.extend(ten.pods)
             en.requests = res.merge(en.requests,
                                     *(p.requests() for p in ten.pods))
+            for p in ten.pods:
+                host.topology.record(p, en.requirements)
         tmpl_idx = {t.nodepool_name: i for i, t in enumerate(host.templates)}
         for tnc in tensor_results.new_nodeclaims:
             i = tmpl_idx.get(tnc.template.nodepool_name)
@@ -231,6 +244,9 @@ class TensorScheduler:
             nc.requirements.add(*tnc.requirements.values())
             nc.pods = list(tnc.pods)
             nc.requests = res.merge(nc.requests, tnc.requests)
+            for p in nc.pods:
+                host.topology.record(p, nc.requirements,
+                                     ALLOW_UNDEFINED_WELL_KNOWN)
             host.new_nodeclaims.append(nc)
             remaining = host.remaining_resources.get(nct.nodepool_name)
             if remaining is not None:

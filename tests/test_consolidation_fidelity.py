@@ -126,6 +126,22 @@ class TestFilterOutSameType:
             FakeReplacement([self.nano, self.small]), candidates)
         assert [it.name for it in surviving] == ["t3a.nano", "t3a.small"]
 
+    def test_missing_price_rejects_same_type(self):
+        # a candidate whose instance type has NO compatible offering left
+        # (e.g. the spot offering was just pulled) prices at 0 in the
+        # reference's map lookup -> maxPrice=0 -> replacement rejected
+        # (multinodeconsolidation.go filterOutSameType; ADVICE r2 low)
+        from karpenter_tpu.utils import resources as res
+        pulled = InstanceType(
+            name="t3a.xlarge",
+            requirements=self.xlarge.requirements,
+            offerings=Offerings([]),
+            capacity=res.parse_list({"cpu": "16", "memory": "16Gi"}))
+        candidates = [FakeCandidate(pulled), FakeCandidate(self.small)]
+        surviving = filter_out_same_type(
+            FakeReplacement([self.nano, self.xlarge]), candidates)
+        assert surviving == []
+
 
 class TestSingleNodeFairness:
     def test_round_robin_across_nodepools(self):

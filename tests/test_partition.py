@@ -131,6 +131,32 @@ class TestPartitionedSolve:
         assert abs(len(r.new_nodeclaims) - len(rh.new_nodeclaims)) <= \
             max(1, len(rh.new_nodeclaims) // 50 + 1)
 
+    def test_remainder_sees_tensor_topology_counts(self):
+        """Retry pods share the spread selector with their tensor-placed
+        groupmates, so the host remainder's skew arithmetic must count the
+        tensor half (ADVICE r2 medium): 5 tensor-placed pods leave zone
+        counts (2,1,1,1); 3 retries must fill the three 1-count zones, not
+        re-spread from zero into (3,2,2,1)."""
+        its = _its()
+        pool = make_nodepool()
+        spreadp = make_pods(5, cpu="100m", labels={"app": "s"},
+                            spread=[spread_zone(key="app", value="s")])
+        ts = TensorScheduler([pool], {"default": its})
+        r0 = ts.solve(list(spreadp))
+        assert not r0.pod_errors and ts.fallback_reason == ""
+        retries = make_pods(3, cpu="100m", labels={"app": "s"},
+                            spread=[spread_zone(key="app", value="s")])
+        r = ts._host_solve_remainder(retries, r0)
+        assert not r.pod_errors
+        counts = {}
+        for nc in r.new_nodeclaims:
+            zr = nc.requirements.get(api_labels.LABEL_TOPOLOGY_ZONE)
+            zones = zr.values_list()
+            assert len(zones) == 1
+            counts[zones[0]] = counts.get(zones[0], 0) + len(nc.pods)
+        assert sum(counts.values()) == 8
+        assert max(counts.values()) - min(counts.values()) <= 1
+
     def test_limits_shared_across_partition(self):
         """NodePool limits consumed by the tensor bulk must constrain the
         host stragglers too."""
