@@ -72,6 +72,26 @@ class StorageClass:
 
 
 @dataclass
+class VolumeAttachmentSpec:
+    node_name: str = ""
+    # VolumeAttachment.spec.source.persistentVolumeName
+    persistent_volume_name: Optional[str] = None
+
+
+@dataclass
+class VolumeAttachment:
+    """storagev1.VolumeAttachment — node termination waits for these to be
+    cleaned up before deleting the instance
+    (node/termination/controller.go:141-150,190-240)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: VolumeAttachmentSpec = field(default_factory=VolumeAttachmentSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
 class CSINodeDriver:
     name: str = ""
     allocatable_count: Optional[int] = None  # attach limit

@@ -30,7 +30,11 @@ from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..kube.store import DELETED, Event, Store
+from ..logging import get_logger
 from ..utils.clock import Clock
+from ..utils.injection import with_controller
+
+log = get_logger("manager")
 
 
 class Result:
@@ -127,7 +131,8 @@ class Manager:
             live = self.store.get(type(obj), obj.metadata.name,
                                   obj.metadata.namespace)
             target = live if live is not None else obj
-            result = controller.reconcile(target)
+            with with_controller(controller.name):
+                result = controller.reconcile(target)
             if result is not None and result.requeue_after is not None:
                 self.requeue(controller, target, result.requeue_after)
             n += 1
@@ -137,7 +142,8 @@ class Manager:
     def tick(self) -> None:
         """Run every singleton once, then drain the fallout."""
         for s in self.singletons:
-            s.reconcile()
+            with with_controller(s.name):
+                s.reconcile()
             self.drain()
 
     def run_until_quiet(self, max_rounds: int = 16) -> None:
@@ -146,10 +152,12 @@ class Manager:
         for _ in range(max_rounds):
             moved = self.drain()
             for s in self.singletons:
-                s.reconcile()
+                with with_controller(s.name):
+                    s.reconcile()
             moved += self.drain()
             if moved == 0:
                 return
+        log.warning("manager did not quiesce", max_rounds=max_rounds)
 
     def advance(self, seconds: float) -> None:
         """Step a FakeClock and fire due timers (test helper)."""

@@ -3,8 +3,15 @@
 Mirrors /root/reference/pkg/controllers/node/termination/: on
 deletionTimestamp, delete owning NodeClaims (controller.go:178-188), taint
 disrupted:NoSchedule (terminator.go:55-92), drain pods in priority groups —
-noncritical non-daemonset first (terminator.go:119-138) — then remove the
-finalizer (controller.go:242-270).
+noncritical non-daemonset first (terminator.go:119-138) — wait for
+VolumeAttachments of drainable pods to detach unless past the termination
+grace deadline (controller.go:141-150,190-240), then remove the finalizer
+(controller.go:242-270).
+
+Eviction runs through a per-pod exponential-backoff queue
+(terminator/eviction.go:49-50,94: 100ms base / 10s cap): a PDB-blocked
+eviction (the Eviction API's 429) backs that pod off instead of hammering
+the budget every pass.
 
 Standalone-runtime deviation: the reference evicts via the Eviction API and
 relies on workload controllers (Deployments) to recreate pods, with the
@@ -21,14 +28,22 @@ from typing import List, Optional
 from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim
 from ..api.objects import Node, Pod
+from ..api.storage import PersistentVolumeClaim, VolumeAttachment
 from ..kube.store import Store
+from ..logging import get_logger
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
 from ..utils import pod as pod_utils
+from ..utils.backoff import ItemBackoff
 from ..utils.clock import Clock
 from .manager import Controller, Result
 
 CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical floor
+
+EVICTION_BASE_DELAY = 0.1   # terminator/eviction.go:49
+EVICTION_MAX_DELAY = 10.0   # terminator/eviction.go:50
+
+log = get_logger("node.termination")
 
 
 class NodeTermination(Controller):
@@ -40,6 +55,10 @@ class NodeTermination(Controller):
         self.store = store
         self.cluster = cluster
         self.clock = clock or store.clock
+        # pod key -> eviction backoff state (the eviction queue's rate
+        # limiter); next_try gates when a blocked pod may be retried
+        self._backoff = ItemBackoff(EVICTION_BASE_DELAY, EVICTION_MAX_DELAY)
+        self._next_try: dict = {}
 
     def reconcile(self, node: Node) -> Optional[Result]:
         if node.metadata.deletion_timestamp is None:
@@ -57,7 +76,18 @@ class NodeTermination(Controller):
         self._annotate_termination_time(node, owning)
         remaining = self._drain(node)
         if remaining:
+            log.debug("draining node", node=node.name, pods_remaining=remaining)
             return Result(requeue_after=1.0)
+        # drained: wait for volumes to detach unless past the TGP deadline
+        # (controller.go:141-150)
+        term_time = self._termination_time(node)
+        if term_time is None or self.clock.now() < term_time:
+            attached = self._attached_volumes(node)
+            if attached:
+                log.debug("waiting on volume detach", node=node.name,
+                          volume_attachments=attached)
+                return Result(requeue_after=1.0)
+        log.info("terminated node", node=node.name)
         self.store.remove_finalizer(node, api_labels.TERMINATION_FINALIZER)
         return None
 
@@ -89,10 +119,10 @@ class NodeTermination(Controller):
     def _drain(self, node: Node) -> int:
         """Evict in priority groups; returns evictable pods still bound.
 
-        PDB-blocked and do-not-disrupt pods are retried (the Eviction API's
-        429 path, terminator/eviction.go) until the TerminationGracePeriod
-        deadline, after which everything is force-deleted
-        (terminator.go:140-177)."""
+        PDB-blocked and do-not-disrupt pods are retried with per-pod
+        exponential backoff (the Eviction API's 429 path,
+        terminator/eviction.go) until the TerminationGracePeriod deadline,
+        after which everything is force-deleted (terminator.go:140-177)."""
         now = self.clock.now()
         term_time = self._termination_time(node)
         expired = term_time is not None and now >= term_time
@@ -122,17 +152,55 @@ class NodeTermination(Controller):
                 if expired:
                     self._force_delete(p)
                     continue
+                key = (p.namespace, p.name, p.uid)
                 if not pod_utils.is_disruptable(p):
                     continue  # do-not-disrupt: wait for the TGP deadline
-                ok, _ = limits.can_evict(p)
+                if self._next_try.get(key, 0.0) > now:
+                    continue  # backing off after a PDB rejection
+                ok, pdb = limits.can_evict(p)
                 if not ok:
-                    continue  # PDB 429: retry next pass
+                    # 429: exponential backoff before the next attempt
+                    delay = self._backoff.next_delay(key)
+                    self._next_try[key] = now + delay
+                    log.debug("eviction blocked by PDB", node=node.name,
+                              pod=f"{p.namespace}/{p.name}",
+                              pdb=f"{pdb.namespace}/{pdb.name}",
+                              retry_in=round(delay, 3))
+                    continue
                 self._evict(p)
+                limits.record_eviction(p)
             # one priority group per pass (terminator.go:119-138)
             break
         return len([p for p in self._pods_on(node) if pod_utils.is_evictable(p)])
 
+    def _attached_volumes(self, node: Node) -> List[str]:
+        """VolumeAttachments that must detach before instance delete
+        (controller.go:190-240): attachments whose PV belongs to a
+        NON-drainable pod are filtered out — they will never detach, so they
+        must not block termination."""
+        vas = self.store.list(
+            VolumeAttachment,
+            predicate=lambda va: va.spec.node_name == node.name)
+        if not vas:
+            return []
+        blocked_pvs = set()
+        for p in self._pods_on(node):
+            if pod_utils.is_evictable(p) and pod_utils.is_disruptable(p):
+                continue
+            for ref in p.spec.volumes:
+                pvc = self.store.get(PersistentVolumeClaim, ref.claim_name,
+                                     p.namespace)
+                if pvc is not None and pvc.spec.volume_name:
+                    blocked_pvs.add(pvc.spec.volume_name)
+        return [va.name for va in vas
+                if va.spec.persistent_volume_name
+                and va.spec.persistent_volume_name not in blocked_pvs]
+
     def _force_delete(self, pod: Pod) -> None:
+        # the pod leaves the node either way: drop its eviction-queue state
+        key = (pod.namespace, pod.name, pod.uid)
+        self._backoff.forget(key)
+        self._next_try.pop(key, None)
         if pod_utils.is_reschedulable(pod):
             pod.spec.node_name = ""
             pod.status.nominated_node_name = ""

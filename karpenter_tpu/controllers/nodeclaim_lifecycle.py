@@ -27,6 +27,7 @@ from ..api.objects import Node
 from ..cloudprovider.types import (CloudProviderError, InsufficientCapacityError,
                                    NodeClaimNotFoundError)
 from ..kube.store import NotFoundError, Store
+from ..logging import get_logger
 from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
 from ..state.cluster import Cluster
 from ..utils.clock import Clock
@@ -34,6 +35,8 @@ from .manager import Controller, Result
 
 REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go registrationTTL
 LAUNCH_RETRY_SECONDS = 15.0
+
+log = get_logger("nodeclaim.lifecycle")
 
 
 class NodeClaimLifecycle(Controller):
@@ -74,15 +77,22 @@ class NodeClaimLifecycle(Controller):
     def _launch(self, nc: NodeClaim) -> Optional[Result]:
         try:
             self.cloud_provider.create(nc)
-        except InsufficientCapacityError:
+        except InsufficientCapacityError as e:
             # launch.go:78-86: ICE deletes the claim so the provisioner retries
+            log.warning("insufficient capacity, deleting nodeclaim",
+                        nodeclaim=nc.name, error=str(e))
             self.store.delete(nc)
             return Result()
         except CloudProviderError as e:
+            log.error("launching nodeclaim failed", nodeclaim=nc.name,
+                      error=str(e))
             nc.conditions.set_false(COND_LAUNCHED, reason="LaunchFailed",
                                     message=str(e), now=self.clock.now())
             self.store.update(nc)
             return Result(requeue_after=LAUNCH_RETRY_SECONDS)
+        log.info("launched nodeclaim", nodeclaim=nc.name,
+                 nodepool=nc.nodepool_name,
+                 provider_id=nc.status.provider_id)
         nc.conditions.set_true(COND_LAUNCHED, reason="Launched",
                                now=self.clock.now())
         self.store.update(nc)
@@ -114,6 +124,7 @@ class NodeClaimLifecycle(Controller):
             node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
         self.store.update(node)
         nc.status.node_name = node.name
+        log.info("registered nodeclaim", nodeclaim=nc.name, node=node.name)
         nc.conditions.set_true(COND_REGISTERED, reason="Registered",
                                now=self.clock.now())
         self.store.update(nc)
@@ -136,6 +147,7 @@ class NodeClaimLifecycle(Controller):
                 return
         node.metadata.labels[api_labels.NODE_INITIALIZED_LABEL_KEY] = "true"
         self.store.update(node)
+        log.info("initialized nodeclaim", nodeclaim=nc.name, node=node.name)
         nc.conditions.set_true(COND_INITIALIZED, reason="Initialized",
                                now=self.clock.now())
         self.store.update(nc)
@@ -145,6 +157,8 @@ class NodeClaimLifecycle(Controller):
     def _liveness(self, nc: NodeClaim) -> Optional[Result]:
         age = self.clock.now() - nc.metadata.creation_timestamp
         if age >= self.registration_ttl:
+            log.warning("nodeclaim not registered within TTL, deleting",
+                        nodeclaim=nc.name, ttl=self.registration_ttl)
             self.store.delete(nc)  # liveness.go:55-62
             return Result()
         return Result(requeue_after=self.registration_ttl - age)

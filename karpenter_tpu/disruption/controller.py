@@ -19,9 +19,11 @@ from ..api.nodeclaim import NodeClaim
 from ..api.objects import Node
 from ..controllers.manager import Result, SingletonController
 from ..kube.store import Store
+from ..logging import get_logger
 from ..provisioning.provisioner import Provisioner
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
+from ..utils.backoff import ItemBackoff
 from ..utils.clock import Clock
 from .helpers import build_disruption_budget_mapping, get_candidates
 from .methods import (Drift, Emptiness, Method, MultiNodeConsolidation,
@@ -32,6 +34,8 @@ from .validation import CONSOLIDATION_TTL_SECONDS, validate_command
 POLL_INTERVAL_SECONDS = 10.0         # controller.go:68
 COMMAND_TIMEOUT_SECONDS = 10 * 60.0  # queue.go commandTimeout
 
+log = get_logger("disruption")
+
 
 @dataclass
 class QueuedCommand:
@@ -39,10 +43,21 @@ class QueuedCommand:
     replacement_names: List[str]
     enqueued_at: float
     provider_ids: List[str] = field(default_factory=list)
+    next_at: float = 0.0  # rate-limited retry gate
+
+    @property
+    def key(self) -> tuple:
+        return tuple(self.provider_ids)
+
+
+QUEUE_BASE_DELAY = 1.0   # orchestration/queue.go:51
+QUEUE_MAX_DELAY = 10.0   # orchestration/queue.go:52
 
 
 class OrchestrationQueue(SingletonController):
-    """orchestration/queue.go:108-281 (deterministic-runtime version)."""
+    """orchestration/queue.go:108-281 (deterministic-runtime version).
+    Commands still waiting on replacements retry with per-item exponential
+    backoff (queue.go:128-132: 1s base / 10s cap) instead of a flat 1s."""
 
     name = "disruption.queue"
 
@@ -52,6 +67,7 @@ class OrchestrationQueue(SingletonController):
         self.cluster = cluster
         self.clock = clock or store.clock
         self.items: List[QueuedCommand] = []
+        self._backoff = ItemBackoff(QUEUE_BASE_DELAY, QUEUE_MAX_DELAY)
 
     def has_any(self, provider_id: str) -> bool:
         return any(provider_id in qc.provider_ids for qc in self.items)
@@ -61,13 +77,24 @@ class OrchestrationQueue(SingletonController):
         self.items.append(qc)
 
     def reconcile(self) -> Optional[Result]:
+        now = self.clock.now()
         remaining: List[QueuedCommand] = []
+        delays: List[float] = []
         for qc in self.items:
+            if qc.next_at > now:
+                remaining.append(qc)
+                delays.append(qc.next_at - now)
+                continue
             state = self._process(qc)
             if state == "wait":
+                delay = self._backoff.next_delay(qc.key)
+                qc.next_at = now + delay
                 remaining.append(qc)
+                delays.append(delay)
+            else:
+                self._backoff.forget(qc.key)
         self.items = remaining
-        return Result(requeue_after=1.0) if remaining else None
+        return Result(requeue_after=min(delays)) if remaining else None
 
     def _process(self, qc: QueuedCommand) -> str:
         if self.clock.now() - qc.enqueued_at > COMMAND_TIMEOUT_SECONDS:
@@ -91,6 +118,10 @@ class OrchestrationQueue(SingletonController):
 
     def _rollback(self, qc: QueuedCommand) -> None:
         """queue.go:181-223: untaint + unmark so the nodes return to service."""
+        log.warning("disruption command failed, rolling back",
+                    reason=qc.command.reason,
+                    candidates=[c.state_node.name()
+                                for c in qc.command.candidates])
         for c in qc.command.candidates:
             node = self.store.get(Node, c.state_node.name())
             if node is not None:
@@ -188,6 +219,11 @@ class DisruptionController(SingletonController):
         """controller.go:196-246: taint -> launch replacements -> mark ->
         enqueue."""
         self.last_command = cmd
+        log.info("disrupting nodes",
+                 reason=cmd.reason, decision=cmd.decision,
+                 consolidation_type=cmd.consolidation_type,
+                 candidates=[c.state_node.name() for c in cmd.candidates],
+                 replacements=len(cmd.replacements))
         from ..metrics import registry as metrics
         metrics.DISRUPTION_DECISIONS.inc({
             "decision": cmd.decision, "reason": cmd.reason,

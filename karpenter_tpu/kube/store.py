@@ -44,7 +44,9 @@ class NotFoundError(Exception):
 
 # Cluster-scoped kinds: namespace ignored in keys, the way the API server
 # treats Node/NodeClaim/NodePool.
-CLUSTER_SCOPED_KINDS = frozenset({"Node", "NodeClaim", "NodePool", "NodeClass"})
+CLUSTER_SCOPED_KINDS = frozenset({"Node", "NodeClaim", "NodePool", "NodeClass",
+                                  "PersistentVolume", "StorageClass", "CSINode",
+                                  "VolumeAttachment"})
 
 
 def _ns(kind: type, namespace: str) -> str:
@@ -59,8 +61,15 @@ class Store:
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
         self._objs: Dict[type, Dict[Tuple[str, str], object]] = {}
+        self._by_uid: Dict[type, Dict[str, object]] = {}
         self._watchers: List[Callable[[Event], None]] = []
         self._rv = 0
+
+    def get_by_uid(self, kind: type, uid: str) -> Optional[object]:
+        """O(1) UID lookup (a field-indexer analog, operator.go:177-206):
+        deleting-node pod carryover resolves pods by UID per reconcile, so a
+        scan here would be O(pods) per deleting node."""
+        return self._by_uid.get(kind, {}).get(uid)
 
     # -- watch --------------------------------------------------------------
 
@@ -88,6 +97,8 @@ class Store:
             obj.metadata.creation_timestamp = self.clock.now()
         self._bump(obj)
         coll[k] = obj
+        if obj.metadata.uid:
+            self._by_uid.setdefault(kind, {})[obj.metadata.uid] = obj
         self._notify(ADDED, obj)
         return obj
 
@@ -115,6 +126,8 @@ class Store:
             raise NotFoundError(f"{kind.__name__} {k} not found")
         self._bump(obj)
         coll[k] = obj
+        if obj.metadata.uid:
+            self._by_uid.setdefault(kind, {})[obj.metadata.uid] = obj
         self._notify(MODIFIED, obj)
         return obj
 
@@ -142,6 +155,7 @@ class Store:
                 self._notify(MODIFIED, live)
             return
         del coll[k]
+        self._by_uid.get(kind, {}).pop(live.metadata.uid, None)
         self._notify(DELETED, live)
 
     def remove_finalizer(self, obj, finalizer: str) -> None:
@@ -152,6 +166,7 @@ class Store:
             k = _key(obj)
             if k in coll:
                 del coll[k]
+                self._by_uid.get(type(obj), {}).pop(obj.metadata.uid, None)
                 self._notify(DELETED, obj)
             return
         self.update(obj)

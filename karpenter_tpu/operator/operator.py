@@ -24,27 +24,35 @@ from ..controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
 from ..controllers.nodeclaim_lifecycle import NodeClaimLifecycle
 from ..controllers.nodepool_aux import (NodePoolCounter, NodePoolHash,
                                         NodePoolReadiness, NodePoolValidation)
+from ..cloudprovider.metrics import decorate as decorate_cloud_provider
 from ..disruption.controller import DisruptionController, OrchestrationQueue
 from ..events.recorder import Recorder
 from ..kube.store import Store
-from ..provisioning.provisioner import Binder, PodTrigger, Provisioner
+from ..logging import configure as configure_logging, get_logger
+from ..provisioning.provisioner import Binder, NodeDeletionTrigger, PodTrigger, Provisioner
 from ..state.cluster import Cluster
 from ..state.informers import wire_informers
 from ..utils.clock import Clock
 from .options import Options
+from .server import ServingGroup
 
 
 class Operator:
     def __init__(self, options: Optional[Options] = None, cloud_provider=None,
                  clock: Optional[Clock] = None):
         self.options = options or Options()
+        configure_logging(self.options.log_level)
+        self.log = get_logger("operator")
         self.clock = clock or Clock()
         self.store = Store(self.clock)
         self.cluster = Cluster(self.store, self.clock)
         wire_informers(self.store, self.cluster)
-        self.cloud_provider = cloud_provider or KwokCloudProvider(store=self.store)
+        # every SPI call is timed + error-counted (cloudprovider/metrics.py)
+        self.cloud_provider = decorate_cloud_provider(
+            cloud_provider or KwokCloudProvider(store=self.store))
         self.recorder = Recorder(self.clock)
         self.manager = Manager(self.store, self.clock)
+        self.serving: Optional[ServingGroup] = None
 
         gates = self.options.gates
         scheduler_factory = None
@@ -71,6 +79,7 @@ class Operator:
         controllers = [
             self.provisioner,
             PodTrigger(self.provisioner),
+            NodeDeletionTrigger(self.provisioner),
             Binder(self.store, self.cluster, self.provisioner),
             self.queue,
             self.disruption,
@@ -99,6 +108,26 @@ class Operator:
                                           self.cloud_provider, self.clock))
         self.manager.register(*controllers)
 
+    # -- serving (operator.go:142-175) --------------------------------------
+
+    def start_serving(self) -> ServingGroup:
+        """Start the /metrics + healthz/readyz HTTP servers on the
+        configured ports (port 0 = ephemeral, for tests)."""
+        if self.serving is None:
+            self.serving = ServingGroup(
+                self.options.metrics_port, self.options.health_probe_port,
+                healthy=lambda: True,
+                ready=lambda: self.cluster.synced()).start()
+            self.log.info("serving metrics and health probes",
+                          metrics_port=self.serving.metrics_port,
+                          health_port=self.serving.health_port)
+        return self.serving
+
+    def stop_serving(self) -> None:
+        if self.serving is not None:
+            self.serving.stop()
+            self.serving = None
+
     # -- drive --------------------------------------------------------------
 
     def step(self) -> None:
@@ -107,9 +136,17 @@ class Operator:
 
     def run(self, stop=None, tick_seconds: float = 1.0) -> None:
         """Real-time loop (kwok/main.go:33-48 equivalent)."""
-        while stop is None or not stop():
-            self.manager.run_until_quiet()
-            time.sleep(tick_seconds)
+        self.log.info("starting operator",
+                      cluster_name=self.options.cluster_name,
+                      solver_backend=self.options.solver_backend,
+                      feature_gates=self.options.feature_gates)
+        self.start_serving()
+        try:
+            while stop is None or not stop():
+                self.manager.run_until_quiet()
+                time.sleep(tick_seconds)
+        finally:
+            self.stop_serving()
 
     def metrics_text(self) -> str:
         from ..metrics.registry import REGISTRY

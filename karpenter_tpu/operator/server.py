@@ -1,0 +1,97 @@
+"""HTTP serving: /metrics exposition + health probes.
+
+Mirrors /root/reference/pkg/operator/operator.go:142-175: a metrics endpoint
+serving the Prometheus registry on Options.metrics_port, and healthz/readyz
+probe endpoints on Options.health_probe_port. Stdlib ThreadingHTTPServer in
+daemon threads — the operator loop stays single-threaded; the handlers only
+read (registry text render, health predicate)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..metrics.registry import REGISTRY
+
+
+def _handler(routes: dict) -> type:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            path = self.path.split("?", 1)[0]
+            fn = routes.get(path)
+            if fn is None:
+                self.send_error(404)
+                return
+            try:
+                status, content_type, body = fn()
+            except Exception as exc:  # probe handlers must never kill serving
+                status, content_type, body = 500, "text/plain", str(exc)
+            data = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):  # scrape spam stays out of the logs
+            pass
+
+    return Handler
+
+
+class _Server:
+    def __init__(self, port: int, routes: dict):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _handler(routes))
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]  # resolved when port=0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class ServingGroup:
+    """Metrics server + health-probe server (operator.go:142-175). Checks
+    default to always-healthy; the operator wires liveness to the manager.
+    Port 0 binds an ephemeral port (tests); resolved ports are exposed as
+    metrics_port/health_port."""
+
+    def __init__(self, metrics_port: int, health_probe_port: int,
+                 healthy: Callable[[], bool] = lambda: True,
+                 ready: Callable[[], bool] = lambda: True,
+                 registry=REGISTRY):
+        def probe(check: Callable[[], bool]):
+            def fn():
+                if check():
+                    return 200, "text/plain", "ok"
+                return 503, "text/plain", "unhealthy"
+            return fn
+
+        self._metrics = _Server(metrics_port, {
+            "/metrics": lambda: (200, "text/plain; version=0.0.4",
+                                 registry.expose()),
+        })
+        self._health = _Server(health_probe_port, {
+            "/healthz": probe(healthy),
+            "/readyz": probe(ready),
+        })
+        self.metrics_port = self._metrics.port
+        self.health_port = self._health.port
+
+    def start(self) -> "ServingGroup":
+        self._metrics.start()
+        self._health.start()
+        return self
+
+    def stop(self) -> None:
+        self._metrics.stop()
+        self._health.stop()

@@ -23,6 +23,8 @@ from ..api.nodepool import NodePool, order_by_weight
 from ..api.objects import Node, Pod
 from ..controllers.manager import Controller, Result, SingletonController
 from ..kube.store import Store
+from ..logging import get_logger
+from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
 from ..utils import pod as pod_utils
 from ..utils.clock import Clock
@@ -32,6 +34,8 @@ from .topology import ClusterView
 
 BATCH_IDLE_SECONDS = 1.0   # options.go:99 batchIdleDuration
 BATCH_MAX_SECONDS = 10.0   # options.go:100 batchMaxDuration
+
+log = get_logger("provisioner")
 
 
 class Batcher:
@@ -111,6 +115,31 @@ class PodTrigger(Controller):
             self.provisioner.trigger()
 
 
+class NodeDeletionTrigger(Controller):
+    """Node watch -> batcher trigger for disrupted/deleting nodes
+    (provisioning/controller.go:92-113): pods on a node that starts
+    disrupting must re-provision without waiting for an unrelated pod
+    event. Requeues every 10s while the node stays disrupted, matching the
+    reference's RequeueAfter loop."""
+
+    name = "provisioner.trigger.node"
+    kinds = (Node,)
+
+    def __init__(self, provisioner: "Provisioner"):
+        self.provisioner = provisioner
+
+    def reconcile(self, node) -> Optional[Result]:
+        live = self.provisioner.store.get(Node, node.name)
+        if live is None:
+            return None
+        disrupted = any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT)
+                        for t in live.spec.taints)
+        if not disrupted and live.metadata.deletion_timestamp is None:
+            return None
+        self.provisioner.trigger()
+        return Result(requeue_after=10.0)
+
+
 class Provisioner(SingletonController):
     name = "provisioner"
 
@@ -133,6 +162,7 @@ class Provisioner(SingletonController):
         # pod key -> nodeclaim name, consumed by the Binder
         self.nominations: Dict[str, str] = {}
         self.last_results = None
+        self.last_scheduler = None
         # --enable-profiling analog (operator.go:159-175): jax profiler trace
         # captured around each solve when set
         self.profile_dir: Optional[str] = None
@@ -182,6 +212,7 @@ class Provisioner(SingletonController):
                     deleting_pods.append(p)
         from ..metrics import registry as metrics
         done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
+        started = self.clock.now()
         if self.profile_dir:
             import jax
             with jax.profiler.trace(self.profile_dir):
@@ -193,13 +224,24 @@ class Provisioner(SingletonController):
         self.last_results = results
         self._create_nodeclaims(results)
         self._record(results)
+        ts = self.last_scheduler
+        log.info("scheduled pod batch",
+                 pods=len(pods) + len(deleting_pods),
+                 nodeclaims=len(results.new_nodeclaims),
+                 existing_nodes=sum(1 for en in results.existing_nodes
+                                    if en.pods),
+                 unschedulable=len(results.pod_errors),
+                 duration=round(self.clock.now() - started, 4),
+                 tensor_pods=getattr(ts, "partition", (0, 0))[0],
+                 host_pods=getattr(ts, "partition", (0, 0))[1],
+                 fallback_reason=getattr(ts, "fallback_reason", ""))
+        if results.pod_errors:
+            for uid, err in list(results.pod_errors.items())[:10]:
+                log.debug("pod failed to schedule", pod_uid=uid, error=err)
         return None
 
     def _pod_by_uid(self, uid: str) -> Optional[Pod]:
-        for p in self.store.list(Pod):
-            if p.uid == uid:
-                return p
-        return None
+        return self.store.get_by_uid(Pod, uid)
 
     def schedule(self, pods: List[Pod]):
         # exclude deleting nodes from pack targets (NewScheduler filters them)
@@ -221,6 +263,7 @@ class Provisioner(SingletonController):
             nodepools, instance_types, state_nodes,
             self.cluster.daemonset_pod_list(),
             StateClusterView(self.store, self.cluster))
+        self.last_scheduler = ts
         return ts.solve(pods)
 
     def _create_nodeclaims(self, results) -> None:

@@ -27,6 +27,11 @@ class Limits:
     def __init__(self, pdbs: List[PodDisruptionBudget], pods: List[Pod]):
         self.pdbs = pdbs
         self.pods = pods
+        # evictions granted THROUGH this Limits instance, counted against
+        # each PDB's headroom: the API server sees each eviction reflected in
+        # PDB status before the next one, so a one-shot snapshot must track
+        # its own grants to avoid over-evicting within a single drain pass
+        self._granted: dict = {}
 
     def _matching_pods(self, pdb: PodDisruptionBudget) -> List[Pod]:
         sel = pdb.spec.selector
@@ -58,6 +63,18 @@ class Limits:
             sel = pdb.spec.selector
             if sel is None or not sel.matches(pod.labels):
                 continue
-            if self.disruptions_allowed(pdb) <= 0:
+            allowed = self.disruptions_allowed(pdb) - \
+                self._granted.get(id(pdb), 0)
+            if allowed <= 0:
                 return False, pdb
         return True, None
+
+    def record_eviction(self, pod: Pod) -> None:
+        """Count a granted eviction against every matching PDB so the next
+        can_evict in the same pass sees the reduced headroom."""
+        for pdb in self.pdbs:
+            if pdb.namespace != pod.namespace:
+                continue
+            sel = pdb.spec.selector
+            if sel is not None and sel.matches(pod.labels):
+                self._granted[id(pdb)] = self._granted.get(id(pdb), 0) + 1

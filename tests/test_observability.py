@@ -1,0 +1,184 @@
+"""Observability floor: structured logging, /metrics + health HTTP serving,
+cloudprovider metrics decorator (VERDICT r2 missing #1-#3).
+
+Reference shapes: operator/logging/logging.go:55-124, operator.go:142-175,
+cloudprovider/metrics/cloudprovider.go:33-272."""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import logging as klog
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.metrics import (ERRORS_TOTAL, METHOD_DURATION,
+                                                 decorate)
+from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.operator.server import ServingGroup
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.injection import controller_name, with_controller
+
+from factories import make_nodepool, make_pod, make_pods
+from test_operator import settle
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestLogging:
+    def test_json_line_structure(self):
+        buf = io.StringIO()
+        klog.configure("info", stream=buf)
+        klog.get_logger("provisioner").info("scheduled pod batch",
+                                            pods=12, nodeclaims=3)
+        rec = json.loads(buf.getvalue().strip())
+        assert rec["level"] == "INFO"
+        assert rec["logger"] == "karpenter.provisioner"
+        assert rec["message"] == "scheduled pod batch"
+        assert rec["pods"] == 12 and rec["nodeclaims"] == 3
+        assert "time" in rec
+
+    def test_level_filtering(self):
+        buf = io.StringIO()
+        klog.configure("error", stream=buf)
+        log = klog.get_logger("x")
+        log.info("quiet")
+        log.debug("quieter")
+        assert buf.getvalue() == ""
+        log.error("loud")
+        assert json.loads(buf.getvalue())["level"] == "ERROR"
+
+    def test_with_values_binds_context(self):
+        buf = io.StringIO()
+        klog.configure("info", stream=buf)
+        log = klog.get_logger("y").with_values(node="n-1")
+        log.info("terminated node")
+        assert json.loads(buf.getvalue())["node"] == "n-1"
+
+    def test_nop_logger_silent(self):
+        buf = io.StringIO()
+        klog.configure("debug", stream=buf)
+        klog.NOP.error("should vanish")
+        assert buf.getvalue() == ""
+
+
+class TestInjection:
+    def test_controller_name_scoped(self):
+        assert controller_name() == ""
+        with with_controller("provisioner"):
+            assert controller_name() == "provisioner"
+            with with_controller("inner"):
+                assert controller_name() == "inner"
+            assert controller_name() == "provisioner"
+        assert controller_name() == ""
+
+
+class TestServing:
+    def test_metrics_endpoint_serves_registry(self):
+        reg = Registry()
+        reg.counter("test_serving_total", "t").inc()
+        sg = ServingGroup(0, 0, registry=reg).start()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{sg.metrics_port}/metrics")
+            assert status == 200
+            assert "test_serving_total 1.0" in body
+        finally:
+            sg.stop()
+
+    def test_health_probes(self):
+        ready = {"ok": False}
+        sg = ServingGroup(0, 0, ready=lambda: ready["ok"]).start()
+        try:
+            status, body = _get(f"http://127.0.0.1:{sg.health_port}/healthz")
+            assert status == 200 and body == "ok"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{sg.health_port}/readyz")
+            assert ei.value.code == 503
+            ready["ok"] = True
+            status, _ = _get(f"http://127.0.0.1:{sg.health_port}/readyz")
+            assert status == 200
+        finally:
+            sg.stop()
+
+    def test_unknown_path_404(self):
+        sg = ServingGroup(0, 0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{sg.metrics_port}/nope")
+            assert ei.value.code == 404
+        finally:
+            sg.stop()
+
+
+class TestOperatorServing:
+    def test_operator_e2e_metrics_over_http(self):
+        """VERDICT done-criterion: curl :PORT/metrics works against a live
+        operator after a solve."""
+        op = Operator(options=Options(metrics_port=0, health_probe_port=0),
+                      clock=FakeClock())
+        op.store.create(make_nodepool(name="default"))
+        for p in make_pods(3, cpu="500m"):
+            op.store.create(p)
+        settle(op)
+        sg = op.start_serving()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{sg.metrics_port}/metrics")
+            assert status == 200
+            assert "karpenter_nodeclaims_created_total" in body
+            assert "karpenter_cloudprovider_duration_seconds" in body
+            status, _ = _get(f"http://127.0.0.1:{sg.health_port}/healthz")
+            assert status == 200
+        finally:
+            op.stop_serving()
+
+    def test_solve_logs_summary_line(self):
+        op = Operator(clock=FakeClock())
+        buf = io.StringIO()
+        klog.configure("info", stream=buf)  # after Operator's configure
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        batch = [l for l in lines if l["message"] == "scheduled pod batch"]
+        assert batch, lines
+        assert batch[0]["pods"] >= 1
+        assert batch[0]["logger"] == "karpenter.provisioner"
+        assert "fallback_reason" in batch[0]
+
+
+class TestCloudProviderDecorator:
+    def test_spi_calls_timed_with_controller_label(self):
+        cp = decorate(FakeCloudProvider())
+        labels = {"controller": "provisioner", "method": "get_instance_types",
+                  "provider": "fake"}
+        before = METHOD_DURATION.count(labels)
+        with with_controller("provisioner"):
+            cp.get_instance_types(make_nodepool())
+        assert METHOD_DURATION.count(labels) == before + 1
+
+    def test_typed_errors_counted_and_propagated(self):
+        cp = decorate(FakeCloudProvider())
+        cp.next_get_err = NodeClaimNotFoundError("gone")
+        labels = {"controller": "", "method": "get", "provider": "fake",
+                  "error": "NodeClaimNotFoundError"}
+        before = ERRORS_TOTAL.value(labels)
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.get("fake://nope")
+        assert ERRORS_TOTAL.value(labels) == before + 1
+
+    def test_passthrough_attributes(self):
+        inner = FakeCloudProvider()
+        cp = decorate(inner)
+        cp.next_create_err = ValueError("boom")   # set through the proxy
+        assert inner.next_create_err is not None
+        assert cp.name == "fake"
+        assert cp.created is inner.created
