@@ -162,6 +162,40 @@ class KwokCloudProvider(CloudProvider):
             self.store.create(node)
         return nodeclaim
 
+    def resync(self) -> int:
+        """Rebuild the simulated fleet after a store restore (restart =
+        resync, cluster.go:96-150): kwok's "cloud" is the store's Node
+        objects, so instances survive an operator restart the way real cloud
+        instances do. Returns instances recovered."""
+        if self.store is None:
+            return 0
+        claims = {nc.status.provider_id: nc
+                  for nc in self.store.list(NodeClaim)
+                  if nc.status.provider_id}
+        hi = 0
+        n = 0
+        for node in self.store.list(Node):
+            pid = node.spec.provider_id
+            if not pid or not pid.startswith("kwok://"):
+                continue
+            try:
+                hi = max(hi, int(pid.rsplit("-", 1)[1]))
+            except (ValueError, IndexError):
+                pass
+            nc = claims.get(pid)
+            if nc is None:
+                # claim-less instance: garbagecollection only sees instances
+                # in self.created and claims in the store, so an orphan node
+                # would otherwise survive forever as phantom capacity — reap
+                # it here, the way GC reaps untracked cloud instances
+                self.store.delete(node)
+                continue
+            if pid not in self.created:
+                self.created[pid] = (nc, node)
+                n += 1
+        self._seq = itertools.count(hi + 1)
+        return n
+
     def delete(self, nodeclaim: NodeClaim) -> None:
         pid = nodeclaim.status.provider_id
         if pid not in self.created:

@@ -103,6 +103,7 @@ class NodePoolValidation(Controller):
         self.store = store
 
     def reconcile(self, pool: NodePool) -> Optional[Result]:
+        from ..api.validation import validate_nodeclaim_template_spec
         errs = []
         for b in pool.spec.disruption.budgets:
             v = b.nodes.strip()
@@ -110,9 +111,9 @@ class NodePoolValidation(Controller):
                 v = v[:-1]
             if not v.isdigit():
                 errs.append(f"invalid budget nodes {b.nodes!r}")
-        for r in pool.spec.template.spec.requirements:
-            if r.key in api_labels.RESTRICTED_LABELS:
-                errs.append(f"restricted requirement key {r.key}")
+        # the webhook battery (nodeclaim_validation.go:62-151): operators,
+        # restricted labels, qualified names, minValues, Gt/Lt, taint shape
+        errs.extend(validate_nodeclaim_template_spec(pool.spec.template.spec))
         status = "False" if errs else "True"
         self._set_condition(pool, COND_VALIDATION_SUCCEEDED, status,
                             "; ".join(errs))

@@ -158,6 +158,65 @@ class Store:
         self._by_uid.get(kind, {}).pop(live.metadata.uid, None)
         self._notify(DELETED, live)
 
+    # -- durability ---------------------------------------------------------
+    #
+    # The reference's durable state is the Kubernetes API server; restart =
+    # resync from it (state/cluster.go:96-150). Standalone, the store IS the
+    # API server, so it owns durability: save() snapshots every collection
+    # atomically; load() replays a snapshot through the watch fan-out so
+    # informers rebuild cluster state and controllers re-reconcile, exactly
+    # like a watch-stream resync.
+
+    _REPLAY_ORDER = ("NodePool", "NodeClass", "StorageClass",
+                     "PersistentVolume", "PersistentVolumeClaim", "CSINode",
+                     "NodeClaim", "Node", "PodDisruptionBudget")
+
+    def save(self, path: str) -> int:
+        """Atomic snapshot (tmp + rename). Returns objects written."""
+        import os
+        import pickle
+        import tempfile
+        data = {"objs": self._objs, "rv": self._rv}
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".store-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(data, f)
+                f.flush()
+                os.fsync(f.fileno())  # a crash must not truncate the snapshot
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return sum(len(c) for c in self._objs.values())
+
+    def load(self, path: str) -> int:
+        """Replay a snapshot: existing keys are kept (live state wins), new
+        objects are announced as ADDED in dependency order (pools/claims/
+        nodes before pods) so the cluster cache rebuilds coherently. Returns
+        objects restored."""
+        import pickle
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        self._rv = max(self._rv, data["rv"])
+        kinds = sorted(data["objs"],
+                       key=lambda k: (self._REPLAY_ORDER.index(k.__name__)
+                                      if k.__name__ in self._REPLAY_ORDER
+                                      else len(self._REPLAY_ORDER)))
+        n = 0
+        for kind in kinds:
+            coll = self._objs.setdefault(kind, {})
+            for k, obj in data["objs"][kind].items():
+                if k in coll:
+                    continue
+                coll[k] = obj
+                if obj.metadata.uid:
+                    self._by_uid.setdefault(kind, {})[obj.metadata.uid] = obj
+                self._notify(ADDED, obj)
+                n += 1
+        return n
+
     def remove_finalizer(self, obj, finalizer: str) -> None:
         if finalizer in obj.metadata.finalizers:
             obj.metadata.finalizers.remove(finalizer)

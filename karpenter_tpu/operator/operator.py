@@ -108,6 +108,29 @@ class Operator:
                                           self.cloud_provider, self.clock))
         self.manager.register(*controllers)
 
+        # restart = resync (cluster.go:96-150): replay the durable snapshot
+        # through the watch fan-out AFTER controllers are registered, so the
+        # cluster cache rebuilds and every object re-reconciles
+        self._saved_rv = -1
+        if self.options.state_file:
+            import os
+            if os.path.exists(self.options.state_file):
+                try:
+                    n = self.store.load(self.options.state_file)
+                except Exception as exc:
+                    # a corrupt snapshot must not crash-loop the operator;
+                    # restart = resync means booting fresh is always legal
+                    self.log.error("snapshot unreadable, booting fresh",
+                                   file=self.options.state_file,
+                                   error=str(exc))
+                else:
+                    resync = getattr(self.cloud_provider, "resync", None)
+                    recovered = resync() if resync is not None else 0
+                    self.log.info("restored state from snapshot",
+                                  file=self.options.state_file, objects=n,
+                                  cloud_instances=recovered,
+                                  synced=self.cluster.synced())
+
     # -- serving (operator.go:142-175) --------------------------------------
 
     def start_serving(self) -> ServingGroup:
@@ -128,6 +151,13 @@ class Operator:
             self.serving.stop()
             self.serving = None
 
+    def checkpoint(self) -> None:
+        """Persist the store when a state file is configured; no-op while
+        nothing changed since the last save (resourceVersion watermark)."""
+        if self.options.state_file and self.store._rv != self._saved_rv:
+            self.store.save(self.options.state_file)
+            self._saved_rv = self.store._rv
+
     # -- drive --------------------------------------------------------------
 
     def step(self) -> None:
@@ -144,8 +174,10 @@ class Operator:
         try:
             while stop is None or not stop():
                 self.manager.run_until_quiet()
+                self.checkpoint()
                 time.sleep(tick_seconds)
         finally:
+            self.checkpoint()
             self.stop_serving()
 
     def metrics_text(self) -> str:

@@ -48,6 +48,10 @@ class Options:
     cpu_requests: str = ""  # reserved
     cluster_name: str = "karpenter-tpu"
     enable_profiling: bool = False
+    # durable-state snapshot path ("" = in-memory only). The reference's
+    # durable state is the apiserver; standalone, the store checkpoints here
+    # and restores on boot (restart = resync, state/cluster.go:96-150)
+    state_file: str = ""
     # TPU solver knobs (new surface: no reference analog)
     solver_backend: str = "tensor"   # tensor | sidecar
     solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint

@@ -1,0 +1,160 @@
+"""Durable state: store snapshot/replay + kill-and-restart resync
+(VERDICT r2 #7; reference invariant: restart = resync from the apiserver,
+state/cluster.go:96-150)."""
+
+import pytest
+
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods
+from test_operator import settle
+
+
+class TestSnapshotRoundtrip:
+    def test_save_load_preserves_objects_and_uids(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        s1 = Store(FakeClock())
+        pod = make_pod(cpu="100m")
+        s1.create(pod)
+        s1.create(make_nodepool(name="np"))
+        assert s1.save(path) == 2
+
+        s2 = Store(FakeClock())
+        events = []
+        s2.watch(lambda ev: events.append((ev.type, type(ev.obj).__name__)))
+        assert s2.load(path) == 2
+        restored = s2.get(Pod, pod.name, pod.namespace)
+        assert restored is not None and restored.uid == pod.uid
+        assert s2.get_by_uid(Pod, pod.uid) is restored
+        # replay announced as ADDED, dependency order (pool before pod)
+        assert ("ADDED", "NodePool") in events and ("ADDED", "Pod") in events
+        assert events.index(("ADDED", "NodePool")) < \
+            events.index(("ADDED", "Pod"))
+
+    def test_load_keeps_live_state_on_conflict(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        s1 = Store(FakeClock())
+        pod = make_pod(cpu="100m", name="same")
+        s1.create(pod)
+        s1.save(path)
+        s2 = Store(FakeClock())
+        newer = make_pod(cpu="200m", name="same")
+        s2.create(newer)
+        s2.load(path)
+        assert s2.get(Pod, "same", "default") is newer
+
+
+class TestSnapshotResilience:
+    def test_corrupt_snapshot_boots_fresh(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        with open(path, "wb") as f:
+            f.write(b"\x00garbage")
+        op = Operator(options=Options(state_file=path), clock=FakeClock())
+        # restart = resync: booting fresh is always legal
+        assert op.store.list(Pod) == []
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        assert op.store.list(Node)
+
+    def test_checkpoint_skips_when_unchanged(self, tmp_path):
+        import os
+        path = str(tmp_path / "state.bin")
+        op = Operator(options=Options(state_file=path), clock=FakeClock())
+        op.store.create(make_nodepool(name="default"))
+        op.checkpoint()
+        mtime = os.path.getmtime(path)
+        os.utime(path, (mtime - 100, mtime - 100))
+        op.checkpoint()  # rv unchanged -> no rewrite
+        assert os.path.getmtime(path) == mtime - 100
+
+    def test_resync_reaps_orphan_kwok_nodes(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        op1 = Operator(options=Options(state_file=path), clock=FakeClock())
+        op1.store.create(make_nodepool(name="default"))
+        op1.store.create(make_pod(cpu="500m"))
+        settle(op1)
+        node = op1.store.list(Node)[0]
+        # claim vanishes behind the snapshot's back (divergent snapshot)
+        nc = op1.store.list(NodeClaim)[0]
+        nc.metadata.finalizers.clear()
+        op1.store.delete(nc)
+        op1.checkpoint()
+        clock2 = FakeClock()
+        clock2.step(op1.clock.now())
+        op2 = Operator(options=Options(state_file=path), clock=clock2)
+        # resync starts the reap: the node is terminating (finalizer-gated)
+        assert op2.store.get(Node, node.name).metadata.deletion_timestamp \
+            is not None
+        settle(op2)
+        # phantom instance drained away, not left as packable capacity
+        assert op2.store.get(Node, node.name) is None
+
+
+class TestKillAndRestart:
+    def test_restart_preserves_cluster_and_resumes(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        op1 = Operator(options=Options(state_file=path), clock=FakeClock())
+        op1.store.create(make_nodepool(name="default"))
+        for p in make_pods(3, cpu="500m"):
+            op1.store.create(p)
+        settle(op1)
+        claims1 = {nc.name for nc in op1.store.list(NodeClaim)}
+        nodes1 = {n.name for n in op1.store.list(Node)}
+        bound1 = {p.name: p.spec.node_name for p in op1.store.list(Pod)}
+        assert claims1 and nodes1 and all(bound1.values())
+        op1.checkpoint()
+
+        # kill: op1 is gone; a fresh process restores from the snapshot
+        clock2 = FakeClock()
+        clock2.step(op1.clock.now())
+        op2 = Operator(options=Options(state_file=path), clock=clock2)
+        assert {nc.name for nc in op2.store.list(NodeClaim)} == claims1
+        assert {n.name for n in op2.store.list(Node)} == nodes1
+        assert {p.name: p.spec.node_name
+                for p in op2.store.list(Pod)} == bound1
+        # Synced()-style invariant holds immediately after restore
+        assert op2.cluster.synced()
+
+        # controllers resume without wrecking state: GC must NOT reap the
+        # restored claims (the kwok fleet resynced from the store)
+        settle(op2)
+        assert {nc.name for nc in op2.store.list(NodeClaim)} == claims1
+        assert {n.name for n in op2.store.list(Node)} == nodes1
+
+        # and the runtime keeps working: a new pod packs onto the restored
+        # node's remaining capacity (existing-node state survived)
+        newpod = make_pod(cpu="100m")
+        op2.store.create(newpod)
+        settle(op2)
+        assert op2.store.get(Pod, newpod.name, newpod.namespace).spec.node_name
+
+    def test_restart_resumes_inflight_termination(self, tmp_path):
+        """A node mid-drain at crash time finishes terminating after
+        restart — deletionTimestamp/finalizers are part of the snapshot."""
+        path = str(tmp_path / "state.bin")
+        op1 = Operator(options=Options(state_file=path), clock=FakeClock())
+        op1.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        op1.store.create(pod)
+        settle(op1)
+        node = op1.store.list(Node)[0]
+        op1.store.delete(node)  # sets deletionTimestamp (finalizer held)
+        op1.checkpoint()
+
+        clock2 = FakeClock()
+        clock2.step(op1.clock.now())
+        op2 = Operator(options=Options(state_file=path), clock=clock2)
+        restored = op2.store.get(Node, node.name)
+        assert restored is not None
+        assert restored.metadata.deletion_timestamp is not None
+        settle(op2)
+        # drain completed: old node gone, pod re-provisioned onto a new one
+        assert op2.store.get(Node, node.name) is None
+        live = op2.store.get(Pod, pod.name, pod.namespace)
+        assert live.spec.node_name and live.spec.node_name != node.name

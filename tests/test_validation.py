@@ -1,0 +1,178 @@
+"""Requirement/taint validation battery (VERDICT r2 #10).
+
+Accept/reject table mirrors /root/reference/pkg/apis/v1/
+nodeclaim_validation.go:62-151 (ValidateRequirement + validateTaints) and
+the webhook behaviors its suite pins."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Taint
+from karpenter_tpu.api.validation import (is_qualified_name,
+                                          is_valid_label_value,
+                                          validate_requirement,
+                                          validate_requirements,
+                                          validate_taints)
+
+from factories import make_nodepool
+
+
+def req(key, op, values=(), min_values=None):
+    r = NodeSelectorRequirement(key=key, operator=op, values=tuple(values))
+    if min_values is not None:
+        # NodeClaim-side selector shape carries min_values
+        class R:
+            pass
+        rr = R()
+        rr.key, rr.operator, rr.values, rr.min_values = key, op, tuple(values), min_values
+        return rr
+    return r
+
+
+ACCEPT = [
+    req(api_labels.LABEL_INSTANCE_TYPE, "In", ["m5.large"]),
+    req(api_labels.LABEL_TOPOLOGY_ZONE, "In", ["us-west-2a", "us-west-2b"]),
+    req(api_labels.CAPACITY_TYPE_LABEL_KEY, "NotIn", ["spot"]),
+    req("example.com/team", "Exists"),
+    req("example.com/team", "DoesNotExist"),
+    req("node.kubernetes.io/instance-type", "In", ["g4dn.xlarge"]),  # exception domain
+    req(api_labels.LABEL_ARCH, "In", ["amd64"]),
+    req("karpenter.k8s.aws/instance-cpu", "Gt", ["4"]),
+    req("karpenter.k8s.aws/instance-cpu", "Lt", ["0"]),   # 0 is non-negative
+    req("beta.kubernetes.io/instance-type", "In", ["m5.large"]),  # normalized
+    req(api_labels.LABEL_INSTANCE_TYPE, "In", ["a", "b", "c"], min_values=2),
+]
+
+REJECT = [
+    # unsupported operator
+    req(api_labels.LABEL_INSTANCE_TYPE, "IsGreaterThan", ["1"]),
+    req(api_labels.LABEL_INSTANCE_TYPE, "in", ["m5.large"]),
+    # restricted domains (kubernetes.io / k8s.io / karpenter.sh) unless
+    # well-known or exception
+    req("kubernetes.io/custom", "In", ["x"]),
+    req("k8s.io/custom", "In", ["x"]),
+    req(f"{api_labels.GROUP}/custom", "In", ["x"]),
+    req(api_labels.LABEL_HOSTNAME, "In", ["node-1"]),
+    # malformed key / values
+    req("-bad", "In", ["x"]),
+    req("a/b/c", "In", ["x"]),
+    req("example.com/" + "k" * 64, "In", ["x"]),
+    req("example.com/team", "In", ["bad value!"]),
+    req("example.com/team", "In", ["-leading"]),
+    # In needs values; minValues must fit
+    req("example.com/team", "In", []),
+    req(api_labels.LABEL_INSTANCE_TYPE, "In", ["a"], min_values=2),
+    # Gt/Lt single non-negative integer
+    req("example.com/cpu", "Gt", ["1", "2"]),
+    req("example.com/cpu", "Gt", ["-1"]),
+    req("example.com/cpu", "Lt", ["abc"]),
+    req("example.com/cpu", "Gt", []),
+]
+
+
+class TestRequirementTable:
+    @pytest.mark.parametrize("r", ACCEPT,
+                             ids=[f"{r.key}-{r.operator}" for r in ACCEPT])
+    def test_accepted(self, r):
+        assert validate_requirement(r) == []
+
+    @pytest.mark.parametrize("r", REJECT, ids=[
+        f"{i}-{r.key}-{r.operator}" for i, r in enumerate(REJECT)])
+    def test_rejected(self, r):
+        assert validate_requirement(r) != []
+
+    def test_errors_aggregate(self):
+        # several violations -> several errors (multierr behavior)
+        r = req("kubernetes.io/custom", "BadOp", [])
+        errs = validate_requirement(r)
+        assert len(errs) >= 2
+
+    def test_validate_requirements_prefixes(self):
+        errs = validate_requirements([req("kubernetes.io/custom", "In", ["x"])])
+        assert errs and "in requirements, restricted" in errs[0]
+
+
+class TestQualifiedNames:
+    def test_name_part_rules(self):
+        assert is_qualified_name("simple") == []
+        assert is_qualified_name("with-dash_and.dot9") == []
+        assert is_qualified_name("") != []
+        assert is_qualified_name("x" * 64) != []
+        assert is_qualified_name("trailing-") != []
+
+    def test_prefix_rules(self):
+        assert is_qualified_name("example.com/name") == []
+        assert is_qualified_name("UPPER.com/name") != []
+        assert is_qualified_name(("a" * 254) + "/name") != []
+        assert is_qualified_name("/name") != []
+
+    def test_label_values(self):
+        assert is_valid_label_value("") == []
+        assert is_valid_label_value("ok-value.1") == []
+        assert is_valid_label_value("has space") != []
+        assert is_valid_label_value("x" * 64) != []
+
+
+class TestTaintTable:
+    def test_valid_taints(self):
+        errs = validate_taints(
+            [Taint(key="dedicated", value="gpu", effect="NoSchedule"),
+             Taint(key="dedicated", value="gpu", effect="NoExecute")],
+            [Taint(key="startup.example.com/gate", effect="NoSchedule")])
+        assert errs == []
+
+    def test_empty_key_rejected(self):
+        assert validate_taints([Taint(key="", effect="NoSchedule")]) != []
+
+    def test_bad_effect_rejected(self):
+        assert validate_taints(
+            [Taint(key="k", effect="NoSchedule2")]) != []
+
+    def test_duplicate_key_effect_rejected(self):
+        errs = validate_taints(
+            [Taint(key="k", value="a", effect="NoSchedule"),
+             Taint(key="k", value="b", effect="NoSchedule")])
+        assert any("duplicate" in e for e in errs)
+
+    def test_duplicate_spans_startup_taints(self):
+        errs = validate_taints(
+            [Taint(key="k", effect="NoSchedule")],
+            [Taint(key="k", effect="NoSchedule")])
+        assert any("duplicate" in e for e in errs)
+
+    def test_bad_value_rejected(self):
+        assert validate_taints(
+            [Taint(key="k", value="bad value!", effect="NoSchedule")]) != []
+
+
+class TestOperatorLevelRejection:
+    def test_nodepool_condition_set_false(self):
+        from karpenter_tpu.controllers.nodepool_aux import (
+            COND_VALIDATION_SUCCEEDED, NodePoolValidation)
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        store = Store(FakeClock())
+        pool = make_nodepool(
+            name="bad",
+            requirements=[req("kubernetes.io/custom", "In", ["x"])])
+        store.create(pool)
+        NodePoolValidation(store).reconcile(pool)
+        cond = next(c for c in pool.status.conditions
+                    if c["type"] == COND_VALIDATION_SUCCEEDED)
+        assert cond["status"] == "False"
+        assert "restricted" in cond["message"]
+
+    def test_nodepool_condition_true_when_valid(self):
+        from karpenter_tpu.controllers.nodepool_aux import (
+            COND_VALIDATION_SUCCEEDED, NodePoolValidation)
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        store = Store(FakeClock())
+        pool = make_nodepool(
+            name="good",
+            requirements=[req(api_labels.LABEL_ARCH, "In", ["amd64"])])
+        store.create(pool)
+        NodePoolValidation(store).reconcile(pool)
+        cond = next(c for c in pool.status.conditions
+                    if c["type"] == COND_VALIDATION_SUCCEEDED)
+        assert cond["status"] == "True"
