@@ -89,12 +89,13 @@ def validate_requirement(req) -> List[str]:
         errs.append(f"key {key} with operator {op} must have at least "
                     "minimum number of values defined in 'values' field")
     if op in ("Gt", "Lt"):
+        # strconv.Atoi strictness (nodeclaim_validation.go:146): Python's
+        # int() tolerates underscores/whitespace/Unicode digits and has no
+        # int64 range, all of which Go rejects
         ok = len(values) == 1
         if ok:
-            try:
-                ok = int(values[0]) >= 0
-            except ValueError:
-                ok = False
+            ok = (bool(re.fullmatch(r"[+-]?[0-9]+", values[0]))
+                  and 0 <= int(values[0]) <= 2**63 - 1)
         if not ok:
             errs.append(f"key {key} with operator {op} must have a single "
                         "positive integer value")

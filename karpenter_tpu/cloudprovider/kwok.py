@@ -169,19 +169,28 @@ class KwokCloudProvider(CloudProvider):
         instances do. Returns instances recovered."""
         if self.store is None:
             return 0
+        def pid_seq(pid) -> int:
+            if not pid or not pid.startswith("kwok://"):
+                return -1
+            try:
+                return int(pid.rsplit("-", 1)[1])
+            except (ValueError, IndexError):
+                return -1
+
         claims = {nc.status.provider_id: nc
                   for nc in self.store.list(NodeClaim)
                   if nc.status.provider_id}
-        hi = 0
+        # claims whose Node is already reaped still pin their sequence
+        # number: a restart mid-termination must not reissue a live claim's
+        # provider_id to the next create()
+        hi = max((pid_seq(pid) for pid in claims), default=0)
+        hi = max(hi, 0)
         n = 0
         for node in self.store.list(Node):
             pid = node.spec.provider_id
             if not pid or not pid.startswith("kwok://"):
                 continue
-            try:
-                hi = max(hi, int(pid.rsplit("-", 1)[1]))
-            except (ValueError, IndexError):
-                pass
+            hi = max(hi, pid_seq(pid))
             nc = claims.get(pid)
             if nc is None:
                 # claim-less instance: garbagecollection only sees instances

@@ -123,7 +123,9 @@ class NodePoolValidation(Controller):
                        message: str = "") -> None:
         for c in pool.status.conditions:
             if c.get("type") == ctype:
-                if c.get("status") != status:
+                # message alone can change (e.g. one of several validation
+                # errors fixed while others remain) — stale text misleads
+                if c.get("status") != status or c.get("message") != message:
                     c["status"] = status
                     c["message"] = message
                     self.store.update(pool)

@@ -156,6 +156,7 @@ class Store:
             return
         del coll[k]
         self._by_uid.get(kind, {}).pop(live.metadata.uid, None)
+        self._rv += 1  # deletions must advance the checkpoint watermark
         self._notify(DELETED, live)
 
     # -- durability ---------------------------------------------------------
@@ -199,23 +200,27 @@ class Store:
         import pickle
         with open(path, "rb") as f:
             data = pickle.load(f)
-        self._rv = max(self._rv, data["rv"])
         kinds = sorted(data["objs"],
                        key=lambda k: (self._REPLAY_ORDER.index(k.__name__)
                                       if k.__name__ in self._REPLAY_ORDER
                                       else len(self._REPLAY_ORDER)))
-        n = 0
+        # stage first, then commit: a snapshot from an incompatible code
+        # version must fail BEFORE any object is announced, so the caller's
+        # "boot fresh" fallback starts from a genuinely empty store
+        staged: List[tuple] = []
         for kind in kinds:
-            coll = self._objs.setdefault(kind, {})
+            coll = self._objs.get(kind, {})
             for k, obj in data["objs"][kind].items():
                 if k in coll:
                     continue
-                coll[k] = obj
-                if obj.metadata.uid:
-                    self._by_uid.setdefault(kind, {})[obj.metadata.uid] = obj
-                self._notify(ADDED, obj)
-                n += 1
-        return n
+                staged.append((kind, k, obj, obj.metadata.uid))
+        self._rv = max(self._rv, data["rv"])
+        for kind, k, obj, uid in staged:
+            self._objs.setdefault(kind, {})[k] = obj
+            if uid:
+                self._by_uid.setdefault(kind, {})[uid] = obj
+            self._notify(ADDED, obj)
+        return len(staged)
 
     def remove_finalizer(self, obj, finalizer: str) -> None:
         if finalizer in obj.metadata.finalizers:
@@ -226,6 +231,7 @@ class Store:
             if k in coll:
                 del coll[k]
                 self._by_uid.get(type(obj), {}).pop(obj.metadata.uid, None)
+                self._rv += 1  # see delete(): watermark must see removals
                 self._notify(DELETED, obj)
             return
         self.update(obj)

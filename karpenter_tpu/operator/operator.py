@@ -177,7 +177,10 @@ class Operator:
                 self.checkpoint()
                 time.sleep(tick_seconds)
         finally:
-            self.checkpoint()
+            try:
+                self.checkpoint()
+            except Exception as exc:  # must not mask the loop's exception
+                self.log.error("final checkpoint failed", error=str(exc))
             self.stop_serving()
 
     def metrics_text(self) -> str:

@@ -49,6 +49,14 @@ class TestSnapshotRoundtrip:
         assert s2.get(Pod, "same", "default") is newer
 
 
+class _BrokenObj:
+    """Simulates a snapshot object from an incompatible code version: any
+    metadata access explodes during load()'s staging pass."""
+    @property
+    def metadata(self):
+        raise AttributeError("incompatible snapshot object")
+
+
 class TestSnapshotResilience:
     def test_corrupt_snapshot_boots_fresh(self, tmp_path):
         path = str(tmp_path / "state.bin")
@@ -72,6 +80,77 @@ class TestSnapshotResilience:
         os.utime(path, (mtime - 100, mtime - 100))
         op.checkpoint()  # rv unchanged -> no rewrite
         assert os.path.getmtime(path) == mtime - 100
+
+    def test_deletion_advances_checkpoint_watermark(self, tmp_path):
+        """A pure-delete tick must still checkpoint: otherwise a restart
+        resurrects the deleted object from the stale snapshot."""
+        path = str(tmp_path / "state.bin")
+        op = Operator(options=Options(state_file=path), clock=FakeClock())
+        pod = make_pod(cpu="100m")
+        op.store.create(pod)
+        op.checkpoint()
+        op.store.delete(pod)  # pods carry no finalizers: immediate removal
+        op.checkpoint()
+        s2 = Store(FakeClock())
+        s2.load(path)
+        assert s2.get(Pod, pod.name, pod.namespace) is None
+
+    def test_finalizer_removal_advances_watermark(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        op = Operator(options=Options(state_file=path), clock=FakeClock())
+        pod = make_pod(cpu="100m")
+        pod.metadata.finalizers.append("test/f")
+        op.store.create(pod)
+        op.store.delete(pod)  # only stamps deletionTimestamp
+        op.checkpoint()
+        op.store.remove_finalizer(pod, "test/f")  # actual removal
+        op.checkpoint()
+        s2 = Store(FakeClock())
+        s2.load(path)
+        assert s2.get(Pod, pod.name, pod.namespace) is None
+
+    def test_partial_snapshot_stages_before_announcing(self, tmp_path):
+        """load() must mutate nothing when the snapshot can't be fully
+        staged (e.g. pickled objects from an incompatible code version)."""
+        import pickle
+        path = str(tmp_path / "state.bin")
+        s1 = Store(FakeClock())
+        s1.create(make_pod(cpu="100m"))
+        data = {"objs": {**s1._objs, _BrokenObj: {("", "x"): _BrokenObj()}},
+                "rv": s1._rv}
+        with open(path, "wb") as f:
+            pickle.dump(data, f)
+        s2 = Store(FakeClock())
+        events = []
+        s2.watch(lambda ev: events.append(ev))
+        with pytest.raises(AttributeError):
+            s2.load(path)
+        assert not events and s2.list(Pod) == []
+
+    def test_resync_never_reissues_live_claim_provider_id(self, tmp_path):
+        """A NodeClaim whose Node is already reaped (restart mid-
+        termination) must still pin its provider_id sequence number."""
+        path = str(tmp_path / "state.bin")
+        op1 = Operator(options=Options(state_file=path), clock=FakeClock())
+        op1.store.create(make_nodepool(name="default"))
+        op1.store.create(make_pod(cpu="500m"))
+        settle(op1)
+        nc = op1.store.list(NodeClaim)[0]
+        node = op1.store.list(Node)[0]
+        node.metadata.finalizers.clear()
+        op1.store.delete(node)  # node reaped, claim (with provider_id) lives
+        op1.checkpoint()
+        clock2 = FakeClock()
+        clock2.step(op1.clock.now())
+        op2 = Operator(options=Options(state_file=path), clock=clock2)
+        op2.store.create(make_pod(cpu="500m", name="after-restart"))
+        settle(op2)
+        # the orphaned claim is legitimately GC'd (instance vanished), but
+        # its provider_id must never be REISSUED to the replacement claim
+        pids = [c.status.provider_id for c in op2.store.list(NodeClaim)
+                if c.status.provider_id]
+        assert pids and nc.status.provider_id not in pids
+        assert len(pids) == len(set(pids)), f"duplicate provider_id: {pids}"
 
     def test_resync_reaps_orphan_kwok_nodes(self, tmp_path):
         path = str(tmp_path / "state.bin")
