@@ -48,9 +48,13 @@ _MEMS = ["64Mi", "128Mi", "256Mi", "512Mi", "1Gi"]
 
 
 def _pods(hostport_pct: float = 0.0):
-    """The reference benchmark mix; hostport_pct > 0 additionally gives that
-    fraction of pods a (distinct) host port — inexpressible in the tensor
-    kernel, exercising the partitioned tensor-bulk + host-straggler path."""
+    """The reference benchmark mix (kinds 0-5,
+    scheduling_benchmark_test.go:233-247) extended with the widened kernel
+    shapes (kinds 6-8: minDomains spread, zonal spread + hostname
+    anti-affinity, non-self-selector spread); hostport_pct > 0 additionally
+    gives that fraction of pods a (distinct) host port — inexpressible in
+    the tensor kernel, exercising the partitioned tensor-bulk +
+    host-straggler path."""
     from karpenter_tpu.api.objects import HostPort
     pods = []
     n_deploys = min(N_DEPLOYS, max(1, N_PODS))
@@ -59,7 +63,7 @@ def _pods(hostport_pct: float = 0.0):
         labels = {"app": f"deploy-{d}"}
         sel = LabelSelector(match_labels=dict(labels))
         spread, affinity = [], None
-        kind = d % 6
+        kind = d % 9
         if kind == 1:
             spread = [TopologySpreadConstraint(
                 topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
@@ -80,6 +84,22 @@ def _pods(hostport_pct: float = 0.0):
             affinity = Affinity(pod_anti_affinity=PodAffinity(required=[
                 PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
                                 label_selector=sel)]))
+        elif kind == 6:
+            spread = [TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                min_domains=4, label_selector=sel)]
+        elif kind == 7:
+            spread = [TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                label_selector=sel)]
+            affinity = Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
+                                label_selector=sel)]))
+        elif kind == 8:
+            spread = [TopologySpreadConstraint(
+                topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                label_selector=LabelSelector(
+                    match_labels={"app": f"unrelated-{d}"}))]
         requests = res.parse_list({"cpu": _CPUS[d % 5], "memory": _MEMS[d % 5]})
         for i in range(per):
             pods.append(Pod(
@@ -327,9 +347,10 @@ def bench_provisioning(pods, n_its, mixed: bool = False):
         best = min(best, time.perf_counter() - t0)
 
     pods_per_sec = len(pods) / best
-    mix = ("reference benchmark pod mix + 1% host-port stragglers "
-           "(partitioned tensor+host solve)" if mixed
-           else "reference benchmark pod mix")
+    mix = ("reference benchmark pod mix + widened shapes + 1% host-port "
+           "stragglers (partitioned tensor+host solve)" if mixed
+           else "reference benchmark pod mix + widened shapes (minDomains, "
+                "multi-constraint, non-self selectors)")
     return {
         "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
                    f"{n_its or 144} instance types, {mix}"),
@@ -379,9 +400,19 @@ def bench_mesh_local():
             assert s.fallback_reason == "", s.fallback_reason
         return best, results
 
+    def claim_key(nc):
+        return (nc.template.nodepool_name,
+                tuple(sorted(nc.requirements.get(
+                    api_labels.LABEL_TOPOLOGY_ZONE).values)),
+                tuple(it.name for it in nc.instance_type_options),
+                len(nc.pods))
+
     t_single, r_single = timed(None)
     t_mesh, r_mesh = timed(mesh)
-    assert len(r_mesh.new_nodeclaims) == len(r_single.new_nodeclaims)
+    # exact decision equality, not just counts: same claims (pool, zone
+    # restriction, surviving instance types in order, fill) and same errors
+    assert sorted(map(claim_key, r_mesh.new_nodeclaims)) == \
+        sorted(map(claim_key, r_single.new_nodeclaims))
     assert r_mesh.pod_errors == r_single.pod_errors
     print(json.dumps({
         "metric": (f"provisioning Solve() on a {MESH_DEVICES}-device "

@@ -26,7 +26,8 @@ from ..api.nodepool import NodePool, order_by_weight
 from ..ops import binpack
 from ..provisioning.grouping import PodGroup, group_pods
 from ..provisioning.provisioner import Provisioner, StateClusterView
-from ..provisioning.tensor_scheduler import TensorScheduler, _FallbackError
+from ..provisioning.tensor_scheduler import (TensorScheduler, _FallbackError,
+                                             pad_exist_counts)
 from ..state.cluster import Cluster
 from ..utils import pod as pod_utils
 from .types import Candidate, CandidateError
@@ -88,16 +89,6 @@ class PrefixSimulator:
         self.node_index = {sn.name(): i
                            for i, sn in enumerate(self.ts.state_nodes)}
         self.zone_names = self.problem.vocab.values[self.problem.zone_key]
-        # conservative coupling check: any scheduled cluster pod (including
-        # candidates' own pods, which stay scheduled in short prefixes)
-        # matching a host-kind/anti topology selector means host-path
-        # semantics; exclude only the base pending set so every probe's
-        # countable superset is covered
-        try:
-            self.ts.cluster_zone_counts(groups, self.zone_names,
-                                        self.base_uids)
-        except _FallbackError as e:
-            raise PrefixFallback(str(e))
 
     # -- per-probe host replay ---------------------------------------------
 
@@ -127,15 +118,18 @@ class PrefixSimulator:
             if self.ts.state_nodes[i].name() not in excluded_nodes]
 
         limits, limit_resources = self._limits(excluded_nodes)
-        # per-probe zone occupancy: cluster pods matching each group's
-        # topology selector that are NOT pending in this probe still count
+        # per-probe domain occupancy: cluster pods matching each group's
+        # topology selectors that are NOT pending in this probe still count
         # (non-prefix candidates' pods among them) — host countDomains parity
-        izc = self.ts.cluster_zone_counts(probe_groups, self.zone_names,
-                                          allowed)
+        izc, exist_counts, host_total = self.ts.cluster_topology_counts(
+            probe_groups, self.zone_names, allowed)
+        exist_counts = pad_exist_counts(self.problem, exist_counts)
         packer = binpack.Packer(self.problem, self.tensors, probe_groups,
                                 limits, limit_resources,
                                 initial_zone_counts=izc,
-                                exist_order=exist_order)
+                                exist_order=exist_order,
+                                exist_counts=exist_counts,
+                                host_match_total=host_total)
         pr = packer.pack()
         results = self.ts._materialize(
             pr, self.problem, probe_groups, self.templates, self.catalog,

@@ -312,18 +312,25 @@ class PackResult:
 
 
 def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
-              c: int, max_skew: int) -> np.ndarray:
+              c: int, max_skew: int,
+              min_domains: Optional[int] = None,
+              zone_names: Optional[np.ndarray] = None) -> np.ndarray:
     """Distribute c pods over zones the way the reference's min-count domain
     selection does (topologygroup.go:181-227): each pod goes to the lowest-count
     admitted+viable zone subject to count+1-min <= maxSkew, min taken over all
-    admitted zones. Returns per-zone allocation (pods that can't place anywhere
-    are simply not allocated; caller errors them)."""
+    admitted zones. With minDomains set and fewer admitted domains than it,
+    the global min floors to zero (topologygroup.go:240-247), so the skew
+    check binds against absolute counts. Returns per-zone allocation (pods
+    that can't place anywhere are simply not allocated; caller errors them)."""
     counts = counts.astype(np.int64).copy()
     alloc = np.zeros_like(counts)
     remaining = c
+    floor_zero = (min_domains is not None
+                  and int(admitted.sum()) < min_domains)
     # fast path: every admitted zone viable -> sequential min-fill equals a
-    # closed-form water-fill (skew never binds when always filling the min)
-    if admitted.any() and (viable | ~admitted).all():
+    # closed-form water-fill (skew never binds when always filling the min;
+    # invalid under the minDomains zero floor, where skew binds absolutely)
+    if not floor_zero and admitted.any() and (viable | ~admitted).all():
         idx = np.where(admitted)[0]
         cz = counts[idx]
         # largest level L with sum(max(0, L - cz)) <= remaining
@@ -342,12 +349,18 @@ def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
         alloc[idx] = add
         return alloc
     while remaining > 0:
-        m0 = counts[admitted].min() if admitted.any() else 0
+        if floor_zero:
+            m0 = 0
+        else:
+            m0 = counts[admitted].min() if admitted.any() else 0
         eligible = viable & admitted & (counts + 1 - m0 <= max_skew)
         if not eligible.any():
             break
         cand = np.where(eligible)[0]
-        zi = cand[np.lexsort((cand, counts[cand]))[0]]
+        # min count, ties by domain NAME — the host oracle's deterministic
+        # tie-break (_next_domain_spread iterates sorted(candidates))
+        tie = zone_names[cand] if zone_names is not None else cand
+        zi = cand[np.lexsort((tie, counts[cand]))[0]]
         alloc[zi] += 1
         counts[zi] += 1
         remaining -= 1
@@ -361,7 +374,9 @@ class Packer:
                  template_limits: List[Optional[dict]],
                  limit_resources: List[str],
                  initial_zone_counts: Optional[np.ndarray] = None,
-                 exist_order: Optional[List[int]] = None):
+                 exist_order: Optional[List[int]] = None,
+                 exist_counts: Optional[np.ndarray] = None,
+                 host_match_total: Optional[np.ndarray] = None):
         self.p = p
         self.t = t
         self.groups = groups
@@ -377,6 +392,13 @@ class Packer:
             list(range(p.exist_avail.shape[0])) if p.exist_avail is not None else [])
         self.exist_avail = (p.exist_avail.copy() if p.exist_avail is not None
                             else np.zeros((0, p.group_req.shape[1]), dtype=np.int64))
+        # scheduled cluster pods matching each group's hostname-level
+        # selector, per packable existing node [G, N] and in total [G] (the
+        # countDomains analog for hostname topologies, topology.go:268-321)
+        self.exist_counts = exist_counts
+        self.host_match_total = host_match_total
+        # domain-name tie-break order for zone selection (host parity)
+        self._zone_names = np.array(p.vocab.values[p.zone_key], dtype=object)
         self.result = PackResult()
         # per-group nonzero request columns + per-(m,g) daemon-adjusted
         # allocatable slices, so the per-probe capacity math touches only the
@@ -565,10 +587,19 @@ class Packer:
         cohort.enc = np_combine(cohort.enc, _row(self.p.group_enc, g))
 
     def _fill_existing(self, g: int, remaining: int, zone: Optional[int],
-                       per_node_cap: int) -> int:
+                       per_node_cap: int,
+                       node_caps: Optional[np.ndarray] = None,
+                       max_nodes: int = 0) -> int:
+        """Pack into live nodes. node_caps[n] (when given) hard-caps each
+        node individually — the hostname-topology cap derived from already-
+        scheduled matching pods (0 = excluded); max_nodes > 0 limits how many
+        distinct nodes may be used (hostname pod affinity: all on one)."""
         placed_total = 0
+        used_nodes = 0
         for n in self.exist_order:
             if remaining <= 0:
+                break
+            if max_nodes and used_nodes >= max_nodes:
                 break
             if not self.t.exist_ok[g, n]:
                 continue
@@ -582,6 +613,8 @@ class Packer:
             cap = int(per.min()) if per.size else 0
             if per_node_cap:
                 cap = min(cap, per_node_cap)
+            if node_caps is not None:
+                cap = min(cap, int(node_caps[n]))
             fill = min(cap, remaining)
             if fill <= 0:
                 continue
@@ -589,6 +622,7 @@ class Packer:
             self.result.existing.setdefault(n, []).append((g, fill))
             placed_total += fill
             remaining -= fill
+            used_nodes += 1
         return placed_total
 
     # -- main ---------------------------------------------------------------
@@ -608,64 +642,68 @@ class Packer:
         for pod in pods[start:]:
             self.result.errors[pod.uid] = msg
 
+    def _host_caps(self, g: int, host_spec) -> Tuple[int, Optional[np.ndarray]]:
+        """Per-fresh-node cap (0 = unlimited) and per-existing-node caps from
+        the group's hostname-level constraint. Self-selecting constraints
+        budget against already-scheduled matching pods per node
+        (exist_counts); non-self constraints never budget batch pods (they
+        don't match the selector) — they only admit or exclude nodes by their
+        static matching counts (topologygroup.go:181-227, 316-342 with the
+        hostname global-min floored at 0, :232-234)."""
+        if host_spec is None:
+            return 0, None
+        N = self.exist_avail.shape[0]
+        cnt = (self.exist_counts[g] if self.exist_counts is not None
+               else np.zeros(N, dtype=np.int64))
+        if host_spec.kind == "spread-host":
+            skew = host_spec.max_skew
+            if host_spec.self_select:
+                return skew, np.maximum(0, skew - cnt)
+            return 0, np.where(cnt > skew, 0, INT32_MAX)
+        # anti-host
+        if host_spec.self_select:
+            return 1, np.where(cnt > 0, 0, 1)
+        return 0, np.where(cnt > 0, 0, INT32_MAX)
+
     def _pack_group(self, g: int) -> None:
         group = self.groups[g]
         c = group.count
         if c == 0:
             return
-        topo = group.topo[0] if group.topo else None
-        kind = topo.kind if topo else "none"
+        specs = group.topo or []
+        zone_spec = next((s for s in specs
+                          if s.kind in ("spread-zone", "affinity-zone",
+                                        "anti-zone")), None)
+        host_spec = next((s for s in specs
+                          if s.kind in ("spread-host", "anti-host",
+                                        "affinity-host")), None)
 
-        if kind == "none":
-            placed = self._fill_existing(g, c, None, 0)
-            placed += self._fill_cohorts(g, c - placed, None, 0)
-            placed += self._place_new(g, c - placed, None, 0)
+        if host_spec is not None and host_spec.kind == "affinity-host":
+            self._pack_affinity_host(g, c)  # always alone (grouping)
+            return
+        per_node_cap, node_caps = self._host_caps(g, host_spec)
+
+        if zone_spec is None:
+            placed = self._fill_existing(g, c, None, per_node_cap, node_caps)
+            placed += self._fill_cohorts(g, c - placed, None, per_node_cap)
+            placed += self._place_new(g, c - placed, None, per_node_cap)
             if placed < c:
-                self._error_group(g, c - placed, "no instance type satisfied the pod")
-        elif kind == "spread-zone":
-            self._pack_spread_zone(g, c, topo.max_skew)
-        elif kind == "spread-host":
-            per = topo.max_skew
-            placed = self._fill_existing(g, c, None, per)
-            placed += self._fill_cohorts(g, c - placed, None, per)
-            placed += self._place_new(g, c - placed, None, per)
-            if placed < c:
-                self._error_group(g, c - placed, "unsatisfiable hostname topology spread")
-        elif kind == "anti-host":
-            placed = self._fill_existing(g, c, None, 1)
-            placed += self._fill_cohorts(g, c - placed, None, 1)
-            placed += self._place_new(g, c - placed, None, 1)
-            if placed < c:
-                self._error_group(g, c - placed, "unsatisfiable hostname anti-affinity")
-        elif kind == "affinity-host":
-            # all pods onto one node; overflow is unschedulable (reference
-            # late-committal: the hostname domain is fixed by the first pod)
-            placed = 0
-            for n in self.exist_order:
-                if self.t.exist_ok[g, n]:
-                    placed = self._fill_existing(g, c, None, 0)
-                    break
-            if placed == 0:
-                placed = self._place_one_node(g, c)
-            if placed < c:
-                self._error_group(g, c - placed,
-                                  "hostname pod affinity: node capacity exhausted")
-        elif kind == "affinity-zone":
-            self._pack_affinity_zone(g, c)
-        elif kind == "anti-zone":
-            # late committal (topology_test.go:2150-2176): one pod per batch
-            placed = self._fill_existing(g, 1, None, 0)
-            if placed == 0:
-                placed += self._fill_cohorts(g, 1, None, 0)
-            if placed == 0:
-                placed += self._place_new(g, 1, None, 0)
-            if placed < 1:
-                self._error_group(g, c, "unsatisfiable zonal anti-affinity")
-            elif c > 1:
-                self._error_group(
-                    g, c - 1, "zonal anti-affinity: domain undetermined until next batch")
-        else:
-            self._error_group(g, c, f"unsupported topology kind {kind}")
+                msg = "no instance type satisfied the pod"
+                if host_spec is not None:
+                    msg = ("unsatisfiable hostname topology spread"
+                           if host_spec.kind == "spread-host"
+                           else "unsatisfiable hostname anti-affinity")
+                self._error_group(g, c - placed, msg)
+        elif zone_spec.kind == "spread-zone":
+            if zone_spec.self_select:
+                self._pack_spread_zone(g, c, zone_spec, per_node_cap, node_caps)
+            else:
+                self._pack_spread_zone_static(g, c, zone_spec, per_node_cap,
+                                              node_caps)
+        elif zone_spec.kind == "affinity-zone":
+            self._pack_affinity_zone(g, c, zone_spec, per_node_cap, node_caps)
+        else:  # anti-zone (always alone)
+            self._pack_anti_zone(g, c, zone_spec)
 
     def _place_new(self, g: int, remaining: int, zone: Optional[int],
                    per_node_cap: int) -> int:
@@ -706,47 +744,165 @@ class Packer:
             return fill
         return 0
 
-    def _pack_spread_zone(self, g: int, c: int, max_skew: int) -> None:
+    def _zone_admitted_viable(self, g: int) -> Tuple[np.ndarray, np.ndarray]:
         # admitted zones: group+any template admits; viable: some IT offering
         admitted = np.zeros(self.Z, dtype=bool)
         viable = np.zeros(self.Z, dtype=bool)
         for m in self._viable_templates(g):
             admitted |= self.t.zone_adm[g, m]
             viable |= self.t.it_ok_z[g, m].any(axis=0)
+        return admitted, viable
+
+    def _fill_zone(self, g: int, a: int, z: int, per_node_cap: int,
+                   node_caps: Optional[np.ndarray]) -> int:
+        placed = self._fill_existing(g, a, z, per_node_cap, node_caps)
+        placed += self._fill_cohorts(g, a - placed, z, per_node_cap)
+        placed += self._place_new(g, a - placed, z, per_node_cap)
+        return placed
+
+    def _pack_spread_zone(self, g: int, c: int, spec, per_node_cap: int = 0,
+                          node_caps: Optional[np.ndarray] = None) -> None:
+        admitted, viable = self._zone_admitted_viable(g)
         if not admitted.any():
             self._error_group(g, c, "no zone admitted for topology spread")
             return
-        alloc = waterfill(self.zone_counts[g], viable, admitted, c, max_skew)
+        alloc = waterfill(self.zone_counts[g], viable, admitted, c,
+                          spec.max_skew, spec.min_domains,
+                          zone_names=self._zone_names)
         placed_total = 0
         for z in np.argsort(-alloc):
             a = int(alloc[z])
             if a <= 0:
                 continue
-            placed = self._fill_existing(g, a, int(z), 0)
-            placed += self._fill_cohorts(g, a - placed, int(z), 0)
-            placed += self._place_new(g, a - placed, int(z), 0)
+            placed = self._fill_zone(g, a, int(z), per_node_cap, node_caps)
             self.zone_counts[g, z] += placed
             placed_total += placed
         if placed_total < c:
             self._error_group(g, c - placed_total, "unsatisfiable zonal topology spread")
 
-    def _pack_affinity_zone(self, g: int, c: int) -> None:
-        viable = np.zeros(self.Z, dtype=bool)
-        for m in self._viable_templates(g):
-            viable |= self.t.it_ok_z[g, m].any(axis=0)
-        counts = self.zone_counts[g]
-        occupied = (counts > 0) & viable
-        candidates = np.where(occupied)[0] if occupied.any() else np.where(viable)[0]
-        if len(candidates) == 0:
-            self._error_group(g, c, "no viable zone for zonal pod affinity")
+    def _pack_spread_zone_static(self, g: int, c: int, spec,
+                                 per_node_cap: int,
+                                 node_caps: Optional[np.ndarray]) -> None:
+        """Non-self-selecting zonal spread: placing batch pods never changes
+        the domain counts, so the skew arithmetic is static. Existing nodes
+        in any skew-eligible zone may take pods; fresh nodes all commit to
+        the min-count eligible zone, exactly the domain nextDomain would
+        return for an unconstrained node (topologygroup.go:181-227)."""
+        admitted, viable = self._zone_admitted_viable(g)
+        if not admitted.any():
+            self._error_group(g, c, "no zone admitted for topology spread")
             return
+        counts = self.zone_counts[g]
+        floor_zero = (spec.min_domains is not None
+                      and int(admitted.sum()) < spec.min_domains)
+        gmin = 0 if floor_zero else int(counts[admitted].min())
+        eligible = admitted & (counts - gmin <= spec.max_skew)
+        if not eligible.any():
+            self._error_group(g, c, "unsatisfiable zonal topology spread")
+            return
+        placed = 0
+        for z in np.where(eligible)[0]:
+            if placed >= c:
+                break
+            placed += self._fill_existing(g, c - placed, int(z),
+                                          per_node_cap, node_caps)
+        fresh = eligible & viable
+        if placed < c and fresh.any():
+            cand = np.where(fresh)[0]
+            z = int(cand[np.lexsort((self._zone_names[cand],
+                                     counts[cand]))[0]])
+            placed += self._fill_cohorts(g, c - placed, z, per_node_cap)
+            placed += self._place_new(g, c - placed, z, per_node_cap)
+        if placed < c:
+            self._error_group(g, c - placed, "unsatisfiable zonal topology spread")
+
+    def _pack_affinity_zone(self, g: int, c: int, spec, per_node_cap: int = 0,
+                            node_caps: Optional[np.ndarray] = None) -> None:
+        admitted, viable = self._zone_admitted_viable(g)
+        counts = self.zone_counts[g]
+        occupied = (counts > 0) & admitted
+        if occupied.any():
+            # pods must join an occupied domain (topologygroup.go:253-300);
+            # if none of those domains has a viable instance type the pods
+            # fail — there is NO bootstrap while matching pods exist
+            candidates = np.where(occupied & viable)[0]
+            if len(candidates) == 0:
+                self._error_group(
+                    g, c, "zonal pod affinity: no viable occupied zone")
+                return
+        elif not spec.self_select:
+            # non-self affinity can never self-satisfy (the bootstrap at
+            # topologygroup.go:283-287 requires the pod to match its own
+            # selector): nothing matches anywhere -> unschedulable
+            self._error_group(
+                g, c, "zonal pod affinity: no pods match the affinity selector")
+            return
+        else:
+            candidates = np.where(viable)[0]
+            if len(candidates) == 0:
+                self._error_group(g, c, "no viable zone for zonal pod affinity")
+                return
         z = int(candidates[0])
-        placed = self._fill_existing(g, c, z, 0)
-        placed += self._fill_cohorts(g, c - placed, z, 0)
-        placed += self._place_new(g, c - placed, z, 0)
+        placed = self._fill_zone(g, c, z, per_node_cap, node_caps)
         self.zone_counts[g, z] += placed
         if placed < c:
             self._error_group(g, c - placed, "zonal pod affinity: zone capacity exhausted")
+
+    def _pack_anti_zone(self, g: int, c: int, spec) -> None:
+        """Zonal anti-affinity: pods may only land in EMPTY domains
+        (topologygroup.go:316-342). Self-selecting: each placement occupies a
+        zone, and peers in the same batch are mutually excluded but not yet
+        recorded — late committal places one pod per batch
+        (topology_test.go:2150-2176). Non-self: batch pods never occupy
+        domains, so every pod can go to any statically-empty zone."""
+        admitted, viable = self._zone_admitted_viable(g)
+        counts = self.zone_counts[g]
+        empty = admitted & (counts == 0)
+        if spec.self_select:
+            placed = 0
+            for z in np.where(empty)[0]:
+                placed = self._fill_zone(g, 1, int(z), 0, None)
+                if placed:
+                    self.zone_counts[g, z] += 1
+                    break
+            if placed < 1:
+                self._error_group(g, c, "unsatisfiable zonal anti-affinity")
+            elif c > 1:
+                self._error_group(
+                    g, c - 1, "zonal anti-affinity: domain undetermined until next batch")
+            return
+        placed = 0
+        for z in np.where(empty)[0]:
+            if placed >= c:
+                break
+            placed += self._fill_zone(g, c - placed, int(z), 0, None)
+        if placed < c:
+            self._error_group(g, c - placed, "unsatisfiable zonal anti-affinity")
+
+    def _pack_affinity_host(self, g: int, c: int) -> None:
+        """Hostname pod affinity (self-selecting; grouping keeps non-self on
+        the host path). With matching pods already scheduled, the batch must
+        join their nodes (no bootstrap, topologygroup.go:253-287); otherwise
+        the hostname domain is fixed by the first placement, so everything
+        lands on ONE node and overflow is unschedulable."""
+        total = (int(self.host_match_total[g])
+                 if self.host_match_total is not None else 0)
+        if total > 0:
+            cnt = (self.exist_counts[g] if self.exist_counts is not None
+                   else np.zeros(self.exist_avail.shape[0], dtype=np.int64))
+            node_caps = np.where(cnt > 0, INT32_MAX, 0)
+            placed = self._fill_existing(g, c, None, 0, node_caps)
+            if placed < c:
+                self._error_group(
+                    g, c - placed,
+                    "hostname pod affinity: no co-located capacity")
+            return
+        placed = self._fill_existing(g, c, None, 0, None, max_nodes=1)
+        if placed == 0:
+            placed = self._place_one_node(g, c)
+        if placed < c:
+            self._error_group(g, c - placed,
+                              "hostname pod affinity: node capacity exhausted")
 
 
 def _row(e: EncodedRequirements, i: int) -> EncodedRequirements:

@@ -11,13 +11,27 @@ the kernel-supported forms below and no constraint selects pods of another
 group (cross-group count coupling). Otherwise the scheduler transparently
 falls back to the host solver, whose semantics are always authoritative.
 
-Supported per-group topology forms (self-selecting only):
-- zonal topology spread        (topologygroup.go nextDomainTopologySpread)
+Supported per-group topology forms:
+- zonal topology spread        (topologygroup.go nextDomainTopologySpread,
+                                incl. minDomains floor-to-zero semantics)
 - hostname topology spread
 - zonal pod affinity           (all pods collapse to one zone)
-- hostname pod affinity        (all pods onto one node, overflow unschedulable)
+- hostname pod affinity        (all pods onto one node, overflow unschedulable;
+                                self-selecting only — non-self has no bootstrap
+                                and needs live co-location state)
 - zonal pod anti-affinity      (late committal: one pod per batch schedules)
 - hostname pod anti-affinity   (one pod per node)
+
+Each form may be self-selecting (the constraint's selector matches the pod's
+own labels — the deployment case) or non-self-selecting (counts come only
+from already-scheduled cluster pods; the packer treats the domain counts as
+static since placing batch pods never changes them). A group may carry up to
+TWO constraints when they layer cleanly: one zone-level constraint (zonal
+spread or zonal affinity) plus one hostname-level constraint (hostname
+spread or hostname anti-affinity) — the common real-world combo of "spread
+across zones AND at most one per node". Anything else (zonal anti-affinity
+or hostname affinity combined with another constraint, explicit affinity
+namespaces, non-zone/hostname topology keys) demotes to the host path.
 """
 
 from __future__ import annotations
@@ -40,11 +54,18 @@ ANTI_ZONE = "anti-zone"
 ANTI_HOST = "anti-host"
 
 
+ZONE_KINDS = (SPREAD_ZONE, AFFINITY_ZONE, ANTI_ZONE)
+HOST_KINDS = (SPREAD_HOST, AFFINITY_HOST, ANTI_HOST)
+
+
 @dataclass
 class TopoSpec:
     kind: str
     max_skew: int = 1
     schedule_anyway: bool = False  # relaxable on failure
+    min_domains: Optional[int] = None  # spread only (topologygroup.go:240-247)
+    self_select: bool = True   # selector matches the group's own labels
+    selector: object = None    # LabelSelector for cluster-pod counting
 
 
 @dataclass
@@ -73,21 +94,31 @@ def _selector_is_self(selector, labels: dict) -> bool:
     return selector is not None and selector.matches(labels)
 
 
+def _term_namespaces_ok(term, pod: Pod) -> bool:
+    """Explicit cross-namespace affinity terms need host-side namespace-aware
+    counting (topology.go:341)."""
+    return not term.namespaces or set(term.namespaces) == {pod.namespace}
+
+
 def _classify_topology(pod: Pod) -> "Tuple[Optional[List[TopoSpec]], bool]":
     """Returns (specs, relaxable) or (None, _) when unsupported by the kernel."""
     specs: List[TopoSpec] = []
     relaxable = False
     for tsc in pod.spec.topology_spread_constraints:
-        if tsc.min_domains is not None:
-            return None, relaxable
-        if not _selector_is_self(tsc.label_selector, pod.labels):
-            return None, relaxable
         anyway = tsc.when_unsatisfiable != DO_NOT_SCHEDULE
         relaxable |= anyway
+        self_sel = _selector_is_self(tsc.label_selector, pod.labels)
         if tsc.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
-            specs.append(TopoSpec(SPREAD_ZONE, tsc.max_skew, anyway))
+            specs.append(TopoSpec(SPREAD_ZONE, tsc.max_skew, anyway,
+                                  min_domains=tsc.min_domains,
+                                  self_select=self_sel,
+                                  selector=tsc.label_selector))
         elif tsc.topology_key == api_labels.LABEL_HOSTNAME:
-            specs.append(TopoSpec(SPREAD_HOST, tsc.max_skew, anyway))
+            # minDomains is irrelevant for hostname spreads: the global min
+            # floors at 0 regardless (topologygroup.go:232-234)
+            specs.append(TopoSpec(SPREAD_HOST, tsc.max_skew, anyway,
+                                  self_select=self_sel,
+                                  selector=tsc.label_selector))
         else:
             return None, relaxable
     aff = pod.spec.affinity
@@ -95,27 +126,49 @@ def _classify_topology(pod: Pod) -> "Tuple[Optional[List[TopoSpec]], bool]":
         if aff.pod_affinity is not None:
             relaxable |= bool(aff.pod_affinity.preferred)
             for term in aff.pod_affinity.required:
-                if not _selector_is_self(term.label_selector, pod.labels):
+                self_sel = _selector_is_self(term.label_selector, pod.labels)
+                if not _term_namespaces_ok(term, pod):
                     return None, relaxable
                 if term.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
-                    specs.append(TopoSpec(AFFINITY_ZONE))
+                    specs.append(TopoSpec(AFFINITY_ZONE, self_select=self_sel,
+                                          selector=term.label_selector))
                 elif term.topology_key == api_labels.LABEL_HOSTNAME:
-                    specs.append(TopoSpec(AFFINITY_HOST))
+                    if not self_sel:
+                        # non-self hostname affinity has no bootstrap and
+                        # pins pods to live co-location state: host path
+                        return None, relaxable
+                    specs.append(TopoSpec(AFFINITY_HOST, self_select=True,
+                                          selector=term.label_selector))
                 else:
                     return None, relaxable
         if aff.pod_anti_affinity is not None:
             relaxable |= bool(aff.pod_anti_affinity.preferred)
             for term in aff.pod_anti_affinity.required:
-                if not _selector_is_self(term.label_selector, pod.labels):
+                self_sel = _selector_is_self(term.label_selector, pod.labels)
+                if not _term_namespaces_ok(term, pod):
                     return None, relaxable
                 if term.topology_key == api_labels.LABEL_TOPOLOGY_ZONE:
-                    specs.append(TopoSpec(ANTI_ZONE))
+                    specs.append(TopoSpec(ANTI_ZONE, self_select=self_sel,
+                                          selector=term.label_selector))
                 elif term.topology_key == api_labels.LABEL_HOSTNAME:
-                    specs.append(TopoSpec(ANTI_HOST))
+                    specs.append(TopoSpec(ANTI_HOST, self_select=self_sel,
+                                          selector=term.label_selector))
                 else:
                     return None, relaxable
-    if len(specs) > 1:
-        return None, relaxable  # multi-constraint groups: host path for now
+    if len(specs) == 1:
+        return specs, relaxable
+    if len(specs) == 2:
+        # supported layering: one zone-level + one hostname-level constraint,
+        # where the zone constraint is spread or affinity and the hostname
+        # constraint is spread or anti-affinity (zone choice and per-node
+        # caps compose independently in the packer). Normalize zone-first.
+        zone = [s for s in specs if s.kind in (SPREAD_ZONE, AFFINITY_ZONE)]
+        host = [s for s in specs if s.kind in (SPREAD_HOST, ANTI_HOST)]
+        if len(zone) == 1 and len(host) == 1:
+            return zone + host, relaxable
+        return None, relaxable
+    if len(specs) > 2:
+        return None, relaxable
     return specs, relaxable
 
 

@@ -498,58 +498,63 @@ class TensorScheduler:
             zone_values=zone_values, allow_undefined=allow_undefined,
             device_cache={})
 
-    def _group_selector(self, g: PodGroup):
-        """The (single) self-selecting topology selector of a group, from its
-        probe pod (grouping enforces <= 1 topology constraint per group)."""
-        probe = g.pods[0]
-        for tsc in probe.spec.topology_spread_constraints:
-            return tsc.label_selector
-        aff = probe.spec.affinity
-        if aff is not None:
-            for pa in (aff.pod_affinity, aff.pod_anti_affinity):
-                if pa is not None and pa.required:
-                    return pa.required[0].label_selector
-        return None
-
     def cluster_zone_counts(self, groups: List[PodGroup], zone_names,
                             exclude_uids) -> np.ndarray:
+        """Back-compat view of cluster_topology_counts: zone counts only."""
+        return self.cluster_topology_counts(groups, zone_names,
+                                            exclude_uids)[0]
+
+    def cluster_topology_counts(self, groups: List[PodGroup], zone_names,
+                                exclude_uids):
         """The tensor twin of Topology countDomains (topology.go:268-321):
-        initial per-zone occupancy from scheduled cluster pods matching each
-        group's topology selector, excluding the batch itself. Zone-spread
-        and zone-affinity groups consume these counts directly; hostname or
-        anti-affinity groups coupled to live cluster pods are host-path
-        territory (per-node/per-conflict state) and raise _FallbackError."""
-        from .grouping import AFFINITY_ZONE, SPREAD_ZONE
+        initial domain occupancy from scheduled cluster pods matching each
+        group's topology selectors, excluding the batch itself. Returns
+        (izc [G, Z] per-zone counts for the group's zone-level constraint,
+        exist_counts [G, N] per-packable-node counts for its hostname-level
+        constraint, host_total [G] total hostname-level matches anywhere
+        with a known node — the affinity no-bootstrap signal). The spread
+        node filter (topologynodefilter.go) applies to spread constraints
+        only; affinity groups count every matching pod."""
+        from .grouping import HOST_KINDS, SPREAD_HOST, SPREAD_ZONE, ZONE_KINDS
         from .topology import TopologyNodeFilter, ignored_for_topology
 
         zone_idx = {z: i for i, z in enumerate(zone_names)}
-        izc = np.zeros((len(groups), len(zone_names)), dtype=np.int64)
+        node_idx = {sn.name(): i for i, sn in enumerate(self.state_nodes)}
+        G = len(groups)
+        izc = np.zeros((G, len(zone_names)), dtype=np.int64)
+        exist_counts = np.zeros((G, max(1, len(self.state_nodes))),
+                                dtype=np.int64)
+        host_total = np.zeros(G, dtype=np.int64)
         for gi, g in enumerate(groups):
             # prefix probes can empty a group (all its pods belong to
             # non-prefix candidates); nothing pending means nothing to place
             if not g.topo or not g.pods:
                 continue
-            sel = self._group_selector(g)
-            if sel is None:
-                continue
             probe = g.pods[0]
-            node_filter = TopologyNodeFilter.for_pod(probe)
-            matched = False
-            for p in self.cluster.list_pods(probe.namespace, sel):
-                if p.uid in exclude_uids or ignored_for_topology(p):
-                    continue
-                labels = self.cluster.node_labels(p.spec.node_name)
-                if labels is None or not node_filter.matches_labels(labels):
-                    continue
-                matched = True
-                zone = labels.get(api_labels.LABEL_TOPOLOGY_ZONE)
-                if zone in zone_idx:
-                    izc[gi, zone_idx[zone]] += 1
-            if matched and g.topo[0].kind not in (SPREAD_ZONE, AFFINITY_ZONE):
-                raise _FallbackError(
-                    f"scheduled cluster pods couple to {g.topo[0].kind} "
-                    "topology")
-        return izc
+            spread_filter = TopologyNodeFilter.for_pod(probe)
+            for spec in g.topo:
+                if spec.selector is None:
+                    continue  # a nil selector selects nothing
+                is_spread = spec.kind in (SPREAD_ZONE, SPREAD_HOST)
+                for p in self.cluster.list_pods(probe.namespace,
+                                                spec.selector):
+                    if p.uid in exclude_uids or ignored_for_topology(p):
+                        continue
+                    labels = self.cluster.node_labels(p.spec.node_name)
+                    if labels is None:
+                        continue
+                    if is_spread and not spread_filter.matches_labels(labels):
+                        continue
+                    if spec.kind in ZONE_KINDS:
+                        zone = labels.get(api_labels.LABEL_TOPOLOGY_ZONE)
+                        if zone in zone_idx:
+                            izc[gi, zone_idx[zone]] += 1
+                    elif spec.kind in HOST_KINDS:
+                        host_total[gi] += 1
+                        n = node_idx.get(p.spec.node_name)
+                        if n is not None:
+                            exist_counts[gi, n] += 1
+        return izc, exist_counts, host_total
 
     def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
         self.fallback_reason = ""
@@ -575,6 +580,7 @@ class TensorScheduler:
 
         Z = len(problem.zone_values)
         zone_names = vocab.values[zone_key]
+        exist_counts = host_total = None
         if self.initial_zone_counts is not None:
             izc = np.zeros((len(groups), Z), dtype=np.int64)
             for gi, g in enumerate(groups):
@@ -583,16 +589,20 @@ class TensorScheduler:
                     izc[gi, z] = cnt
         else:
             # default: count scheduled cluster pods matching each group's
-            # topology selector so a deployment scale-up spreads against its
+            # topology selectors so a deployment scale-up spreads against its
             # existing replicas exactly like the host path does
-            izc = self.cluster_zone_counts(
+            izc, exist_counts, host_total = self.cluster_topology_counts(
                 groups, zone_names, {p.uid for p in pods})
 
         sn_order = sorted(range(len(self.state_nodes)),
                           key=lambda i: (not self.state_nodes[i].initialized(),
                                          self.state_nodes[i].name()))
+        if exist_counts is not None:
+            exist_counts = pad_exist_counts(problem, exist_counts)
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
-                                initial_zone_counts=izc, exist_order=sn_order)
+                                initial_zone_counts=izc, exist_order=sn_order,
+                                exist_counts=exist_counts,
+                                host_match_total=host_total)
         pr = packer.pack()
         return self._materialize(pr, problem, groups, templates, catalog,
                                  vocab, zone_key)
@@ -682,6 +692,17 @@ class TensorScheduler:
 
 class _FallbackError(Exception):
     pass
+
+
+def pad_exist_counts(problem, exist_counts: np.ndarray) -> np.ndarray:
+    """Align [G, N] matching-pod counts with the packer's (pow2-padded)
+    existing-node axis; padded rows are unpackable anyway (zero capacity)."""
+    Np = (problem.exist_avail.shape[0]
+          if problem.exist_avail is not None else 0)
+    if exist_counts.shape[1] < max(Np, 1):
+        exist_counts = np.pad(
+            exist_counts, ((0, 0), (0, max(Np, 1) - exist_counts.shape[1])))
+    return exist_counts
 
 
 def _node_remaining_daemons(sn, templates, daemonset_pods) -> dict:

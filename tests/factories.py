@@ -93,6 +93,52 @@ def affinity_term(topology_key, key="app", value="demo"):
                            label_selector=LabelSelector(match_labels={key: value}))
 
 
+def make_state_node(name, nodepool="default", cpu="4", memory="8Gi",
+                    zone=None, initialized=True, labels=None):
+    """A live StateNode the schedulers can pack onto."""
+    from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus
+    from karpenter_tpu.state.statenode import StateNode
+
+    lbl = {api_labels.LABEL_HOSTNAME: name,
+           api_labels.NODEPOOL_LABEL_KEY: nodepool}
+    if zone:
+        lbl[api_labels.LABEL_TOPOLOGY_ZONE] = zone
+    if initialized:
+        lbl[api_labels.NODE_INITIALIZED_LABEL_KEY] = "true"
+    lbl.update(labels or {})
+    alloc = res.parse_list({"cpu": cpu, "memory": memory, "pods": "110"})
+    return StateNode(node=Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=lbl),
+        spec=NodeSpec(provider_id=f"t://{name}"),
+        status=NodeStatus(capacity=dict(alloc), allocatable=alloc)))
+
+
+class StaticClusterView:
+    """ClusterView stub: scheduled pods pinned to named nodes with labels."""
+
+    def __init__(self, pods_on_nodes, node_labels):
+        self._pods = list(pods_on_nodes)
+        self._node_labels = dict(node_labels)
+
+    def list_pods(self, namespace, selector):
+        return [p for p in self._pods
+                if p.namespace == namespace and selector.matches(p.labels)]
+
+    def node_labels(self, node_name):
+        return self._node_labels.get(node_name)
+
+    def for_pods_with_anti_affinity(self):
+        return []
+
+
+def running_on(pods, node_name):
+    """Mark pods as scheduled+running on a node (countDomains inputs)."""
+    for p in pods:
+        p.spec.node_name = node_name
+        p.status.phase = "Running"
+    return pods
+
+
 def make_scheduler(nodepools, instance_types, pods, state_nodes=(), daemonset_pods=(),
                    cluster: Optional[ClusterView] = None) -> Scheduler:
     if not isinstance(instance_types, dict):
