@@ -842,7 +842,9 @@ class Packer:
             if len(candidates) == 0:
                 self._error_group(g, c, "no viable zone for zonal pod affinity")
                 return
-        z = int(candidates[0])
+        # host-parity tie-break: first domain by NAME (the oracle's affinity
+        # bootstrap iterates sorted(self.domains)), not by vocab index
+        z = int(min(candidates, key=self._zone_names.__getitem__))
         placed = self._fill_zone(g, c, z, per_node_cap, node_caps)
         self.zone_counts[g, z] += placed
         if placed < c:

@@ -78,7 +78,8 @@ class InFlightNodeClaim:
         self.host_port_usage = HostPortUsage()
         self.pods: List[Pod] = []
 
-    def add(self, pod: Pod, pod_requests: dict) -> Optional[str]:
+    def add(self, pod: Pod, pod_requests: dict,
+            pod_reqs: Optional[Requirements] = None) -> Optional[str]:
         """Returns an error string, or None on success (nodeclaim.go:67-122)."""
         errs = scheduling_taints.tolerates(self.template.taints, pod)
         if errs:
@@ -87,11 +88,14 @@ class InFlightNodeClaim:
         conflicts = self.host_port_usage.conflicts(pod, host_ports)
         if conflicts:
             return f"checking host port usage, {conflicts[0]}"
-        nodeclaim_requirements = Requirements(self.requirements.values())
-        pod_reqs = pod_requirements(pod)
-        errs = nodeclaim_requirements.compatible(pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN)
+        if pod_reqs is None:
+            pod_reqs = pod_requirements(pod)
+        # compat is non-mutating: check BEFORE paying for the copy — a pod
+        # scans many full claims per solve, and most attempts fail here
+        errs = self.requirements.compatible(pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN)
         if errs:
             return f"incompatible requirements, {errs[0]}"
+        nodeclaim_requirements = self.requirements.copy()
         nodeclaim_requirements.add(*pod_reqs.values())
 
         strict_reqs = pod_reqs
@@ -208,7 +212,8 @@ class ExistingNode:
     def initialized(self) -> bool:
         return self.state_node.initialized()
 
-    def add(self, pod: Pod, pod_requests: dict) -> Optional[str]:
+    def add(self, pod: Pod, pod_requests: dict,
+            pod_reqs: Optional[Requirements] = None) -> Optional[str]:
         errs = scheduling_taints.tolerates(self.cached_taints, pod)
         if errs:
             return errs[0]
@@ -230,11 +235,12 @@ class ExistingNode:
         requests = res.merge(self.requests, pod_requests)
         if not res.fits(requests, self.cached_available):
             return "exceeds node resources"
-        node_requirements = Requirements(self.requirements.values())
-        pod_reqs = pod_requirements(pod)
-        errs = node_requirements.compatible(pod_reqs)
+        if pod_reqs is None:
+            pod_reqs = pod_requirements(pod)
+        errs = self.requirements.compatible(pod_reqs)
         if errs:
             return errs[0]
+        node_requirements = self.requirements.copy()
         node_requirements.add(*pod_reqs.values())
         strict_reqs = pod_reqs
         if has_preferred_node_affinity(pod):
@@ -392,6 +398,9 @@ class Scheduler:
         self.new_nodeclaims: List[InFlightNodeClaim] = []
         self.existing_nodes: List[ExistingNode] = []
         self.cached_pod_requests: Dict[str, dict] = {}
+        # pod_requirements(pod) is pure until relax() mutates the pod; memo
+        # per uid saves rebuilding it on every claim attempt of the scan loop
+        self._cached_pod_reqs: Dict[str, Requirements] = {}
         self._calculate_existing_nodes(state_nodes)
 
     def _calculate_existing_nodes(self, state_nodes) -> None:
@@ -435,6 +444,7 @@ class Scheduler:
             relaxed = self.preferences.relax(pod)
             q.push(pod, relaxed)
             if relaxed:
+                self._cached_pod_reqs.pop(pod.uid, None)
                 self.topology.update(pod)
         for nc in self.new_nodeclaims:
             nc.finalize()
@@ -445,12 +455,16 @@ class Scheduler:
         """scheduler.go:267-315: existing nodes -> in-flight claims (fewest pods
         first) -> new claim from templates in weight order."""
         pod_requests = self.cached_pod_requests[pod.uid]
+        pod_reqs = self._cached_pod_reqs.get(pod.uid)
+        if pod_reqs is None:
+            pod_reqs = pod_requirements(pod)
+            self._cached_pod_reqs[pod.uid] = pod_reqs
         for node in self.existing_nodes:
-            if node.add(pod, pod_requests) is None:
+            if node.add(pod, pod_requests, pod_reqs) is None:
                 return None
         self.new_nodeclaims.sort(key=lambda n: len(n.pods))
         for nc in self.new_nodeclaims:
-            if nc.add(pod, pod_requests) is None:
+            if nc.add(pod, pod_requests, pod_reqs) is None:
                 return None
         errs = []
         for i, nct in enumerate(self.templates):
@@ -463,7 +477,7 @@ class Scheduler:
                     errs.append(f'all available instance types exceed limits for nodepool: "{nct.nodepool_name}"')
                     continue
             nc = InFlightNodeClaim(nct, self.topology, self.daemon_overhead[i], instance_types)
-            err = nc.add(pod, pod_requests)
+            err = nc.add(pod, pod_requests, pod_reqs)
             if err is not None:
                 nc.destroy()
                 errs.append(f'incompatible with nodepool "{nct.nodepool_name}", {err}')

@@ -182,19 +182,36 @@ class TensorScheduler:
             results = self._tensor_solve(groups, eligible)
         except _FallbackError as e:
             return self._host_solve(pods, str(e))
+        # the host pass only adds value over the packer for pods whose group
+        # carries relaxable preferences (the relaxation ladder,
+        # preferences.go:38-57) — for everything else it re-derives the same
+        # verdict at O(pods x claims) host cost, so packer errors on
+        # non-relaxable groups are final
+        relaxable_err = None
+        if results.pod_errors and not self.force_tensor:
+            err_uids = set(results.pod_errors)
+            relaxable_err = [
+                g for g in groups
+                if g.has_relaxable and any(p.uid in err_uids for p in g.pods)]
         if not leftover:
-            if results.pod_errors and not self.force_tensor and any(
-                    g.has_relaxable for g in groups):
+            if relaxable_err:
                 return self._host_solve(
                     pods, "unscheduled pods with relaxable preferences")
             return results
         # partitioned: the tensor bulk is committed; stragglers (plus any
-        # eligible pods the packer couldn't place — they get the host's
-        # relaxation ladder) run through a host scheduler seeded with the
-        # tensor placements, so capacity and in-flight nodes are shared
+        # relaxable-group pods the packer couldn't place — they get the
+        # host's relaxation ladder) run through a host scheduler seeded with
+        # the tensor placements, so capacity and in-flight nodes are shared
         # (scheduler.go:267-283 semantics: existing -> in-flight -> new)
-        retry = [p for p in eligible if p.uid in results.pod_errors]
-        return self._host_solve_remainder(leftover + retry, results)
+        retry = [p for g in (relaxable_err or []) for p in g.pods
+                 if p.uid in results.pod_errors]
+        retry_uids = {p.uid for p in retry}
+        kept_errors = {uid: err for uid, err in results.pod_errors.items()
+                       if uid not in retry_uids}
+        final = self._host_solve_remainder(leftover + retry, results)
+        for uid, err in kept_errors.items():
+            final.pod_errors.setdefault(uid, err)
+        return final
 
     def _host_solve(self, pods: List[Pod], reason: str) -> Results:
         self.fallback_reason = reason
@@ -525,6 +542,40 @@ class TensorScheduler:
         exist_counts = np.zeros((G, max(1, len(self.state_nodes))),
                                 dtype=np.int64)
         host_total = np.zeros(G, dtype=np.int64)
+
+        # the flagship two-constraint combo reuses one selector for both
+        # specs: memoize list_pods per (namespace, selector shape) and
+        # node_labels per node within the call
+        def sel_key(namespace: str, sel) -> tuple:
+            # LabelSelector normalizes match_labels to a tuple of pairs
+            ml = getattr(sel, "match_labels", None) or ()
+            if hasattr(ml, "items"):
+                ml = tuple(sorted(ml.items()))
+            me = getattr(sel, "match_expressions", None) or ()
+            try:
+                return (namespace, tuple(sorted(ml)), tuple(me))
+            except TypeError:
+                return (namespace, id(sel))
+
+        pods_memo: dict = {}
+        labels_memo: dict = {}
+
+        def matched(namespace: str, sel):
+            k = sel_key(namespace, sel)
+            out = pods_memo.get(k)
+            if out is None:
+                out = []
+                for p in self.cluster.list_pods(namespace, sel):
+                    if p.uid in exclude_uids or ignored_for_topology(p):
+                        continue
+                    name = p.spec.node_name
+                    if name not in labels_memo:
+                        labels_memo[name] = self.cluster.node_labels(name)
+                    if labels_memo[name] is not None:
+                        out.append(p)
+                pods_memo[k] = out
+            return out
+
         for gi, g in enumerate(groups):
             # prefix probes can empty a group (all its pods belong to
             # non-prefix candidates); nothing pending means nothing to place
@@ -536,13 +587,8 @@ class TensorScheduler:
                 if spec.selector is None:
                     continue  # a nil selector selects nothing
                 is_spread = spec.kind in (SPREAD_ZONE, SPREAD_HOST)
-                for p in self.cluster.list_pods(probe.namespace,
-                                                spec.selector):
-                    if p.uid in exclude_uids or ignored_for_topology(p):
-                        continue
-                    labels = self.cluster.node_labels(p.spec.node_name)
-                    if labels is None:
-                        continue
+                for p in matched(probe.namespace, spec.selector):
+                    labels = labels_memo[p.spec.node_name]
                     if is_spread and not spread_filter.matches_labels(labels):
                         continue
                     if spec.kind in ZONE_KINDS:

@@ -81,6 +81,10 @@ class TopologyGroup:
         self.min_domains = min_domains
         self.domains: Dict[str, int] = {d: 0 for d in domains}
         self.empty_domains: Set[str] = set(domains)
+        # occupied-domain index: hostname groups accumulate thousands of
+        # placeholder domains (one per in-flight claim), while the occupied
+        # set stays tiny — affinity selection must not scan the whole space
+        self.nonempty: Set[str] = set()
         self.owners: Set[str] = set()
 
     # identity hash so one group tracks many same-shaped pods (topologygroup.go:159-175)
@@ -104,6 +108,7 @@ class TopologyGroup:
         for d in domains:
             self.domains[d] = self.domains.get(d, 0) + 1
             self.empty_domains.discard(d)
+            self.nonempty.add(d)
 
     def register(self, *domains: str) -> None:
         for d in domains:
@@ -115,6 +120,7 @@ class TopologyGroup:
         for d in domains:
             self.domains.pop(d, None)
             self.empty_domains.discard(d)
+            self.nonempty.discard(d)
 
     def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
         if self.type == SPREAD:
@@ -165,19 +171,20 @@ class TopologyGroup:
         return Requirement(pod_domains.key, IN, [best_domain])
 
     def _any_compatible_pod_domain(self, pod_domains: Requirement) -> bool:
-        return any(pod_domains.has(d) and c > 0 for d, c in self.domains.items())
+        return any(pod_domains.has(d) for d in self.nonempty)
 
     def _next_domain_affinity(self, pod: Pod, pod_domains: Requirement,
                               node_domains: Requirement) -> Requirement:
         """topologygroup.go:253-300."""
         options = Requirement(pod_domains.key, DOES_NOT_EXIST)
-        if node_domains.operator() == IN:
+        if node_domains.operator() == IN and \
+                node_domains.length() < len(self.nonempty):
             for d in node_domains.values_list():
-                if pod_domains.has(d) and self.domains.get(d, 0) > 0:
+                if d in self.nonempty and pod_domains.has(d):
                     options.insert(d)
         else:
-            for d, c in self.domains.items():
-                if pod_domains.has(d) and c > 0 and node_domains.has(d):
+            for d in self.nonempty:
+                if pod_domains.has(d) and node_domains.has(d):
                     options.insert(d)
         if options.length() != 0:
             return options
