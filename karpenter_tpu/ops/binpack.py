@@ -314,23 +314,33 @@ class PackResult:
 def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
               c: int, max_skew: int,
               min_domains: Optional[int] = None,
-              zone_names: Optional[np.ndarray] = None) -> np.ndarray:
+              zone_names: Optional[np.ndarray] = None,
+              min_mask: Optional[np.ndarray] = None) -> np.ndarray:
     """Distribute c pods over zones the way the reference's min-count domain
     selection does (topologygroup.go:181-227): each pod goes to the lowest-count
-    admitted+viable zone subject to count+1-min <= maxSkew, min taken over all
-    admitted zones. With minDomains set and fewer admitted domains than it,
-    the global min floors to zero (topologygroup.go:240-247), so the skew
-    check binds against absolute counts. Returns per-zone allocation (pods
-    that can't place anywhere are simply not allocated; caller errors them)."""
+    admitted+viable zone subject to count+1-min <= maxSkew. The global min is
+    taken over `min_mask` — the POD's view of the domain universe
+    (topologygroup.go:229-250), which can include zones no template reaches
+    (e.g. a cluster pod in a zone the pool excludes pins the min there) —
+    defaulting to `admitted`. With minDomains set and fewer min_mask domains
+    than it, the global min floors to zero (topologygroup.go:240-247), so the
+    skew check binds against absolute counts. Returns per-zone allocation
+    (pods that can't place anywhere are simply not allocated; caller errors
+    them)."""
     counts = counts.astype(np.int64).copy()
     alloc = np.zeros_like(counts)
     remaining = c
+    if min_mask is None:
+        min_mask = admitted
     floor_zero = (min_domains is not None
-                  and int(admitted.sum()) < min_domains)
-    # fast path: every admitted zone viable -> sequential min-fill equals a
-    # closed-form water-fill (skew never binds when always filling the min;
-    # invalid under the minDomains zero floor, where skew binds absolutely)
-    if not floor_zero and admitted.any() and (viable | ~admitted).all():
+                  and int(min_mask.sum()) < min_domains)
+    # fast path: every admitted zone viable AND the pod's min universe is
+    # exactly the placement set -> sequential min-fill equals a closed-form
+    # water-fill (skew never binds when always filling the min; invalid
+    # under the minDomains zero floor or when an unreachable domain pins
+    # the global min below the fill level)
+    if not floor_zero and admitted.any() and (viable | ~admitted).all() \
+            and bool((min_mask == admitted).all()):
         idx = np.where(admitted)[0]
         cz = counts[idx]
         # largest level L with sum(max(0, L - cz)) <= remaining
@@ -352,7 +362,7 @@ def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
         if floor_zero:
             m0 = 0
         else:
-            m0 = counts[admitted].min() if admitted.any() else 0
+            m0 = counts[min_mask].min() if min_mask.any() else 0
         eligible = viable & admitted & (counts + 1 - m0 <= max_skew)
         if not eligible.any():
             break
@@ -753,6 +763,22 @@ class Packer:
             viable |= self.t.it_ok_z[g, m].any(axis=0)
         return admitted, viable
 
+    def _zone_min_mask(self, g: int, admitted: np.ndarray) -> np.ndarray:
+        """The pod's view of the domain universe for global-min/minDomains
+        arithmetic (topologygroup.go:229-250): every registered domain the
+        POD's own requirements admit. The universe spans ALL templates'
+        admitted zones — including templates the group can't actually use
+        (tainted pools, incompatible requirements): a zero-count zone behind
+        an intolerable taint still pins the reference's global min at 0 —
+        plus zones holding recorded cluster pods (izc) that no template
+        reaches at all."""
+        greq = self.groups[g].requirements.get(api_labels.LABEL_TOPOLOGY_ZONE)
+        pod_admits = np.fromiter((greq.has(z) for z in self._zone_names),
+                                 dtype=bool, count=self.Z)
+        # zone_adm[g, m] is already pod-side-intersected (combined reqs)
+        return self.t.zone_adm[g].any(axis=0) | \
+            (pod_admits & (self.zone_counts[g] > 0))
+
     def _fill_zone(self, g: int, a: int, z: int, per_node_cap: int,
                    node_caps: Optional[np.ndarray]) -> int:
         placed = self._fill_existing(g, a, z, per_node_cap, node_caps)
@@ -768,7 +794,8 @@ class Packer:
             return
         alloc = waterfill(self.zone_counts[g], viable, admitted, c,
                           spec.max_skew, spec.min_domains,
-                          zone_names=self._zone_names)
+                          zone_names=self._zone_names,
+                          min_mask=self._zone_min_mask(g, admitted))
         placed_total = 0
         for z in np.argsort(-alloc):
             a = int(alloc[z])
@@ -793,9 +820,11 @@ class Packer:
             self._error_group(g, c, "no zone admitted for topology spread")
             return
         counts = self.zone_counts[g]
+        min_mask = self._zone_min_mask(g, admitted)
         floor_zero = (spec.min_domains is not None
-                      and int(admitted.sum()) < spec.min_domains)
-        gmin = 0 if floor_zero else int(counts[admitted].min())
+                      and int(min_mask.sum()) < spec.min_domains)
+        gmin = 0 if floor_zero else (int(counts[min_mask].min())
+                                     if min_mask.any() else 0)
         eligible = admitted & (counts - gmin <= spec.max_skew)
         if not eligible.any():
             self._error_group(g, c, "unsatisfiable zonal topology spread")
@@ -820,8 +849,12 @@ class Packer:
                             node_caps: Optional[np.ndarray] = None) -> None:
         admitted, viable = self._zone_admitted_viable(g)
         counts = self.zone_counts[g]
-        occupied = (counts > 0) & admitted
+        # occupancy is judged through the POD's domain view: a matching pod
+        # in a zone no template reaches still blocks the bootstrap
+        # (nextDomainAffinity returns empty options, not a fresh domain)
+        occupied = (counts > 0) & self._zone_min_mask(g, admitted)
         if occupied.any():
+            occupied &= admitted
             # pods must join an occupied domain (topologygroup.go:253-300);
             # if none of those domains has a viable instance type the pods
             # fail — there is NO bootstrap while matching pods exist

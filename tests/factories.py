@@ -128,7 +128,13 @@ class StaticClusterView:
         return self._node_labels.get(node_name)
 
     def for_pods_with_anti_affinity(self):
-        return []
+        for p in self._pods:
+            aff = p.spec.affinity
+            if aff is not None and aff.pod_anti_affinity is not None \
+                    and aff.pod_anti_affinity.required:
+                labels = self._node_labels.get(p.spec.node_name)
+                if labels is not None:
+                    yield p, labels
 
 
 def running_on(pods, node_name):

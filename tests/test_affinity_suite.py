@@ -1,0 +1,343 @@
+"""Scenario port of the pod (anti-)affinity half of
+/root/reference/pkg/controllers/provisioning/scheduling/topology_test.go
+(:1393-2449): cross-pod affinity, self-affinity bootstrap, zonal
+anti-affinity incl. the Schrödinger batch-order case, inverse anti-affinity
+from existing cluster pods, namespace filtering, and dependent-affinity
+chains. Host oracle is the conformance target; kernel-eligible shapes are
+additionally run through the tensor path."""
+
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (Affinity, LabelSelector,
+                                       NodeSelectorRequirement, PodAffinity,
+                                       PodAffinityTerm)
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+from factories import (StaticClusterView, affinity_term, make_nodepool,
+                       make_pod, make_pods, make_scheduler, running_on)
+
+ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+HOST = api_labels.LABEL_HOSTNAME
+ARCH = api_labels.LABEL_ARCH
+
+
+def its():
+    return kwok.construct_instance_types()
+
+
+def sel(**labels):
+    return LabelSelector(match_labels=dict(labels))
+
+
+def three_zone_pool():
+    return make_nodepool(requirements=[NodeSelectorRequirement(
+        ZONE, "In", ("test-zone-a", "test-zone-b", "test-zone-c"))])
+
+
+def hsolve(pods, pools=None, catalog=None, view=None, state_nodes=()):
+    pools = pools or [make_nodepool()]
+    catalog = catalog if catalog is not None else its()
+    s = make_scheduler(pools, catalog, pods, state_nodes=state_nodes,
+                       cluster=view)
+    return s.solve(pods)
+
+
+def placement_of(results, pod):
+    """(claim-or-node object, kind) hosting the pod, or (None, None)."""
+    for nc in results.new_nodeclaims:
+        if any(p.uid == pod.uid for p in nc.pods):
+            return nc, "new"
+    for en in results.existing_nodes:
+        if any(p.uid == pod.uid for p in en.pods):
+            return en, "existing"
+    return None, None
+
+
+class TestPodAffinity:
+    def test_empty_affinity_schedules(self):
+        pod = make_pod()
+        pod.spec.affinity = Affinity(pod_affinity=PodAffinity(),
+                                     pod_anti_affinity=PodAffinity())
+        h = hsolve([pod])
+        assert not h.pod_errors
+
+    def test_affinity_hostname_colocates_with_target(self):
+        """topology_test.go:1403-1436: followers land on the target's node."""
+        target = make_pod(cpu="500m", labels={"app": "target"})
+        followers = make_pods(5, cpu="100m", labels={"app": "client"},
+                              pod_affinity=[PodAffinityTerm(
+                                  topology_key=HOST,
+                                  label_selector=sel(app="target"))])
+        h = hsolve([target] + followers)
+        assert not h.pod_errors
+        tgt_claim, _ = placement_of(h, target)
+        for f in followers:
+            claim, _ = placement_of(h, f)
+            assert claim is tgt_claim
+
+    def test_affinity_arch_topology(self):
+        """topology_test.go:1437-1479: affinity over the arch topology —
+        followers share the target's architecture, not its node."""
+        target = make_pod(labels={"app": "target"},
+                          node_selector={ARCH: "arm64"})
+        followers = make_pods(3, labels={"app": "client"},
+                              pod_affinity=[PodAffinityTerm(
+                                  topology_key=ARCH,
+                                  label_selector=sel(app="target"))])
+        h = hsolve([target] + followers)
+        assert not h.pod_errors
+        for f in followers:
+            claim, _ = placement_of(h, f)
+            assert claim.requirements.get(ARCH).values_list() == ["arm64"]
+
+    def test_self_affinity_first_empty_domain_only(self):
+        """topology_test.go:1504-1545: the hostname domain is fixed by the
+        first placement; overflow beyond one node's capacity is
+        unschedulable, never a second node."""
+        small = [it for it in its() if it.capacity.get("cpu", 0) <= 2000]
+        pods = make_pods(10, cpu="500m", labels={"security": "s2"},
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=HOST,
+                             label_selector=sel(security="s2"))])
+        h = hsolve(pods, catalog=small)
+        assert len(h.new_nodeclaims) == 1
+        assert len(h.pod_errors) > 0
+        assert len(h.new_nodeclaims[0].pods) + len(h.pod_errors) == 10
+
+    def test_self_affinity_zone_with_constraint(self):
+        """topology_test.go:1614-1644: a zone selector on the pods narrows
+        the self-affinity domain to that zone."""
+        pods = make_pods(4, labels={"security": "s2"},
+                         node_selector={ZONE: "test-zone-b"},
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=ZONE,
+                             label_selector=sel(security="s2"))])
+        h = hsolve(pods)
+        assert not h.pod_errors
+        for nc in h.new_nodeclaims:
+            assert nc.requirements.get(ZONE).values_list() == ["test-zone-b"]
+
+    def test_preferred_affinity_violated_when_impossible(self):
+        """topology_test.go:1698-1730: preferred affinity to a pod that
+        doesn't exist relaxes away."""
+        pods = make_pods(2, labels={"app": "client"},
+                         preferred_pod_affinity=[(10, PodAffinityTerm(
+                             topology_key=HOST,
+                             label_selector=sel(app="no-such")))])
+        h = hsolve(pods)
+        assert not h.pod_errors
+
+    def test_preferred_anti_affinity_violated_when_needed(self):
+        """topology_test.go:1731-1763."""
+        pods = make_pods(3, cpu="100m", labels={"app": "demo"},
+                         preferred_pod_anti_affinity=[(10, PodAffinityTerm(
+                             topology_key=ZONE,
+                             label_selector=sel(app="demo")))])
+        pool = three_zone_pool()
+        h = hsolve(pods + make_pods(2, cpu="100m", labels={"app": "demo"},
+                                    preferred_pod_anti_affinity=[
+                                        (10, PodAffinityTerm(
+                                            topology_key=ZONE,
+                                            label_selector=sel(app="demo")))]),
+                   pools=[pool])
+        # 5 pods, 3 zones: at least two must violate the preference
+        assert not h.pod_errors
+
+    def test_affinity_to_non_existent_pod_unschedulable(self):
+        """topology_test.go:2177-2193 — also kernel-eligible (non-self
+        zonal affinity with no matches has no bootstrap)."""
+        def pods():
+            return make_pods(2, labels={"app": "client"},
+                             pod_affinity=[PodAffinityTerm(
+                                 topology_key=ZONE,
+                                 label_selector=sel(app="no-such"))])
+        h = hsolve(pods())
+        assert len(h.pod_errors) == 2
+        it_map = {"default": its()}
+        ts = TensorScheduler([make_nodepool()], it_map, force_tensor=True)
+        t = ts.solve(pods())
+        assert ts.fallback_reason == ""
+        assert len(t.pod_errors) == 2
+
+    def test_multiple_dependent_affinities(self):
+        """topology_test.go:2256-2290: a -> b -> c -> d hostname chain all
+        collapse onto one node."""
+        a = make_pod(cpu="100m", labels={"app": "a"})
+        b = make_pod(cpu="100m", labels={"app": "b"},
+                     pod_affinity=[PodAffinityTerm(topology_key=HOST,
+                                                   label_selector=sel(app="a"))])
+        c = make_pod(cpu="100m", labels={"app": "c"},
+                     pod_affinity=[PodAffinityTerm(topology_key=HOST,
+                                                   label_selector=sel(app="b"))])
+        d = make_pod(cpu="100m", labels={"app": "d"},
+                     pod_affinity=[PodAffinityTerm(topology_key=HOST,
+                                                   label_selector=sel(app="c"))])
+        h = hsolve([a, b, c, d])
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 1
+
+    def test_unsatisfiable_dependency_fails(self):
+        """topology_test.go:2291-2306: b must join a's node but is pinned to
+        a different zone."""
+        a = make_pod(cpu="100m", labels={"app": "a"},
+                     node_selector={ZONE: "test-zone-a"})
+        b = make_pod(cpu="100m", labels={"app": "b"},
+                     node_selector={ZONE: "test-zone-b"},
+                     pod_affinity=[PodAffinityTerm(topology_key=HOST,
+                                                   label_selector=sel(app="a"))])
+        h = hsolve([a, b])
+        assert len(h.pod_errors) == 1
+        assert b.uid in h.pod_errors
+
+
+class TestPodAntiAffinity:
+    def test_separate_nodes_on_hostname(self):
+        """topology_test.go:1764-1785, both batch orders."""
+        for order in (0, 1):
+            target = make_pod(cpu="500m", labels={"security": "s2"})
+            avoider = make_pod(cpu="500m",
+                               pod_anti_affinity=[PodAffinityTerm(
+                                   topology_key=HOST,
+                                   label_selector=sel(security="s2"))])
+            batch = [avoider, target] if order == 0 else [target, avoider]
+            h = hsolve(batch)
+            assert not h.pod_errors
+            c1, _ = placement_of(h, target)
+            c2, _ = placement_of(h, avoider)
+            assert c1 is not c2
+
+    def test_anti_zone_all_zones_occupied(self):
+        """topology_test.go:1786-1824: matching pods pinned to every zone
+        the pool offers -> the avoider is unschedulable."""
+        pool = three_zone_pool()
+        zoned = [make_pod(cpu="2", labels={"security": "s2"},
+                          node_selector={ZONE: z})
+                 for z in ("test-zone-a", "test-zone-b", "test-zone-c")]
+        avoider = make_pod(pod_anti_affinity=[PodAffinityTerm(
+            topology_key=ZONE, label_selector=sel(security="s2"))])
+        h = hsolve(zoned + [avoider], pools=[pool])
+        assert set(h.pod_errors) == {avoider.uid}
+
+    def test_anti_zone_target_zone_unknown(self):
+        """topology_test.go:1825-1846: the matching pod schedules anywhere,
+        so every zone is potentially poisoned within the batch."""
+        pool = three_zone_pool()
+        target = make_pod(cpu="2", labels={"security": "s2"})
+        avoider = make_pod(pod_anti_affinity=[PodAffinityTerm(
+            topology_key=ZONE, label_selector=sel(security="s2"))])
+        h = hsolve([target, avoider], pools=[pool])
+        assert set(h.pod_errors) == {avoider.uid}
+
+    def test_anti_zone_schroedinger(self):
+        """topology_test.go:1966-1996: in-batch, the avoider commits first
+        (FFD order) and poisons every zone for the matching pod; once the
+        avoider is COMMITTED to a zone (next batch, via the cluster), the
+        matching pod schedules into another zone."""
+        pool = three_zone_pool()
+        avoider = make_pod(cpu="2", pod_anti_affinity=[PodAffinityTerm(
+            topology_key=ZONE, label_selector=sel(security="s2"))])
+        labeled = make_pod(cpu="100m", labels={"security": "s2"})
+        h = hsolve([avoider, labeled], pools=[pool])
+        assert set(h.pod_errors) == {labeled.uid}
+        claim, _ = placement_of(h, avoider)
+        # the claim stays UNcommitted across the pool's zones — the actual
+        # zone is decided at node creation (that's the Schrödinger point)
+        options = claim.requirements.get(ZONE).values_list()
+        assert len(options) == 3
+        committed = sorted(options)[0]  # node creation picks one
+
+        # batch 2: the avoider is now a running pod on a real node
+        view = StaticClusterView(
+            running_on([avoider], "node-committed"),
+            {"node-committed": {ZONE: committed,
+                                HOST: "node-committed"}})
+        labeled2 = make_pod(cpu="100m", labels={"security": "s2"})
+        h2 = hsolve([labeled2], pools=[pool], view=view)
+        assert not h2.pod_errors
+        claim2, _ = placement_of(h2, labeled2)
+        z2 = claim2.requirements.get(ZONE).values_list()
+        assert committed not in z2
+
+    def test_inverse_anti_affinity_with_existing_pods(self):
+        """topology_test.go:1997-2046: existing pods with required
+        anti-affinity in every pool zone block a matching newcomer."""
+        pool = three_zone_pool()
+        anti = [PodAffinityTerm(topology_key=ZONE,
+                                label_selector=sel(security="s2"))]
+        existing, labels_map = [], {}
+        for i, z in enumerate(("test-zone-a", "test-zone-b", "test-zone-c")):
+            p = make_pod(cpu="2", pod_anti_affinity=list(anti))
+            running_on([p], f"anti-node-{i}")
+            existing.append(p)
+            labels_map[f"anti-node-{i}"] = {ZONE: z, HOST: f"anti-node-{i}"}
+        view = StaticClusterView(existing, labels_map)
+        newcomer = make_pod(labels={"security": "s2"})
+        h = hsolve([newcomer], pools=[pool], view=view)
+        assert set(h.pod_errors) == {newcomer.uid}
+
+    def test_preferred_inverse_anti_affinity_is_ignored(self):
+        """topology_test.go:2047-2096: only REQUIRED anti-affinity terms of
+        existing pods poison domains; preferred terms don't."""
+        pool = three_zone_pool()
+        existing, labels_map = [], {}
+        for i, z in enumerate(("test-zone-a", "test-zone-b", "test-zone-c")):
+            p = make_pod(cpu="2", preferred_pod_anti_affinity=[
+                (10, PodAffinityTerm(topology_key=ZONE,
+                                     label_selector=sel(security="s2")))])
+            running_on([p], f"pref-node-{i}")
+            existing.append(p)
+            labels_map[f"pref-node-{i}"] = {ZONE: z, HOST: f"pref-node-{i}"}
+        view = StaticClusterView(existing, labels_map)
+        newcomer = make_pod(labels={"security": "s2"})
+        h = hsolve([newcomer], pools=[pool], view=view)
+        assert not h.pod_errors
+
+    def test_anti_affinity_via_zone_topology_batch(self):
+        """topology_test.go:2132-2176: N mutually-anti pods, one schedules
+        per batch (late committal) — and the tensor path agrees."""
+        def pods():
+            return make_pods(3, labels={"app": "demo"},
+                             pod_anti_affinity=[affinity_term(ZONE)])
+        h = hsolve(pods())
+        assert len(h.pod_errors) == 2
+        it_map = {"default": its()}
+        ts = TensorScheduler([make_nodepool()], it_map, force_tensor=True)
+        t = ts.solve(pods())
+        assert len(t.pod_errors) == 2
+
+
+class TestAffinityNamespaces:
+    """topology_test.go:2307-2449."""
+
+    def _target_elsewhere(self):
+        target = make_pod(labels={"app": "target"}, namespace="other")
+        running_on([target], "other-node")
+        return StaticClusterView([target], {
+            "other-node": {ZONE: "test-zone-a", HOST: "other-node"}})
+
+    def test_no_namespaces_no_matches(self):
+        """Matching pods in another namespace don't count without an
+        explicit namespace list -> affinity unsatisfiable."""
+        view = self._target_elsewhere()
+        follower = make_pod(labels={"app": "client"},
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=ZONE,
+                                label_selector=sel(app="target"))])
+        h = hsolve([follower], view=view)
+        assert set(h.pod_errors) == {follower.uid}
+
+    def test_namespace_list_matches(self):
+        view = self._target_elsewhere()
+        follower = make_pod(labels={"app": "client"},
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=ZONE,
+                                label_selector=sel(app="target"),
+                                namespaces=("other",))])
+        h = hsolve([follower], view=view)
+        assert not h.pod_errors
+        claim, _ = placement_of(h, follower)
+        assert claim.requirements.get(ZONE).values_list() == ["test-zone-a"]

@@ -1,0 +1,337 @@
+"""Scenario port of /root/reference/pkg/controllers/provisioning/scheduling/
+suite_test.go (3,916 LoC): custom constraints (node selectors x NodePool
+requirements x operators), preferential fallback (required-term and
+preferred-term relaxation ladders), instance-type compatibility, binpacking,
+daemonset overhead, and existing-node packing. Host oracle is the
+conformance target; plain-constraint scenarios also assert tensor parity."""
+
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (NodeSelectorRequirement, ObjectMeta,
+                                       Pod, PodSpec)
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.utils import resources as res
+
+from factories import (make_nodepool, make_pod, make_pods, make_scheduler,
+                       make_state_node)
+
+ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+ARCH = api_labels.LABEL_ARCH
+OS = api_labels.LABEL_OS
+IT = api_labels.LABEL_INSTANCE_TYPE
+CT = api_labels.CAPACITY_TYPE_LABEL_KEY
+
+
+def its():
+    return kwok.construct_instance_types()
+
+
+def hsolve(pods, pools=None, catalog=None, state_nodes=(), daemons=()):
+    pools = pools or [make_nodepool()]
+    catalog = catalog if catalog is not None else its()
+    s = make_scheduler(pools, catalog, pods, state_nodes=state_nodes,
+                       daemonset_pods=daemons)
+    return s.solve(pods)
+
+
+def tsolve(pods, pools=None, catalog=None):
+    pools = pools or [make_nodepool()]
+    catalog = catalog if catalog is not None else its()
+    it_map = {p.name: list(catalog) for p in pools}
+    ts = TensorScheduler(pools, it_map, force_tensor=True)
+    r = ts.solve(pods)
+    assert ts.fallback_reason == "", ts.fallback_reason
+    return r
+
+
+class TestCustomConstraints:
+    """suite_test.go:142-467 — pool labels/requirements x pod selectors."""
+
+    def test_unconstrained_pod_schedules(self):
+        assert not hsolve([make_pod()]).pod_errors
+
+    def test_conflicting_node_selector_fails(self):
+        pool = make_nodepool(labels={"team": "a"})
+        h = hsolve([make_pod(node_selector={"team": "b"})], pools=[pool])
+        assert len(h.pod_errors) == 1
+
+    def test_matching_pool_label_schedules(self):
+        pool = make_nodepool(labels={"team": "a"})
+        h = hsolve([make_pod(node_selector={"team": "a"})], pools=[pool])
+        assert not h.pod_errors
+
+    def test_undefined_selector_key_fails(self):
+        # nothing in the pool or catalog defines "mystery"
+        h = hsolve([make_pod(node_selector={"mystery": "x"})])
+        assert len(h.pod_errors) == 1
+
+    def test_pool_requirement_defines_custom_key(self):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("team", "In", ("a", "b"))])
+        h = hsolve([make_pod(node_selector={"team": "a"})], pools=[pool])
+        assert not h.pod_errors
+
+    def test_selector_outside_pool_requirement_fails(self):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("team", "In", ("a", "b"))])
+        h = hsolve([make_pod(node_selector={"team": "c"})], pools=[pool])
+        assert len(h.pod_errors) == 1
+
+    @pytest.mark.parametrize("op,values,ok", [
+        ("In", ("test-zone-a",), True),
+        ("In", ("no-such-zone",), False),
+        ("NotIn", ("test-zone-a",), True),
+        ("Exists", (), True),
+        ("DoesNotExist", (), False),  # every node has a zone
+    ])
+    def test_zone_requirement_operators(self, op, values, ok):
+        req = [[NodeSelectorRequirement(ZONE, op, values)]]
+        h = hsolve([make_pod(required_affinity=req)])
+        assert (not h.pod_errors) is ok
+
+    def test_gt_lt_requirements(self):
+        """suite_test.go:253-270 over an integer-valued custom key."""
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("gen", "In", ("2", "4", "8"))])
+        ok = hsolve([make_pod(required_affinity=[[
+            NodeSelectorRequirement("gen", "Gt", ("3",))]])], pools=[pool])
+        assert not ok.pod_errors
+        bad = hsolve([make_pod(required_affinity=[[
+            NodeSelectorRequirement("gen", "Gt", ("8",))]])], pools=[pool])
+        assert len(bad.pod_errors) == 1
+        ok2 = hsolve([make_pod(required_affinity=[[
+            NodeSelectorRequirement("gen", "Lt", ("3",))]])], pools=[pool])
+        assert not ok2.pod_errors
+
+    def test_notin_on_undefined_key_schedules(self):
+        """suite_test.go:484-512: NotIn/DoesNotExist tolerate unknown keys."""
+        h = hsolve([make_pod(required_affinity=[[
+            NodeSelectorRequirement("mystery", "NotIn", ("x",))]])])
+        assert not h.pod_errors
+        h2 = hsolve([make_pod(required_affinity=[[
+            NodeSelectorRequirement("mystery", "DoesNotExist", ())]])])
+        assert not h2.pod_errors
+
+    def test_hostname_selector_never_schedules(self):
+        """suite_test.go:214-221: you can't target a node that doesn't
+        exist yet by hostname."""
+        h = hsolve([make_pod(node_selector={
+            api_labels.LABEL_HOSTNAME: "some-node"})])
+        assert len(h.pod_errors) == 1
+
+    def test_compatible_pods_share_a_node(self):
+        """suite_test.go:592-611."""
+        a = make_pod(cpu="100m", required_affinity=[[
+            NodeSelectorRequirement(ZONE, "In",
+                                    ("test-zone-a", "test-zone-b"))]])
+        b = make_pod(cpu="100m", node_selector={ZONE: "test-zone-a"})
+        h = hsolve([a, b])
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 1
+        assert h.new_nodeclaims[0].requirements.get(ZONE).values_list() == \
+            ["test-zone-a"]
+
+    def test_incompatible_pods_get_separate_nodes(self):
+        """suite_test.go:612-631."""
+        a = make_pod(cpu="100m", node_selector={ZONE: "test-zone-a"})
+        b = make_pod(cpu="100m", node_selector={ZONE: "test-zone-b"})
+        h = hsolve([a, b])
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 2
+        t = tsolve([make_pod(cpu="100m", node_selector={ZONE: "test-zone-a"}),
+                    make_pod(cpu="100m", node_selector={ZONE: "test-zone-b"})])
+        assert len(t.new_nodeclaims) == 2
+
+    @pytest.mark.parametrize("key,value", [
+        (ZONE, "test-zone-b"),
+        (ARCH, "arm64"),
+        (OS, "linux"),
+        (CT, "spot"),
+    ])
+    def test_well_known_label_selectors_schedule(self, key, value):
+        h = hsolve([make_pod(node_selector={key: value})])
+        assert not h.pod_errors
+        for nc in h.new_nodeclaims:
+            assert nc.requirements.get(key).values_list() == [value]
+
+
+class TestPreferentialFallback:
+    """suite_test.go:1092-1212."""
+
+    def test_final_required_term_not_relaxed(self):
+        req = [[NodeSelectorRequirement(ZONE, "In", ("invalid",))]]
+        h = hsolve([make_pod(required_affinity=req)])
+        assert len(h.pod_errors) == 1
+
+    def test_relaxes_multiple_required_terms(self):
+        req = [
+            [NodeSelectorRequirement(ZONE, "In", ("invalid",))],
+            [NodeSelectorRequirement(ZONE, "In", ("also-invalid",))],
+            [NodeSelectorRequirement(ZONE, "In", ("test-zone-a",))],
+            [NodeSelectorRequirement(ZONE, "In", ("test-zone-b",))],
+        ]
+        h = hsolve([make_pod(required_affinity=req)])
+        assert not h.pod_errors
+        claim = h.new_nodeclaims[0]
+        assert claim.requirements.get(ZONE).values_list() == ["test-zone-a"]
+
+    def test_relaxes_all_preferred_terms(self):
+        pref = [(1, [NodeSelectorRequirement(ZONE, "In", ("invalid",))]),
+                (1, [NodeSelectorRequirement(IT, "In", ("invalid",))])]
+        h = hsolve([make_pod(preferred_affinity=pref)])
+        assert not h.pod_errors
+
+    def test_relaxes_heaviest_preference_first(self):
+        """suite_test.go:1155-1186: weight-100 impossible preference drops
+        first; the weight-50 zone preference then holds."""
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            ZONE, "In", ("test-zone-a", "test-zone-b"))])
+        pref = [
+            (100, [NodeSelectorRequirement(IT, "In", ("no-such-type",))]),
+            (50, [NodeSelectorRequirement(ZONE, "In", ("test-zone-b",))]),
+            (1, [NodeSelectorRequirement(ZONE, "In", ("test-zone-a",))]),
+        ]
+        h = hsolve([make_pod(preferred_affinity=pref)], pools=[pool])
+        assert not h.pod_errors
+        claim = h.new_nodeclaims[0]
+        assert claim.requirements.get(ZONE).values_list() == ["test-zone-b"]
+
+    def test_requirement_beats_conflicting_preference(self):
+        req = [[NodeSelectorRequirement(ZONE, "In", ("test-zone-c",))]]
+        pref = [(1, [NodeSelectorRequirement(ZONE, "NotIn", ("test-zone-c",))])]
+        h = hsolve([make_pod(required_affinity=req, preferred_affinity=pref)])
+        assert not h.pod_errors
+        claim = h.new_nodeclaims[0]
+        assert claim.requirements.get(ZONE).values_list() == ["test-zone-c"]
+
+    def test_conflicting_preferences_schedule(self):
+        pref = [(1, [NodeSelectorRequirement(ZONE, "In", ("invalid",)),
+                     NodeSelectorRequirement(ZONE, "NotIn", ("invalid",))])]
+        h = hsolve([make_pod(preferred_affinity=pref)])
+        assert not h.pod_errors
+
+
+class TestInstanceTypeCompatibility:
+    """suite_test.go:1213-1500."""
+
+    def test_arch_selector_filters_instance_types(self):
+        h = hsolve([make_pod(node_selector={ARCH: "arm64"})])
+        assert not h.pod_errors
+        for nc in h.new_nodeclaims:
+            for it in nc.instance_type_options:
+                assert it.requirements.get(ARCH).values_list() == ["arm64"]
+
+    def test_instance_type_selector_pins_type(self):
+        name = its()[0].name
+        h = hsolve([make_pod(node_selector={IT: name})])
+        assert not h.pod_errors
+        assert [i.name for i in h.new_nodeclaims[0].instance_type_options] \
+            == [name]
+
+    def test_oversized_pod_fails(self):
+        h = hsolve([make_pod(cpu="10000")])
+        assert len(h.pod_errors) == 1
+        t = tsolve([make_pod(cpu="10000")])
+        assert len(t.pod_errors) == 1
+
+    def test_memory_bound_filtering(self):
+        """Only instance types with enough memory survive in the claim."""
+        h = hsolve([make_pod(cpu="100m", memory="100Gi")])
+        assert not h.pod_errors
+        need = res.parse_list({"memory": "100Gi"})["memory"]
+        for it in h.new_nodeclaims[0].instance_type_options:
+            assert it.allocatable().get("memory", 0) >= need
+
+
+class TestBinpacking:
+    """suite_test.go:1501-1817."""
+
+    def test_packs_small_pods_densely(self):
+        h = hsolve(make_pods(20, cpu="100m", memory="64Mi"))
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 1
+
+    def test_large_pods_split_across_nodes(self):
+        biggest = max(it.capacity.get("cpu", 0) for it in its())
+        per_pod = biggest // 2 + 1  # two can never share the largest node
+        pods = [Pod(metadata=ObjectMeta(name=f"big-{i}", namespace="default"),
+                    spec=PodSpec(),
+                    container_requests=[{"cpu": per_pod}])
+                for i in range(3)]
+        h = hsolve(pods)
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 3
+
+    def test_ffd_order_big_pods_first(self):
+        """Mixed sizes pack big-first so smalls backfill (queue.go:76-112)."""
+        pods = make_pods(2, cpu="3") + make_pods(10, cpu="100m")
+        h = hsolve(pods)
+        assert not h.pod_errors
+        # smalls should have backfilled into the big pods' nodes
+        assert len(h.new_nodeclaims) <= 3
+
+    def test_daemonset_overhead_reserved(self):
+        """suite_test.go:2153+: daemonset requests shrink the usable node."""
+        daemon = make_pod(cpu="1", memory="1Gi")
+        h = hsolve(make_pods(4, cpu="500m"), daemons=[daemon])
+        assert not h.pod_errors
+        for nc in h.new_nodeclaims:
+            want = 4_000 // len(h.new_nodeclaims) * 500 // 500
+            assert nc.requests.get("cpu", 0) >= 1_000  # daemon included
+
+    def test_daemonset_with_incompatible_selector_not_counted(self):
+        daemon = make_pod(cpu="10", node_selector={"no-such": "label"})
+        h = hsolve(make_pods(2, cpu="500m"), daemons=[daemon])
+        assert not h.pod_errors
+        for nc in h.new_nodeclaims:
+            assert nc.requests.get("cpu", 0) < 10_000
+
+
+class TestExistingNodes:
+    """suite_test.go:2427-2607."""
+
+    def test_prefers_existing_capacity(self):
+        sn = make_state_node("live-1", cpu="8", memory="16Gi")
+        h = hsolve(make_pods(4, cpu="500m"), state_nodes=[sn])
+        assert not h.pod_errors
+        assert not h.new_nodeclaims
+        assert sum(len(en.pods) for en in h.existing_nodes) == 4
+
+    def test_overflow_spills_to_new_node(self):
+        sn = make_state_node("live-1", cpu="1", memory="2Gi")
+        h = hsolve(make_pods(4, cpu="500m", memory="256Mi"),
+                   state_nodes=[sn])
+        assert not h.pod_errors
+        assert h.new_nodeclaims  # the 1-cpu node can't hold all four
+        assert sum(len(en.pods) for en in h.existing_nodes) >= 1
+
+    def test_existing_node_taints_respected(self):
+        from karpenter_tpu.api.objects import Taint
+        sn = make_state_node("tainted", cpu="8")
+        sn.node.spec.taints = [Taint(key="dedicated", value="x")]
+        h = hsolve(make_pods(2, cpu="500m"), state_nodes=[sn])
+        assert not h.pod_errors
+        assert all(not en.pods for en in h.existing_nodes)
+        assert h.new_nodeclaims
+
+    def test_existing_node_zone_counts_for_topology(self):
+        """An existing node's zone participates in spread accounting."""
+        from factories import spread_zone
+        sn = make_state_node("live-a", zone="test-zone-a", cpu="32",
+                             memory="64Gi")
+        pods = make_pods(4, cpu="100m", labels={"app": "demo"},
+                         spread=[spread_zone(key="app", value="demo")])
+        h = hsolve(pods, state_nodes=[sn])
+        assert not h.pod_errors
+        zones = Counter()
+        for nc in h.new_nodeclaims:
+            zv = nc.requirements.get(ZONE).values_list()
+            if len(zv) == 1:
+                zones[zv[0]] += len(nc.pods)
+        for en in h.existing_nodes:
+            zones["test-zone-a"] += len(en.pods)
+        assert max(zones.values()) - min(zones.values()) <= 1

@@ -1,0 +1,445 @@
+"""Scenario port of /root/reference/pkg/controllers/provisioning/scheduling/
+topology_test.go (2,502 LoC of Ginkgo tables): zonal/hostname/capacity-type/
+arch spreads, minDomains, spread-option limiting, pod (anti-)affinity,
+inverse anti-affinity, namespace filtering, taints. The host oracle is the
+conformance target; scenarios the tensor kernel claims are additionally
+asserted tensor-vs-host (tensor_solve) — the rest run host-only."""
+
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (LabelSelector, NodeSelectorRequirement,
+                                       PodAffinityTerm, Taint, Toleration,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+from factories import (StaticClusterView, affinity_term, make_nodepool,
+                       make_pod, make_pods, make_scheduler, make_state_node,
+                       running_on)
+
+ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+HOST = api_labels.LABEL_HOSTNAME
+CT = api_labels.CAPACITY_TYPE_LABEL_KEY
+ARCH = api_labels.LABEL_ARCH
+ZONES = ("test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d")
+
+
+def its():
+    return kwok.construct_instance_types()
+
+
+def tsc(key=ZONE, max_skew=1, value="demo", min_domains=None,
+        anyway=False, expressions=None):
+    sel = (LabelSelector(match_expressions=tuple(expressions))
+           if expressions is not None
+           else LabelSelector(match_labels={"app": value}))
+    return TopologySpreadConstraint(
+        topology_key=key, max_skew=max_skew,
+        when_unsatisfiable=("ScheduleAnyway" if anyway else "DoNotSchedule"),
+        label_selector=sel, min_domains=min_domains)
+
+
+def zone_pool(*zones, name="default"):
+    return make_nodepool(name=name, requirements=[
+        NodeSelectorRequirement(ZONE, "In", tuple(zones))])
+
+
+def hsolve(pods, pools=None, catalog=None, view=None, state_nodes=()):
+    pools = pools or [make_nodepool()]
+    catalog = catalog if catalog is not None else its()
+    s = make_scheduler(pools, catalog, pods, state_nodes=state_nodes,
+                       cluster=view)
+    return s.solve(pods)
+
+
+def tsolve(pods, pools=None, catalog=None, view=None, state_nodes=()):
+    pools = pools or [make_nodepool()]
+    catalog = catalog if catalog is not None else its()
+    it_map = {p.name: list(catalog) for p in pools}
+    ts = TensorScheduler(pools, it_map, state_nodes=state_nodes,
+                         cluster=view, force_tensor=True)
+    r = ts.solve(pods)
+    assert ts.fallback_reason == "", ts.fallback_reason
+    return r
+
+
+def domain_fill(results, key) -> Counter:
+    """pods per domain over new claims whose `key` narrowed to one value."""
+    out = Counter()
+    for nc in results.new_nodeclaims:
+        vals = nc.requirements.get(key).values_list()
+        if len(vals) == 1:
+            out[vals[0]] += len(nc.pods)
+    for en in results.existing_nodes:
+        if en.pods:
+            vals = en.requirements.get(key).values_list()
+            if len(vals) == 1:
+                out[vals[0]] += len(en.pods)
+    return out
+
+
+def skew(results, key=ZONE, extra=()):
+    """Order-insensitive per-domain counts, ExpectSkew/ConsistOf style."""
+    c = domain_fill(results, key)
+    for d in extra:
+        c[d] += 1
+    return sorted(c.values())
+
+
+class TestZonalSpread:
+    """topology_test.go:93-530."""
+
+    def test_balance_across_zones_match_labels(self):
+        def pods():
+            return make_pods(5, labels={"app": "demo"}, spread=[tsc()])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert skew(h) == [1, 1, 1, 2]
+        t = tsolve(pods())
+        assert skew(t) == [1, 1, 1, 2]
+
+    def test_balance_across_zones_match_expressions(self):
+        expr = [NodeSelectorRequirement("app", "In", ("demo",))]
+        def pods():
+            return make_pods(5, labels={"app": "demo"},
+                             spread=[tsc(expressions=expr)])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert skew(h) == [1, 1, 1, 2]
+        t = tsolve(pods())
+        assert skew(t) == [1, 1, 1, 2]
+
+    def test_respects_nodepool_zonal_constraints(self):
+        pool = zone_pool("test-zone-a", "test-zone-b")
+        def pods():
+            return make_pods(6, labels={"app": "demo"}, spread=[tsc()])
+        h = hsolve(pods(), pools=[pool])
+        assert not h.pod_errors
+        assert skew(h) == [3, 3]
+        assert set(domain_fill(h, ZONE)) == {"test-zone-a", "test-zone-b"}
+        t = tsolve(pods(), pools=[pool])
+        assert skew(t) == [3, 3]
+
+    def test_subset_with_pool_labels(self):
+        # the pool pins the zone via a template label: one domain only
+        pool = make_nodepool(labels={ZONE: "test-zone-c"})
+        h = hsolve(make_pods(4, labels={"app": "demo"}, spread=[tsc()]),
+                   pools=[pool])
+        assert not h.pod_errors
+        assert dict(domain_fill(h, ZONE)) == {"test-zone-c": 4}
+
+    def test_existing_pod_counts_toward_skew(self):
+        """topology_test.go:218-251: one matching pod already in zone-c, the
+        pool restricted to a/b -> max 2 per zone before skew violation."""
+        existing = running_on(make_pods(1, labels={"app": "demo"}),
+                              "node-c")
+        view = StaticClusterView(existing, {
+            "node-c": {ZONE: "test-zone-c", HOST: "node-c"}})
+        pool = zone_pool("test-zone-a", "test-zone-b")
+        def pods():
+            return make_pods(6, cpu="1100m", labels={"app": "demo"},
+                             spread=[tsc()])
+        h = hsolve(pods(), pools=[pool], view=view)
+        assert len(h.pod_errors) == 2
+        assert skew(h, extra=["test-zone-c"]) == [1, 2, 2]
+        t = tsolve(pods(), pools=[pool], view=view)
+        assert len(t.pod_errors) == 2
+        assert skew(t, extra=["test-zone-c"]) == [1, 2, 2]
+
+    def test_non_minimum_domain_if_all_thats_available(self):
+        """topology_test.go:252-293, adapted: existing matching pods in
+        zones a and b (1 each); the pool only offers zone-c; maxSkew=5
+        allows up to 6 in zone-c (6-1 <= 5), the rest fail."""
+        ex = (running_on(make_pods(1, labels={"app": "demo"}), "node-a")
+              + running_on(make_pods(1, labels={"app": "demo"}), "node-b"))
+        view = StaticClusterView(ex, {
+            "node-a": {ZONE: "test-zone-a", HOST: "node-a"},
+            "node-b": {ZONE: "test-zone-b", HOST: "node-b"}})
+        pool = zone_pool("test-zone-c")
+        def pods():
+            return make_pods(10, labels={"app": "demo"},
+                             spread=[tsc(max_skew=5)])
+        h = hsolve(pods(), pools=[pool], view=view)
+        assert len(h.pod_errors) == 4
+        assert dict(domain_fill(h, ZONE)) == {"test-zone-c": 6}
+        t = tsolve(pods(), pools=[pool], view=view)
+        assert len(t.pod_errors) == 4
+        assert dict(domain_fill(t, ZONE)) == {"test-zone-c": 6}
+
+    def test_recovers_preexisting_skew(self):
+        """topology_test.go:294-332: cluster already skewed (3,0,0,0);
+        3 new pods only fill the minimum domains."""
+        ex = running_on(make_pods(3, labels={"app": "demo"}), "node-a")
+        view = StaticClusterView(ex, {
+            "node-a": {ZONE: "test-zone-a", HOST: "node-a"}})
+        def pods():
+            return make_pods(3, labels={"app": "demo"}, spread=[tsc()])
+        h = hsolve(pods(), view=view)
+        assert not h.pod_errors
+        fills = domain_fill(h, ZONE)
+        assert fills["test-zone-a"] == 0 and sum(fills.values()) == 3
+        t = tsolve(pods(), view=view)
+        assert domain_fill(t, ZONE) == fills
+
+    def test_unreachable_empty_zone_pins_global_min(self):
+        """A zero-count zone offered only by a pool the pod can't use (an
+        intolerable taint) still floors the reference's global min at 0
+        (topologygroup.go:229-250): with two matching cluster pods in
+        zone-a, maxSkew=1 blocks further zone-a placement on both paths."""
+        pool_a = zone_pool("test-zone-a", name="pool-a")
+        pool_b = make_nodepool(name="pool-b", requirements=[
+            NodeSelectorRequirement(ZONE, "In", ("test-zone-b",))],
+            taints=[Taint(key="dedicated", value="x")])
+        ex = running_on(make_pods(2, labels={"app": "demo"}), "node-a")
+        view = StaticClusterView(ex, {
+            "node-a": {ZONE: "test-zone-a", HOST: "node-a"}})
+        def pods():
+            return make_pods(1, labels={"app": "demo"}, spread=[tsc()])
+        h = hsolve(pods(), pools=[pool_a, pool_b], view=view)
+        assert len(h.pod_errors) == 1
+        t = tsolve(pods(), pools=[pool_a, pool_b], view=view)
+        assert len(t.pod_errors) == 1
+
+    def test_counts_only_running_scheduled_matching_pods(self):
+        """topology_test.go:398-430: terminal, terminating, unscheduled, and
+        non-matching pods don't count toward domain occupancy."""
+        ignored = []
+        terminal = running_on(make_pods(1, labels={"app": "demo"}), "node-a")
+        terminal[0].status.phase = "Succeeded"
+        ignored += terminal
+        unsched = make_pods(1, labels={"app": "demo"})  # no node_name
+        ignored += unsched
+        deleting = running_on(make_pods(1, labels={"app": "demo"}), "node-a")
+        deleting[0].metadata.deletion_timestamp = 1.0
+        ignored += deleting
+        other = running_on(make_pods(1, labels={"app": "not-demo"}), "node-a")
+        ignored += other
+        view = StaticClusterView(ignored, {
+            "node-a": {ZONE: "test-zone-a", HOST: "node-a"}})
+        h = hsolve(make_pods(4, labels={"app": "demo"}, spread=[tsc()]),
+                   view=view)
+        assert not h.pod_errors
+        assert skew(h) == [1, 1, 1, 1]  # zone-a got no head start
+
+    def test_interdependent_selector_matches_nothing(self):
+        """topology_test.go:443-467: a hostname spread whose selector matches
+        no pod (not even its owner) never accrues counts -> all pods may
+        share one node."""
+        def pods():
+            return make_pods(5, cpu="100m",
+                             spread=[tsc(key=HOST, value="no-such-app")])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 1
+        t = tsolve(pods())
+        assert not t.pod_errors
+        assert len(t.new_nodeclaims) == 1
+
+
+class TestMinDomains:
+    """topology_test.go:468-530."""
+
+    def test_min_domains_blocks_when_fewer_domains(self):
+        pool = zone_pool("test-zone-a", "test-zone-b")
+        def pods():
+            return make_pods(3, labels={"app": "demo"},
+                             spread=[tsc(min_domains=3)])
+        h = hsolve(pods(), pools=[pool])
+        assert len(h.pod_errors) == 1
+        assert skew(h) == [1, 1]
+        t = tsolve(pods(), pools=[pool])
+        assert len(t.pod_errors) == 1
+        assert skew(t) == [1, 1]
+
+    def test_min_domains_equal_allows_scheduling(self):
+        pool = zone_pool("test-zone-a", "test-zone-b", "test-zone-c")
+        def pods():
+            return make_pods(11, labels={"app": "demo"},
+                             spread=[tsc(min_domains=3)])
+        h = hsolve(pods(), pools=[pool])
+        assert not h.pod_errors
+        assert skew(h) == [3, 4, 4]
+        t = tsolve(pods(), pools=[pool])
+        assert skew(t) == [3, 4, 4]
+
+    def test_min_domains_below_count_allows_scheduling(self):
+        pool = zone_pool("test-zone-a", "test-zone-b", "test-zone-c")
+        def pods():
+            return make_pods(11, labels={"app": "demo"},
+                             spread=[tsc(min_domains=2)])
+        h = hsolve(pods(), pools=[pool])
+        assert not h.pod_errors
+        assert skew(h) == [3, 4, 4]
+        t = tsolve(pods(), pools=[pool])
+        assert skew(t) == [3, 4, 4]
+
+
+class TestHostnameSpread:
+    """topology_test.go:531-638."""
+
+    def test_balance_across_nodes(self):
+        def pods():
+            return make_pods(4, labels={"app": "demo"},
+                             spread=[tsc(key=HOST)])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 4
+        t = tsolve(pods())
+        assert len(t.new_nodeclaims) == 4
+
+    def test_same_hostname_up_to_maxskew(self):
+        def pods():
+            return make_pods(4, cpu="100m", labels={"app": "demo"},
+                             spread=[tsc(key=HOST, max_skew=4)])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert len(h.new_nodeclaims) == 1
+        t = tsolve(pods())
+        assert len(t.new_nodeclaims) == 1
+
+    def test_multiple_deployments_spread_independently(self):
+        """topology_test.go:557-592: two deployments, each hostname-spread
+        on its own selector; counts never couple."""
+        def pods():
+            return (make_pods(3, cpu="100m", labels={"app": "a"},
+                              spread=[tsc(key=HOST, value="a")])
+                    + make_pods(3, cpu="100m", labels={"app": "b"},
+                                spread=[tsc(key=HOST, value="b")]))
+        h = hsolve(pods())
+        assert not h.pod_errors
+        # every node hosts at most 1 of each app
+        for nc in h.new_nodeclaims:
+            per = Counter(p.labels.get("app") for p in nc.pods)
+            assert all(v <= 1 for v in per.values())
+        t = tsolve(pods())
+        assert not t.pod_errors
+        for nc in t.new_nodeclaims:
+            per = Counter(p.labels.get("app") for p in nc.pods)
+            assert all(v <= 1 for v in per.values())
+
+
+class TestCapacityTypeAndArchSpread:
+    """topology_test.go:639-926 — non-zone/hostname topology keys stay on
+    the host oracle (the kernel demotes them)."""
+
+    def test_balance_across_capacity_types(self):
+        h = hsolve(make_pods(2, labels={"app": "demo"},
+                             spread=[tsc(key=CT)]))
+        assert not h.pod_errors
+        assert skew(h, key=CT) == [1, 1]
+
+    def test_respects_nodepool_capacity_type_constraint(self):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(CT, "In", ("spot",))])
+        h = hsolve(make_pods(2, labels={"app": "demo"},
+                             spread=[tsc(key=CT)]), pools=[pool])
+        assert not h.pod_errors
+        assert dict(domain_fill(h, CT)) == {"spot": 2}
+
+    def test_max_skew_binds_on_capacity_type(self):
+        """topology_test.go:667-701: 3 pods forced to spot first, then
+        spread pods must backfill on-demand before spot again."""
+        ex = running_on(make_pods(3, labels={"app": "demo"}), "node-s")
+        view = StaticClusterView(ex, {
+            "node-s": {CT: "spot", ZONE: "test-zone-a", HOST: "node-s"}})
+        h = hsolve(make_pods(3, labels={"app": "demo"},
+                             spread=[tsc(key=CT)]), view=view)
+        assert not h.pod_errors
+        fills = domain_fill(h, CT)
+        assert fills["on-demand"] == 3 and fills["spot"] == 0
+
+    def test_balance_across_arch(self):
+        h = hsolve(make_pods(2, labels={"app": "demo"},
+                             spread=[tsc(key=ARCH)]))
+        assert not h.pod_errors
+        assert skew(h, key=ARCH) == [1, 1]
+
+    def test_zonal_and_hostname_constraints_together(self):
+        """topology_test.go:927-966."""
+        def pods():
+            return make_pods(8, cpu="100m", labels={"app": "demo"},
+                             spread=[tsc(), tsc(key=HOST, max_skew=1)])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert skew(h) == [2, 2, 2, 2]
+        assert all(len(nc.pods) <= 1 for nc in h.new_nodeclaims)
+        t = tsolve(pods())
+        assert skew(t) == [2, 2, 2, 2]
+        assert all(len(nc.pods) <= 1 for nc in t.new_nodeclaims)
+
+    def test_zonal_and_capacity_type_constraints_together(self):
+        h = hsolve(make_pods(8, labels={"app": "demo"},
+                             spread=[tsc(), tsc(key=CT)]))
+        assert not h.pod_errors
+        assert skew(h) == [2, 2, 2, 2]
+        assert skew(h, key=CT) == [4, 4]
+
+    def test_all_three_constraints_together(self):
+        """topology_test.go:1169-1206."""
+        h = hsolve(make_pods(8, cpu="100m", labels={"app": "demo"},
+                             spread=[tsc(), tsc(key=CT),
+                                     tsc(key=HOST, max_skew=3)]))
+        assert not h.pod_errors
+        assert skew(h) == [2, 2, 2, 2]
+        assert skew(h, key=CT) == [4, 4]
+        assert all(len(nc.pods) <= 3 for nc in h.new_nodeclaims)
+
+
+class TestSpreadOptionLimiting:
+    """topology_test.go:1207-1392."""
+
+    def test_limited_by_node_selector(self):
+        def pods():
+            return make_pods(4, labels={"app": "demo"},
+                             node_selector={ZONE: "test-zone-a"},
+                             spread=[tsc()])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert dict(domain_fill(h, ZONE)) == {"test-zone-a": 4}
+        t = tsolve(pods())
+        assert dict(domain_fill(t, ZONE)) == {"test-zone-a": 4}
+
+    def test_limited_by_required_node_affinity(self):
+        req = [[NodeSelectorRequirement(ZONE, "In",
+                                        ("test-zone-a", "test-zone-b"))]]
+        def pods():
+            return make_pods(6, labels={"app": "demo"},
+                             required_affinity=req, spread=[tsc()])
+        h = hsolve(pods())
+        assert not h.pod_errors
+        assert skew(h) == [3, 3]
+        assert set(domain_fill(h, ZONE)) == {"test-zone-a", "test-zone-b"}
+        t = tsolve(pods())
+        assert skew(t) == [3, 3]
+
+    def test_not_limited_by_preferred_node_affinity(self):
+        """topology_test.go:1299-1323: preferences do NOT restrict the
+        domain universe the spread may use."""
+        pref = [(1, [NodeSelectorRequirement(ZONE, "In", ("test-zone-a",))])]
+        h = hsolve(make_pods(8, labels={"app": "demo"},
+                             preferred_affinity=pref, spread=[tsc()]))
+        assert not h.pod_errors
+        assert skew(h) == [2, 2, 2, 2]
+
+
+class TestNodePoolTaints:
+    """suite_test.go:2450-2500."""
+
+    def test_tainted_pool_rejects_intolerant_pods(self):
+        pool = make_nodepool(taints=[Taint(key="dedicated", value="gpu")])
+        h = hsolve(make_pods(2), pools=[pool])
+        assert len(h.pod_errors) == 2
+
+    def test_tolerating_pods_schedule_on_tainted_pool(self):
+        pool = make_nodepool(taints=[Taint(key="dedicated", value="gpu")])
+        tol = [Toleration(key="dedicated", operator="Exists")]
+        h = hsolve(make_pods(2, tolerations=tol), pools=[pool])
+        assert not h.pod_errors
+
+    def test_startup_taints_do_not_block_scheduling(self):
+        pool = make_nodepool(startup_taints=[Taint(key="init", value="x")])
+        h = hsolve(make_pods(2), pools=[pool])
+        assert not h.pod_errors
