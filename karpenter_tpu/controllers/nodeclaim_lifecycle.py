@@ -86,9 +86,16 @@ class NodeClaimLifecycle(Controller):
         except CloudProviderError as e:
             log.error("launching nodeclaim failed", nodeclaim=nc.name,
                       error=str(e))
-            nc.conditions.set_false(COND_LAUNCHED, reason="LaunchFailed",
-                                    message=str(e), now=self.clock.now())
-            self.store.update(nc)
+            # only write when the condition actually flips: an unconditional
+            # status update fires a watch event that re-reconciles this very
+            # claim immediately, turning the requeue_after backoff into a
+            # hot retry storm
+            prev = nc.conditions.get(COND_LAUNCHED)
+            if prev is None or prev.status != "False" or \
+                    prev.message != str(e):
+                nc.conditions.set_false(COND_LAUNCHED, reason="LaunchFailed",
+                                        message=str(e), now=self.clock.now())
+                self.store.update(nc)
             return Result(requeue_after=LAUNCH_RETRY_SECONDS)
         log.info("launched nodeclaim", nodeclaim=nc.name,
                  nodepool=nc.nodepool_name,

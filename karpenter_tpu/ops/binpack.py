@@ -416,6 +416,7 @@ class Packer:
         self._req_nz = [np.nonzero(p.group_req[g])[0] for g in range(self.G)]
         self._req_vals = [p.group_req[g][self._req_nz[g]] for g in range(self.G)]
         self._alloc_nz_cache: Dict[tuple, np.ndarray] = {}
+        self._madj_cache: Dict[int, np.ndarray] = {}
 
     def _alloc_nz(self, m: int, g: int) -> np.ndarray:
         """[T, nnz(g)] allocatable minus template daemon overhead, restricted
@@ -455,10 +456,16 @@ class Packer:
             return n_pods
         placed = 0
         while placed < n_pods:
-            fill = min(per_node, n_pods - placed)
             it_fit = it_set & self._under_limits(m, it_set)
             if not it_fit.any():
                 break
+            # size the fill from the LIMIT-FILTERED set: per_node came from
+            # the unfiltered max-capacity type, which limits may have
+            # excluded — overfilling would prune the cohort's options empty
+            per_fit = min(per_node, int(self.t.ppn[g, m][it_fit].max()))
+            if per_fit <= 0:
+                break
+            fill = min(per_fit, n_pods - placed)
             self._subtract_max(m, it_fit)
             self._append_cohort(g, m, zone, it_fit, fill, cohort_enc, n=1)
             placed += fill
@@ -509,10 +516,32 @@ class Packer:
                                    gt=np.full(K, -2**31, dtype=np.int64),
                                    lt=np.full(K, 2**31 - 1, dtype=np.int64))
 
+    def _adjusted_alloc(self, m: int) -> np.ndarray:
+        """[T, R] allocatable minus template m's daemon overhead, memoized
+        (pure function of m; _commit_to_cohort sits on the remainder hot
+        path)."""
+        out = self._madj_cache.get(m)
+        if out is None:
+            out = self.p.it_alloc - self.p.daemon_overhead[m]
+            self._madj_cache[m] = out
+        return out
+
+    def _fits_requests(self, m: int, requests: np.ndarray) -> np.ndarray:
+        """[T] bool: instance types whose daemon-adjusted allocatable holds
+        the cumulative request vector — the tensor twin of the per-pod
+        instance-type refiltering (nodeclaim.go:108-117): an IT that fit the
+        first pod must leave the set once the accumulated load outgrows it,
+        or downstream consumers (price ordering, the consolidation price
+        filter, the provider's cheapest-offering pick) see phantom options."""
+        return (self._adjusted_alloc(m) >= requests).all(axis=1)
+
     def _append_cohort(self, g: int, m: int, zone: Optional[int],
                        it_set: np.ndarray, fill: int,
                        cohort_enc: EncodedRequirements, n: int = 1) -> None:
         req = self.p.group_req[g] * fill
+        it_set = it_set & self._fits_requests(m, req)
+        assert it_set.any(), \
+            "cohort fill outgrew every surviving instance type (fill sizing bug)"
         self.result.cohorts.append(Cohort(
             m=m, zone=zone, it_set=it_set.copy(), requests=req.copy(), n=n,
             enc=cohort_enc, pods_by_group={g: fill}))
@@ -591,8 +620,8 @@ class Packer:
         return placed_total
 
     def _commit_to_cohort(self, cohort: Cohort, g: int, fill: int, ts: np.ndarray):
-        cohort.it_set = ts.copy()
         cohort.requests = cohort.requests + self.p.group_req[g] * fill
+        cohort.it_set = ts & self._fits_requests(cohort.m, cohort.requests)
         cohort.pods_by_group[g] = cohort.pods_by_group.get(g, 0) + fill
         cohort.enc = np_combine(cohort.enc, _row(self.p.group_enc, g))
 
@@ -739,17 +768,19 @@ class Packer:
             it_ok = self.t.it_ok[g, m]
             if not it_ok.any():
                 continue
-            per = int(self.t.ppn[g, m][it_ok].max())
-            fill = min(per, c)
-            if fill <= 0:
-                continue
             limits = self.template_limits[m]
             if limits is not None:
                 it_fit = it_ok & self._under_limits(m, it_ok)
                 if not it_fit.any():
                     continue
-                self._subtract_max(m, it_fit)
                 it_ok = it_fit
+            # fill sized from the (limit-filtered) surviving set
+            per = int(self.t.ppn[g, m][it_ok].max())
+            fill = min(per, c)
+            if fill <= 0:
+                continue
+            if limits is not None:
+                self._subtract_max(m, it_ok)
             self._append_cohort(g, m, None, it_ok, fill, self._node_enc(g, m, None))
             return fill
         return 0
@@ -763,7 +794,7 @@ class Packer:
             viable |= self.t.it_ok_z[g, m].any(axis=0)
         return admitted, viable
 
-    def _zone_min_mask(self, g: int, admitted: np.ndarray) -> np.ndarray:
+    def _zone_min_mask(self, g: int) -> np.ndarray:
         """The pod's view of the domain universe for global-min/minDomains
         arithmetic (topologygroup.go:229-250): every registered domain the
         POD's own requirements admit. The universe spans ALL templates'
@@ -795,7 +826,7 @@ class Packer:
         alloc = waterfill(self.zone_counts[g], viable, admitted, c,
                           spec.max_skew, spec.min_domains,
                           zone_names=self._zone_names,
-                          min_mask=self._zone_min_mask(g, admitted))
+                          min_mask=self._zone_min_mask(g))
         placed_total = 0
         for z in np.argsort(-alloc):
             a = int(alloc[z])
@@ -820,7 +851,7 @@ class Packer:
             self._error_group(g, c, "no zone admitted for topology spread")
             return
         counts = self.zone_counts[g]
-        min_mask = self._zone_min_mask(g, admitted)
+        min_mask = self._zone_min_mask(g)
         floor_zero = (spec.min_domains is not None
                       and int(min_mask.sum()) < spec.min_domains)
         gmin = 0 if floor_zero else (int(counts[min_mask].min())
@@ -852,7 +883,7 @@ class Packer:
         # occupancy is judged through the POD's domain view: a matching pod
         # in a zone no template reaches still blocks the bootstrap
         # (nextDomainAffinity returns empty options, not a fresh domain)
-        occupied = (counts > 0) & self._zone_min_mask(g, admitted)
+        occupied = (counts > 0) & self._zone_min_mask(g)
         if occupied.any():
             occupied &= admitted
             # pods must join an occupied domain (topologygroup.go:253-300);

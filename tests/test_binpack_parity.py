@@ -158,6 +158,40 @@ class TestBenchmarkMixParity:
         assert abs(th - hh) <= max(1, round(0.05 * hh)), (th, hh)
 
 
+class TestInstanceTypePruning:
+    def test_cohort_drops_outgrown_instance_types(self):
+        """nodeclaim.go:108-117 parity: an instance type that fit the first
+        pod must leave the claim's option list once the accumulated load
+        outgrows it — a phantom small option poisons price ordering and the
+        consolidation price filter (the launch would pick an undersized
+        node)."""
+        its = kwok.construct_instance_types()
+        t = tensor_solve([make_nodepool()], its,
+                         make_pods(2, cpu="1500m", memory="256Mi"))
+        assert not t.pod_errors
+        for nc in t.new_nodeclaims:
+            total = sum(p.requests().get("cpu", 0) for p in nc.pods)
+            for it in nc.instance_type_options:
+                assert it.allocatable().get("cpu", 0) >= total, \
+                    (it.name, total)
+
+    def test_limit_filtered_fill_keeps_viable_options(self):
+        """With nodepool limits excluding the max-capacity type, the fill
+        must be sized from the limit-filtered set — never producing a claim
+        whose pods outgrow every surviving option."""
+        its = kwok.construct_instance_types()
+        pool = make_nodepool(limits={"cpu": "4"})
+        t = tensor_solve([pool], its, make_pods(16, cpu="250m"))
+        for nc in t.new_nodeclaims:
+            assert nc.pods and nc.instance_type_options
+            total = sum(p.requests().get("cpu", 0) for p in nc.pods)
+            assert any(it.allocatable().get("cpu", 0) >= total
+                       for it in nc.instance_type_options)
+        h = host_solve([make_nodepool(limits={"cpu": "4"})], its,
+                       make_pods(16, cpu="250m"))
+        assert len(t.pod_errors) == len(h.pod_errors)
+
+
 class TestFallback:
     def test_unsupported_topology_falls_back(self):
         # region-key spread isn't kernel-supported -> host path

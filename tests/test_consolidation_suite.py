@@ -1,0 +1,403 @@
+"""Scenario port of /root/reference/pkg/controllers/disruption/
+consolidation_test.go (4,382 LoC): budget interplay (percent, absolute,
+per-nodepool, consolidated-marker suppression), replace-vs-delete price
+guards, uninitialized-node gating, do-not-disrupt pods, permanently-pending
+pods, validation races during the 15 s TTL (catalog shrink, late PDB), and
+multi-nodeclaim merges with mixed capacity types."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_INITIALIZED,
+                                         NodeClaim)
+from karpenter_tpu.api.nodepool import Budget
+from karpenter_tpu.api.objects import LabelSelector, Node, ObjectMeta, Pod
+from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.node_termination import NodeTermination
+from karpenter_tpu.disruption.controller import (DisruptionController,
+                                                 OrchestrationQueue)
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods
+
+OD = {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND}
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    queue = OrchestrationQueue(store, cluster, clock)
+    disruption = DisruptionController(store, cluster, provisioner, queue, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock),
+                 NodeClaimDisruptionMarker(store, cluster, provider, clock),
+                 NodeTermination(store, cluster, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.provisioner, e.queue, e.disruption = provisioner, queue, disruption
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def disrupt(env, rounds=8):
+    for _ in range(rounds):
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        env.clock.step(8)
+
+
+def make_empty_nodes(env, n, pool="default", prefix="e"):
+    """Provision n single-pod nodes in `pool`, then strand them empty."""
+    pods = []
+    for i in range(n):
+        p = make_pod(cpu="2500m", node_selector={
+            **OD, api_labels.NODEPOOL_LABEL_KEY: pool}, name=f"{prefix}-{i}")
+        env.store.create(p)
+        pods.append(p)
+        settle(env, rounds=3)
+    for p in pods:
+        env.store.delete(p)
+    settle(env)
+    env.clock.step(21)
+
+
+class TestBudgets:
+    """consolidation_test.go:217-860."""
+
+    def test_percent_budget_limits_empty_disruption(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="30%")]
+        env.store.create(pool)
+        make_empty_nodes(env, 6)
+        assert len(env.store.list(Node)) == 6
+        # one disruption pass: ceil(30% of 6) = 2 nodes may go
+        # (percent rounds UP, nodepool.go:330-334)
+        env.disruption.reconcile()
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=3)
+        assert len(env.store.list(Node)) == 4
+
+    def test_full_budget_allows_all_empty(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(pool)
+        make_empty_nodes(env, 4)
+        disrupt(env)
+        assert env.store.list(Node) == []
+
+    def test_zero_budget_blocks_everything(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.store.create(pool)
+        make_empty_nodes(env, 3)
+        disrupt(env, rounds=3)
+        assert len(env.store.list(Node)) == 3
+
+    def test_per_nodepool_budgets_independent(self, env):
+        """consolidation_test.go:414-480: each pool's budget is its own."""
+        for name, budget in (("pool-a", "1"), ("pool-b", "100%")):
+            pool = make_nodepool(name=name)
+            pool.spec.disruption.budgets = [Budget(nodes=budget)]
+            env.store.create(pool)
+        make_empty_nodes(env, 2, pool="pool-a", prefix="a")
+        make_empty_nodes(env, 2, pool="pool-b", prefix="b")
+        # one pass: pool-a loses at most 1, pool-b may lose both
+        env.disruption.reconcile()
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=3)
+        by_pool = {}
+        for n in env.store.list(Node):
+            key = n.metadata.labels[api_labels.NODEPOOL_LABEL_KEY]
+            by_pool[key] = by_pool.get(key, 0) + 1
+        assert by_pool.get("pool-a", 0) >= 1
+
+    def test_budget_block_does_not_mark_consolidated(self, env):
+        """consolidation_test.go:608-694: a budget-blocked pass must NOT
+        memoize the cluster as consolidated — lifting the budget later must
+        disrupt without waiting for unrelated cluster changes."""
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.store.create(pool)
+        make_empty_nodes(env, 2)
+        disrupt(env, rounds=2)
+        assert len(env.store.list(Node)) == 2
+        # lift the budget; nothing else changes in the cluster
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.update(pool)
+        disrupt(env)
+        assert env.store.list(Node) == []
+
+
+class TestReplaceAndDelete:
+    """consolidation_test.go:870-3071."""
+
+    def test_wont_replace_with_more_expensive(self, env):
+        """A node already on the cheapest fitting type stays put."""
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="200m", memory="128Mi", node_selector=OD)
+        env.store.create(pod)
+        settle(env)
+        node = env.store.list(Node)[0]
+        env.clock.step(21)
+        disrupt(env, rounds=3)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1 and nodes[0].name == node.name
+
+    def test_delete_when_other_capacity_fits(self, env):
+        """consolidation_test.go:2259-2303: pods fit on a surviving node ->
+        delete-only decision, no replacement launched. Sized so the merged
+        load (2x1500m) only fits the candidates' own instance type, making
+        a replacement same-type (blocked) — delete is the only move."""
+        env.store.create(make_nodepool(name="default"))
+        for i in range(2):
+            env.store.create(make_pod(cpu="2000m", node_selector=OD,
+                                      name=f"big-{i}"))
+            env.store.create(make_pod(cpu="1500m", node_selector=OD,
+                                      name=f"small-{i}"))
+            settle(env, rounds=3)
+        assert len(env.store.list(Node)) == 2
+        for i in range(2):
+            env.store.delete(env.store.get(Pod, f"big-{i}", "default"))
+        settle(env)
+        env.clock.step(21)
+        claims_before = {c.name for c in env.store.list(NodeClaim)}
+        disrupt(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        # survivor is an original node, not a fresh replacement
+        claims_after = {c.name for c in env.store.list(NodeClaim)}
+        assert claims_after <= claims_before
+
+    def test_do_not_disrupt_pod_blocks_delete(self, env):
+        """consolidation_test.go:2516-2564."""
+        env.store.create(make_nodepool(name="default"))
+        big = make_pod(cpu="3000m", node_selector=OD)
+        env.store.create(big)
+        settle(env)
+        env.store.delete(big)
+        small = make_pod(cpu="200m", node_selector=OD)
+        small.metadata.annotations[api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.create(small)
+        settle(env)
+        env.clock.step(21)
+        before = {n.name for n in env.store.list(Node)}
+        disrupt(env, rounds=3)
+        assert {n.name for n in env.store.list(Node)} == before
+
+    def test_wont_delete_onto_uninitialized_node(self, env):
+        """consolidation_test.go:2714-2758: a delete whose pods would land
+        on a not-yet-initialized node is rejected."""
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="2500m", node_selector=OD, name="a-big"))
+        env.store.create(make_pod(cpu="300m", node_selector=OD, name="a-small"))
+        settle(env, rounds=3)
+        env.store.create(make_pod(cpu="2500m", node_selector=OD, name="b-big"))
+        env.store.create(make_pod(cpu="300m", node_selector=OD, name="b-small"))
+        settle(env, rounds=3)
+        assert len(env.store.list(Node)) == 2
+        env.store.delete(env.store.get(Pod, "a-big", "default"))
+        env.store.delete(env.store.get(Pod, "b-big", "default"))
+        settle(env)
+        # strip initialization from node B: its claim loses the condition
+        # and the node loses the label (cluster sees it uninitialized)
+        node_b = env.store.get(Pod, "b-small", "default").spec.node_name
+        for nc in env.store.list(NodeClaim):
+            if nc.status.node_name == node_b:
+                nc.conditions.set_false(COND_INITIALIZED, "Testing", "forced")
+                env.store.update(nc)
+        nb = env.store.get(Node, node_b)
+        nb.metadata.labels.pop(api_labels.NODE_INITIALIZED_LABEL_KEY, None)
+        env.store.update(nb)
+        env.clock.step(21)
+        na = env.store.get(Pod, "a-small", "default").spec.node_name
+        env.disruption.reconcile()
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        # node A survived: consolidating it would schedule onto B (uninit)
+        assert env.store.get(Node, na) is not None
+
+    def test_permanently_pending_pod_does_not_block(self, env):
+        """consolidation_test.go:2907-2962: an unschedulable pod can't hold
+        the whole cluster hostage."""
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="100000", name="impossible"))  # 100 cpu
+        make_empty_nodes(env, 2)
+        disrupt(env)
+        assert env.store.list(Node) == []
+        assert env.store.get(Pod, "impossible", "default").spec.node_name == ""
+
+    def test_wont_make_scheduled_pod_pending(self, env):
+        """consolidation_test.go:2963-3005: deletion must resimulate ALL
+        pods; if capacity disappears, keep the node."""
+        env.store.create(make_nodepool(name="default"))
+        # two nodes each nearly full: no node can absorb the other's pods
+        for i in range(2):
+            env.store.create(make_pod(cpu="3400m", node_selector=OD,
+                                      name=f"full-{i}"))
+            settle(env, rounds=3)
+        env.clock.step(21)
+        before = {n.name for n in env.store.list(Node)}
+        disrupt(env, rounds=3)
+        assert {n.name for n in env.store.list(Node)} == before
+        for p in env.store.list(Pod):
+            assert p.spec.node_name
+
+
+class TestValidationRaces:
+    """consolidation_test.go:3072-3499."""
+
+    def test_catalog_shrink_during_ttl_aborts_replace(self, env):
+        """consolidation_test.go:3183-3266: if the re-simulation after the
+        15 s TTL picks instance types that aren't a subset of the original
+        decision, the command is abandoned."""
+        env.store.create(make_nodepool(name="default"))
+        big = make_pod(cpu="3000m", memory="2Gi", node_selector=OD)
+        env.store.create(big)
+        settle(env)
+        env.store.delete(big)
+        small = make_pod(cpu="200m", memory="128Mi", node_selector=OD)
+        env.store.create(small)
+        settle(env)
+        env.clock.step(21)
+        env.disruption.reconcile()
+        pending = env.disruption.pending
+        if pending is None:
+            pytest.skip("no graceful replace computed in this catalog")
+        cmd, _ = pending
+        if not cmd.replacements:
+            pytest.skip("decision was delete-only; nothing to invalidate")
+        # the chosen replacement options vanish from the provider
+        replacement_names = {
+            it.name for nc in cmd.replacements
+            for it in nc.instance_type_options}
+        env.provider._instance_types = [
+            it for it in env.provider._instance_types
+            if it.name not in replacement_names]
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        # original node survives; no replacement with a vanished type exists
+        for n in env.store.list(Node):
+            assert n.metadata.labels[api_labels.LABEL_INSTANCE_TYPE] \
+                not in replacement_names
+
+    def test_late_blocking_pdb_aborts(self, env):
+        """consolidation_test.go:3449-3498: a blocking PDB created during
+        the TTL invalidates the command."""
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m", labels={"app": "guard"})
+        env.store.create(pod)
+        settle(env)
+        node = env.store.list(Node)[0]
+        env.store.delete(pod)
+        settle(env)
+        env.clock.step(21)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        # a pod (guarded by a hot PDB) lands on the candidate mid-TTL
+        guarded = make_pod(cpu="100m", labels={"app": "guard"})
+        guarded.spec.node_name = node.name
+        env.store.create(guarded)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "guard"}),
+                         max_unavailable="0")))
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        assert env.store.get(Node, node.name) is not None
+
+
+class TestMultiNodeClaim:
+    """consolidation_test.go:3499-3700."""
+
+    def test_merge_mixed_capacity_types(self, env):
+        """consolidation_test.go:3597-3657: spot + on-demand candidates can
+        merge into one node (spot-to-spot gate applies to all-spot only)."""
+        env.store.create(make_nodepool(name="default"))
+        selectors = [
+            {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_SPOT},
+            OD, OD]
+        bigs = []
+        for i, sel in enumerate(selectors):
+            big = make_pod(cpu="2500m", node_selector=sel, name=f"m-big-{i}")
+            env.store.create(big)
+            env.store.create(make_pod(cpu="700m", node_selector=sel,
+                                      name=f"m-small-{i}"))
+            settle(env, rounds=3)
+            bigs.append(big)
+        assert len(env.store.list(Node)) == 3
+        for big in bigs:
+            env.store.delete(big)
+        settle(env)
+        env.clock.step(21)
+        disrupt(env)
+        assert len(env.store.list(Node)) <= 2  # merged (1 ideal, ≤2 allowed)
+        for p in env.store.list(Pod):
+            assert p.spec.node_name
+
+    def test_wont_merge_two_same_type_into_same_type(self, env):
+        """multinodeconsolidation.go filterOutSameType end-to-end: two
+        half-full nodes of type X must not 'merge' by buying another X."""
+        env.store.create(make_nodepool(name="default"))
+        for i in range(2):
+            env.store.create(make_pod(cpu="2000m", node_selector=OD,
+                                      name=f"s-big-{i}"))
+            env.store.create(make_pod(cpu="1500m", node_selector=OD,
+                                      name=f"s-small-{i}"))
+            settle(env, rounds=3)
+        types_before = {n.metadata.labels[api_labels.LABEL_INSTANCE_TYPE]
+                        for n in env.store.list(Node)}
+        assert len(types_before) == 1  # both candidates same type
+        claims_before = {c.metadata.name for c in env.store.list(NodeClaim)}
+        for i in range(2):
+            env.store.delete(env.store.get(Pod, f"s-big-{i}", "default"))
+        settle(env)
+        env.clock.step(21)
+        disrupt(env)
+        # the merged load (3000m) only fits the candidates' own type, so a
+        # replacement would be same-type at the same price — forbidden
+        # (delete disguised as replace, multinodeconsolidation.go:180-217).
+        # The only legal consolidation is delete-only onto the survivor.
+        assert len(env.store.list(Node)) == 1
+        claims_after = {c.metadata.name for c in env.store.list(NodeClaim)}
+        assert claims_after <= claims_before
+        if env.disruption.last_command is not None:
+            assert not env.disruption.last_command.replacements
+        for p in env.store.list(Pod):
+            assert p.spec.node_name
