@@ -157,6 +157,12 @@ class KwokCloudProvider(CloudProvider):
         nodeclaim.status.capacity = dict(it.capacity)
         nodeclaim.status.allocatable = dict(it.allocatable())
         nodeclaim.status.image_id = "kwok-image"
+        # the created claim carries the launched instance's labels (the
+        # reference's Create response does; launch.go merges them) — drift
+        # detection reads instance-type/zone/capacity-type off the CLAIM
+        claim_labels = {k: v for k, v in labels.items()
+                        if k != api_labels.LABEL_HOSTNAME}
+        nodeclaim.metadata.labels.update(claim_labels)
         self.created[provider_id] = (nodeclaim, node)
         if self.store is not None:
             self.store.create(node)

@@ -80,6 +80,7 @@ class NodeClaimDisruptionMarker(Controller):
             return
         reason = self._static_drift(nc, pool) or \
             self._requirements_drift(nc, pool) or \
+            self._instance_type_drift(nc, pool) or \
             self.cloud_provider.is_drifted(nc)
         if reason:
             if not nc.conditions.is_true(COND_DRIFTED):
@@ -102,6 +103,23 @@ class NodeClaimDisruptionMarker(Controller):
         if nc_hash is None or nc_ver != NODEPOOL_HASH_VERSION:
             return ""
         return "NodePoolDrifted" if nc_hash != pool.static_hash() else ""
+
+    def _instance_type_drift(self, nc: NodeClaim, pool: NodePool) -> str:
+        """drift.go instanceTypeNotFound (:104-135): the claim's instance
+        type — or any offering matching its zone/capacity-type labels, over
+        the FULL offering list including temporarily-unavailable ones — no
+        longer exists in the provider catalog."""
+        it_name = nc.metadata.labels.get(api_labels.LABEL_INSTANCE_TYPE)
+        if not it_name:
+            return "InstanceTypeNotFound"
+        its = self.cloud_provider.get_instance_types(pool)
+        it = next((i for i in its if i.name == it_name), None)
+        if it is None:
+            return "InstanceTypeNotFound"
+        if not it.offerings.has_compatible(
+                label_requirements(nc.metadata.labels)):
+            return "InstanceTypeNotFound"
+        return ""
 
     def _requirements_drift(self, nc: NodeClaim, pool: NodePool) -> str:
         """drift.go RequirementsDrifted: pool requirements no longer admit the

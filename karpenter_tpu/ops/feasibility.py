@@ -143,9 +143,15 @@ def offering_compat(mask_b: jax.Array, zone_key: int, captype_key: int,
 def pods_per_node(alloc: jax.Array, overhead: jax.Array, req: jax.Array) -> jax.Array:
     """alloc [T,R], overhead [M,R] (daemon), req [G,R] -> [G,M,T] int32: how many
     identical pods fit a fresh node of type t under template m. Zero-request
-    resources don't constrain."""
+    resources don't constrain the pod count — but the daemon overhead itself
+    must fit the node in EVERY resource (the host oracle folds daemon
+    requests into the claim's request vector, scheduler.go:356-382 +
+    nodeclaim.go:108-117, so a type whose overhead outgrows it in any
+    column is infeasible there too): such types get 0."""
     free = alloc[None, :, :] - overhead[:, None, :]      # [M,T,R]
+    daemon_fits = jnp.all(free >= 0, axis=-1)            # [M,T]
     free = jnp.maximum(free, 0)
     r = req[:, None, None, :]                            # [G,1,1,R]
     per = jnp.where(r > 0, free[None] // jnp.maximum(r, 1), jnp.int32(2**30))
-    return jnp.min(per, axis=-1).astype(jnp.int32)       # [G,M,T]
+    per = jnp.min(per, axis=-1).astype(jnp.int32)        # [G,M,T]
+    return jnp.where(daemon_fits[None], per, jnp.int32(0))

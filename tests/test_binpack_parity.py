@@ -175,6 +175,23 @@ class TestInstanceTypePruning:
                 assert it.allocatable().get("cpu", 0) >= total, \
                     (it.name, total)
 
+    def test_oversized_daemon_overhead_excludes_type(self, ):
+        """A daemonset whose overhead outgrows every instance type in a
+        resource the PODS never request must make those types infeasible —
+        the host folds daemon requests into the claim's request vector, so
+        both paths must error the pods identically (not crash)."""
+        its = kwok.construct_instance_types()[:24]
+        daemon = make_pod(cpu="100m", memory="64Mi")
+        daemon.container_requests[0]["ephemeral-storage"] = \
+            100 * 1024**3 * 1000  # 100Gi scaled: exceeds every type
+        pods = make_pods(4, cpu="250m")
+        t = tensor_solve([make_nodepool()], its, pods,
+                         daemonset_pods=[daemon])
+        h = host_solve([make_nodepool()], its, pods,
+                       daemonset_pods=[daemon])
+        assert len(t.pod_errors) == len(h.pod_errors) == 4
+        assert not t.new_nodeclaims and not h.new_nodeclaims
+
     def test_limit_filtered_fill_keeps_viable_options(self):
         """With nodepool limits excluding the max-capacity type, the fill
         must be sized from the limit-filtered set — never producing a claim

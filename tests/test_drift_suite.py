@@ -1,0 +1,256 @@
+"""Scenario port of /root/reference/pkg/controllers/nodeclaim/disruption/
+drift_test.go: static-hash drift (incl. hash-version gating), requirements
+drift, stale-instance-type drift, drift-condition removal, per-pool
+isolation, and the Consolidatable marker's consolidateAfter semantics."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_DRIFTED,
+                                         NodeClaim)
+from karpenter_tpu.api.nodepool import NODEPOOL_HASH_VERSION
+from karpenter_tpu.api.objects import Node, NodeSelectorRequirement, Pod
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod
+
+ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    marker = NodeClaimDisruptionMarker(store, cluster, provider, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock), marker)
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.marker = marker
+    return e
+
+
+def settle(env, rounds=5):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def provision_one(env, pool=None, **pod_kw):
+    env.store.create(pool or make_nodepool(name="default"))
+    env.store.create(make_pod(**pod_kw))
+    settle(env)
+    claims = env.store.list(NodeClaim)
+    assert len(claims) == 1 and claims[0].launched()
+    return claims[0]
+
+
+def remark(env, nc):
+    """Force a marker pass on the claim and return its fresh state."""
+    env.marker.reconcile(nc)
+    return env.store.list(NodeClaim)[0]
+
+
+class TestStaticDrift:
+    def test_template_change_marks_drifted(self, env):
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        assert not nc.conditions.is_true(COND_DRIFTED)
+        pool.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_hash_version_mismatch_suppresses_drift(self, env):
+        """drift_test.go:497-510: an old-hash-version claim must NOT be
+        marked static-drifted — its hash was computed under different rules
+        (hydration re-stamps it first)."""
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        nc.metadata.annotations[
+            api_labels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v1"
+        env.store.update(nc)
+        pool.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+    def test_missing_hash_annotation_suppresses_drift(self, env):
+        """drift_test.go:488-496."""
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        nc.metadata.annotations.pop(
+            api_labels.NODEPOOL_HASH_ANNOTATION_KEY, None)
+        env.store.update(nc)
+        pool.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+    def test_drift_clears_when_pool_reverts(self, env):
+        """drift_test.go:192-203."""
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        pool.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        del pool.spec.template.metadata_labels["team"]
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+    def test_only_claims_of_updated_pool_drift(self, env):
+        """drift_test.go:355-480: two pools, one updated — only its claims
+        drift."""
+        pool_a = make_nodepool(name="pool-a")
+        pool_b = make_nodepool(name="pool-b")
+        env.store.create(pool_a)
+        env.store.create(pool_b)
+        env.store.create(make_pod(cpu="500m", name="pa", node_selector={
+            api_labels.NODEPOOL_LABEL_KEY: "pool-a"}))
+        env.store.create(make_pod(cpu="500m", name="pb", node_selector={
+            api_labels.NODEPOOL_LABEL_KEY: "pool-b"}))
+        settle(env)
+        pool_a.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool_a)
+        for nc in list(env.store.list(NodeClaim)):
+            env.marker.reconcile(nc)
+        for nc in env.store.list(NodeClaim):
+            drifted = nc.conditions.is_true(COND_DRIFTED)
+            assert drifted == (nc.nodepool_name == "pool-a"), nc.metadata.name
+
+    def test_no_drift_when_pool_missing(self, env):
+        """drift_test.go:184-191."""
+        nc = provision_one(env, cpu="500m")
+        from karpenter_tpu.api.nodepool import NodePool
+        env.store.delete(env.store.get(NodePool, "default"))
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+
+class TestRequirementsDrift:
+    def test_pool_requirements_excluding_claim_mark_drifted(self, env):
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m",
+                           node_selector={ZONE: "test-zone-a"})
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(ZONE, "In", ("test-zone-b",))]
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "RequirementsDrifted"
+
+    def test_compatible_requirement_change_no_drift(self, env):
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m",
+                           node_selector={ZONE: "test-zone-a"})
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(ZONE, "In",
+                                    ("test-zone-a", "test-zone-b"))]
+        env.store.update(pool)
+        # requirements changed -> static hash drift fires; requirements
+        # themselves stay compatible. Distinguish the reasons.
+        nc = remark(env, nc)
+        if nc.conditions.is_true(COND_DRIFTED):
+            assert nc.conditions.get(COND_DRIFTED).reason != \
+                "RequirementsDrifted"
+
+
+class TestInstanceTypeDrift:
+    """drift_test.go:85-125 — stale instance types."""
+
+    def test_missing_instance_type_label(self, env):
+        nc = provision_one(env, cpu="500m")
+        del nc.metadata.labels[api_labels.LABEL_INSTANCE_TYPE]
+        env.store.update(nc)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_vanished_instance_type(self, env):
+        nc = provision_one(env, cpu="500m")
+        it_name = nc.metadata.labels[api_labels.LABEL_INSTANCE_TYPE]
+        env.provider._instance_types = [
+            it for it in env.provider._instance_types if it.name != it_name]
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_vanished_offering(self, env):
+        """The claim's zone/capacity-type combination disappears from the
+        type's offerings."""
+        nc = provision_one(env, cpu="500m")
+        it_name = nc.metadata.labels[api_labels.LABEL_INSTANCE_TYPE]
+        zone = nc.metadata.labels[ZONE]
+        it = next(i for i in env.provider._instance_types
+                  if i.name == it_name)
+        it.offerings[:] = [o for o in it.offerings if o.zone != zone]
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_unavailable_offering_is_not_drift(self, env):
+        """Temporarily-unavailable offerings still count: the catalog data
+        exists, the capacity just isn't purchasable right now."""
+        nc = provision_one(env, cpu="500m")
+        it_name = nc.metadata.labels[api_labels.LABEL_INSTANCE_TYPE]
+        it = next(i for i in env.provider._instance_types
+                  if i.name == it_name)
+        for o in it.offerings:
+            o.available = False
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+
+class TestConsolidatableMarker:
+    def test_consolidate_after_never_clears(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.consolidate_after = None  # Never
+        nc = provision_one(env, pool=pool, cpu="500m")
+        env.clock.step(3600)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_CONSOLIDATABLE)
+
+    def test_consolidate_after_elapses(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.consolidate_after = 30.0
+        nc = provision_one(env, pool=pool, cpu="500m")
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_CONSOLIDATABLE)
+        env.clock.step(31)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_CONSOLIDATABLE)
+
+    def test_pod_event_resets_consolidatable(self, env):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.consolidate_after = 30.0
+        nc = provision_one(env, pool=pool, cpu="500m")
+        env.clock.step(31)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_CONSOLIDATABLE)
+        nc.status.last_pod_event_time = env.clock.now()
+        env.store.update(nc)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_CONSOLIDATABLE)
