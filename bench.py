@@ -361,6 +361,53 @@ def bench_provisioning(pods, n_its, mixed: bool = False):
     }
 
 
+def bench_sidecar():
+    """The north-star deployment boundary (SURVEY §7 layer 8): controllers
+    call the TPU solver over gRPC. Measures the FULL round trip — request
+    encode, wire, server-side solve (warm catalog cache), response decode —
+    on the benchmark mix, so the sidecar path's overhead is driver-visible."""
+    from karpenter_tpu.sidecar.client import RemoteScheduler
+    from karpenter_tpu.sidecar.server import serve
+
+    pods = _pods()
+    catalog = _catalog()
+    nodepool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplate(
+            spec=NodeClaimTemplateSpec())))
+    server, port = serve()
+    try:
+        # one client/channel for the whole run: the metric measures the
+        # request round trip, not TCP/HTTP2 connection establishment
+        rs = RemoteScheduler(f"127.0.0.1:{port}", [nodepool],
+                             {"default": catalog})
+
+        def one():
+            r = rs.solve(pods)
+            assert rs.fallback_reason == "", rs.fallback_reason
+            assert len(pods) - len(r.pod_errors) > 0
+            return r
+
+        one()  # warm jit + catalog encoding on the server
+        best = float("inf")
+        for _ in range(max(1, REPEATS - 1)):
+            t0 = time.perf_counter()
+            one()
+            best = min(best, time.perf_counter() - t0)
+        rs._channel.close()
+        print(json.dumps({
+            "metric": (f"provisioning Solve() over the gRPC sidecar, "
+                       f"{len(pods)} pods x {len(catalog)} instance types "
+                       "(full round trip incl. codec)"),
+            "value": round(len(pods) / best, 1),
+            "unit": "pods/sec",
+            "vs_baseline": round(len(pods) / best / 100.0, 2),
+            "seconds": round(best, 3),
+        }), flush=True)
+    finally:
+        server.stop(0)
+
+
 def bench_mesh_local():
     """North-star config solved over a MESH_DEVICES-device mesh (VERDICT r2
     #9): the full solve with the feasibility precompute sharded (groups x
@@ -460,9 +507,13 @@ def main():
     if MODE == "mesh-local":
         bench_mesh_local()
         return
+    if MODE == "sidecar":
+        bench_sidecar()
+        return
     if MODE not in ("all", "provisioning"):
-        raise SystemExit(f"unknown BENCH_MODE {MODE!r}; expected one of "
-                         "all|provisioning|consolidation|spot|mesh|mesh-local")
+        raise SystemExit(
+            f"unknown BENCH_MODE {MODE!r}; expected one of "
+            "all|provisioning|consolidation|spot|mesh|mesh-local|sidecar")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))
@@ -477,7 +528,8 @@ def main():
     print(json.dumps(bench_provisioning(_pods(hostport_pct=1.0), 0,
                                         mixed=True)), flush=True)
     if MODE == "all":
-        for aux in (bench_consolidation, bench_spot_repack, bench_mesh):
+        for aux in (bench_consolidation, bench_spot_repack, bench_mesh,
+                    bench_sidecar):
             try:
                 aux()
             except Exception as e:  # noqa: BLE001 — headline must survive

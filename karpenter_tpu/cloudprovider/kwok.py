@@ -139,6 +139,11 @@ class KwokCloudProvider(CloudProvider):
         node_name = f"kwok-node-{n:05d}"
         labels = dict(nodeclaim.metadata.labels)
         labels.update(reqs.labels())
+        # the launched instance's own facts override requirement
+        # representatives: a multi-valued claim requirement (arch In
+        # [amd64, arm64]) must not stamp a value contradicting the chosen
+        # type (launch.go merges instanceType.Requirements.Labels())
+        labels.update(it.requirements.labels())
         labels[api_labels.LABEL_INSTANCE_TYPE] = it.name
         labels[api_labels.LABEL_TOPOLOGY_ZONE] = offering.zone
         labels[api_labels.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type

@@ -23,6 +23,8 @@ from ..state.cluster import Cluster
 from ..utils.clock import Clock
 from .manager import Controller, Result
 
+DRIFT_RECHECK_SECONDS = 300.0  # drift.go:68,76 — 5 min cache TTL
+
 
 class NodeClaimDisruptionMarker(Controller):
     name = "nodeclaim.disruption"
@@ -42,7 +44,10 @@ class NodeClaimDisruptionMarker(Controller):
             return None
         requeue = self._consolidatable(nc)
         self._drifted(nc)
-        return Result(requeue_after=requeue) if requeue else None
+        # drift inputs are external (catalog, cloud provider): re-check on a
+        # timer even with no claim events (drift.go:68,76 — 5 min cache TTL)
+        return Result(requeue_after=min(requeue or DRIFT_RECHECK_SECONDS,
+                                        DRIFT_RECHECK_SECONDS))
 
     # -- Consolidatable -----------------------------------------------------
 

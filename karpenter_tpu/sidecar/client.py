@@ -93,7 +93,9 @@ class RemoteScheduler:
         # same way an in-process solve would (topology.go:268-321)
         self.cluster = cluster
         self.fallback_reason = ""
-        self._channel = channel or grpc.insecure_channel(address)
+        from .server import GRPC_OPTIONS
+        self._channel = channel or grpc.insecure_channel(
+            address, options=GRPC_OPTIONS)
 
     def solve(self, pods: List[Pod]) -> RemoteResults:
         request = codec.encode_solve_request(

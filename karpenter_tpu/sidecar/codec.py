@@ -197,6 +197,75 @@ def pod_to_dict(p: Pod) -> dict:
     }
 
 
+def encode_pod_batch(pods) -> dict:
+    """Deployment-level dedup for large batches: pods stamped from one
+    deployment share their spec sub-objects, so an identity-keyed template
+    table collapses 50k pods to O(deployments) full specs + a per-pod
+    [name, uid, timestamp, node_name, template] row. This is the wire-side
+    twin of grouping.partition_pods' signature bucketing — and decoding
+    rebuilds SHARED sub-objects, so the server-side bucketing stays O(1)
+    per pod too."""
+    templates: list = []
+    tmpl_idx: dict = {}
+    rows: list = []
+    for p in pods:
+        # identity tokens for stamped-and-shared sub-objects, insertion-order
+        # content for per-pod dicts: distinct-but-equal objects just cost an
+        # extra template, never correctness (the template holds full content)
+        spec = p.spec
+        key = (id(spec.affinity),
+               tuple(map(id, spec.topology_spread_constraints)),
+               tuple(map(id, spec.tolerations)),
+               tuple(spec.node_selector.items()),
+               tuple(p.metadata.labels.items()),
+               tuple(tuple(r.items()) for r in p.container_requests),
+               tuple(tuple(r.items()) for r in p.init_container_requests),
+               tuple((hp.port, hp.protocol, hp.host_ip)
+                     for hp in spec.host_ports),
+               p.metadata.namespace, spec.priority, p.is_daemonset_pod,
+               tuple(p.metadata.annotations.items()))
+        i = tmpl_idx.get(key)
+        if i is None:
+            d = pod_to_dict(p)
+            for f in ("name", "uid", "creation_timestamp", "node_name"):
+                d.pop(f, None)
+            i = tmpl_idx[key] = len(templates)
+            templates.append(d)
+        rows.append([p.name, p.uid, p.metadata.creation_timestamp,
+                     p.spec.node_name, i])
+    return {"templates": templates, "rows": rows}
+
+
+def decode_pod_batch(d: dict) -> "List[Pod]":
+    protos = []
+    for t in d["templates"]:
+        full = dict(t)
+        full.update(name="", uid="", creation_timestamp=0.0, node_name="")
+        protos.append(pod_from_dict(full))
+    out = []
+    for name, uid, ts, node_name, i in d["rows"]:
+        pr = protos[i]
+        out.append(Pod(
+            metadata=ObjectMeta(
+                name=name, namespace=pr.namespace, uid=uid,
+                labels=dict(pr.labels),
+                annotations=dict(pr.metadata.annotations),
+                creation_timestamp=ts),
+            spec=PodSpec(
+                node_selector=pr.spec.node_selector,
+                affinity=pr.spec.affinity,
+                tolerations=pr.spec.tolerations,
+                topology_spread_constraints=
+                    pr.spec.topology_spread_constraints,
+                host_ports=pr.spec.host_ports,
+                priority=pr.spec.priority,
+                node_name=node_name),
+            container_requests=pr.container_requests,
+            init_container_requests=pr.init_container_requests,
+            is_daemonset_pod=pr.is_daemonset_pod))
+    return out
+
+
 def pod_from_dict(d: dict) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=d["name"], namespace=d["namespace"],
@@ -503,7 +572,7 @@ def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]
         "nodepools": [nodepool_to_dict(np) for np in nodepools],
         "catalog": list(catalog.values()),
         "pool_instance_types": per_pool,
-        "pods": [pod_to_dict(p) for p in pods],
+        "pods": encode_pod_batch(pods),
         "state_nodes": [state_node_to_dict(sn) for sn in state_nodes],
         "daemonset_pods": [pod_to_dict(p) for p in daemonset_pods],
         "cluster": (cluster_view_to_dict(cluster, pods)
@@ -520,7 +589,7 @@ def decode_solve_request(data: bytes):
     return (
         [nodepool_from_dict(np) for np in d["nodepools"]],
         instance_types,
-        [pod_from_dict(p) for p in d["pods"]],
+        decode_pod_batch(d["pods"]),
         [WireStateNode(sn) for sn in d["state_nodes"]],
         [pod_from_dict(p) for p in d["daemonset_pods"]],
         WireClusterView(d.get("cluster")),

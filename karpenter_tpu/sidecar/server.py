@@ -43,9 +43,20 @@ class SolverServicer(grpc.GenericRpcHandler):
         return None
 
 
+# a 50k-pod solve request is ~30 MB of codec JSON; the gRPC default (4 MB)
+# would cap the solver at ~7k pods per call
+MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+
+GRPC_OPTIONS = [
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+]
+
+
 def serve(port: int = 0, max_workers: int = 4):
     """Start the sidecar; returns (server, bound_port)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((SolverServicer(),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()

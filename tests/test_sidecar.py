@@ -43,6 +43,34 @@ class TestCodec:
             == api_labels.LABEL_HOSTNAME
         assert codec.pod_to_dict(back) == d
 
+    def test_pod_batch_dedup_round_trip(self):
+        """encode_pod_batch collapses deployment-stamped pods to one
+        template and rebuilds them with SHARED spec sub-objects, so the
+        server-side grouping signature bucketing stays O(1) per pod."""
+        spread = [spread_zone(key="app", value="d0")]
+        a = [make_pod(cpu="500m", labels={"app": "d0"}, spread=spread,
+                      name=f"a-{i}") for i in range(5)]
+        b = [make_pod(cpu="250m", labels={"app": "d1"}, name=f"b-{i}")
+             for i in range(3)]
+        wire = codec.encode_pod_batch(a + b)
+        assert len(wire["templates"]) == 2
+        assert len(wire["rows"]) == 8
+        back = codec.decode_pod_batch(wire)
+        assert [p.name for p in back] == [p.name for p in a + b]
+        assert [p.uid for p in back] == [p.uid for p in a + b]
+        assert back[0].requests() == a[0].requests()
+        assert len(back[0].spec.topology_spread_constraints) == 1
+        # same-template pods share spec sub-objects after decode
+        assert back[0].spec.topology_spread_constraints[0] is \
+            back[1].spec.topology_spread_constraints[0]
+        assert back[5].spec.affinity is back[6].spec.affinity
+        # distinct host ports force distinct templates (conflict tracking)
+        from karpenter_tpu.api.objects import HostPort
+        ported = [make_pod(cpu="100m", host_ports=[HostPort(port=9000 + i)])
+                  for i in range(2)]
+        wire2 = codec.encode_pod_batch(ported)
+        assert len(wire2["templates"]) == 2
+
     def test_instance_type_round_trip(self):
         it = construct_instance_types()[0]
         back = codec.instance_type_from_dict(codec.instance_type_to_dict(it))
