@@ -79,6 +79,11 @@ class Manager:
         self._queued: set = set()
         self._timers: list = []  # heap of (fire_at, seq, controller, obj)
         self._timer_seq = itertools.count()
+        # workqueue AddAfter dedup: at most one pending timer per
+        # (controller, object), keeping the EARLIEST fire time — without it,
+        # every event-driven reconcile that returns requeue_after spawns a
+        # new perpetual timer chain and the heap grows with event history
+        self._timer_pending: dict = {}
         store.watch(self._on_event)
 
     # -- registration -------------------------------------------------------
@@ -107,16 +112,27 @@ class Manager:
         self._queue.append((controller, obj))
 
     def requeue(self, controller: Controller, obj, after: float) -> None:
+        key = (controller.name, type(obj).__name__,
+               obj.metadata.namespace, obj.metadata.name)
+        fire_at = self.clock.now() + after
+        pending = self._timer_pending.get(key)
+        if pending is not None and pending <= fire_at:
+            return  # an earlier (or equal) timer already covers this
+        self._timer_pending[key] = fire_at
         heapq.heappush(self._timers,
-                       (self.clock.now() + after, next(self._timer_seq),
-                        controller, obj))
+                       (fire_at, next(self._timer_seq), controller, obj))
 
     # -- dispatch -----------------------------------------------------------
 
     def _fire_due_timers(self) -> None:
         now = self.clock.now()
         while self._timers and self._timers[0][0] <= now:
-            _, _, c, obj = heapq.heappop(self._timers)
+            fire_at, _, c, obj = heapq.heappop(self._timers)
+            key = (c.name, type(obj).__name__,
+                   obj.metadata.namespace, obj.metadata.name)
+            if self._timer_pending.get(key) != fire_at:
+                continue  # superseded by an earlier requeue; stale heap entry
+            del self._timer_pending[key]
             self._enqueue(c, obj)
 
     def drain(self, max_items: int = 100_000) -> int:

@@ -1,0 +1,109 @@
+"""Leader election for HA operator deployments.
+
+The reference delegates this to a Kubernetes coordination Lease via
+controller-runtime (/root/reference/pkg/operator/operator.go:137-141:
+LeaderElection over leases in kube-system, renewed by the manager; only the
+leader runs controllers). Standalone, the shared substrate is the state
+directory, so the lease is a file: a JSON record {holder, acquired, renew
+deadline} mutated only under an fcntl lock on a sidecar lock file — the
+single-host analog of the apiserver's compare-and-swap on resourceVersion.
+Multi-host deployments would point this at the real coordination API via a
+Lease-shaped adapter; the Operator only sees acquire/renew/release.
+
+Semantics mirror client-go leaderelection: a candidate acquires when the
+lease is absent, expired, or already its own; the holder renews every
+renew_period; a holder that cannot renew within lease_duration is considered
+dead and its lease is stolen.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..utils.clock import Clock
+
+
+class FileLease:
+    def __init__(self, path: str, identity: str,
+                 lease_duration: float = 15.0,
+                 clock: Optional[Clock] = None):
+        self.path = path
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.clock = clock or Clock()
+
+    # -- locked read-modify-write -------------------------------------------
+
+    def _locked(self, fn):
+        import fcntl
+        lock_path = self.path + ".lock"
+        os.makedirs(os.path.dirname(os.path.abspath(lock_path)), exist_ok=True)
+        with open(lock_path, "a+") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                return fn()
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def _write(self, record: dict) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, self.path)
+
+    # -- API ----------------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """Acquire or renew; returns True when this identity holds the
+        lease afterwards."""
+        def attempt():
+            now = self.clock.now()
+            rec = self._read()
+            if rec is not None and rec.get("holder") != self.identity and \
+                    rec.get("renew_deadline", 0) > now:
+                return False
+            self._write({"holder": self.identity, "acquired": now,
+                         "renew_deadline": now + self.lease_duration})
+            return True
+        return self._locked(attempt)
+
+    def renew(self) -> bool:
+        """Extend the lease; returns False if it was lost (stolen after an
+        expiry — the caller must stop leading immediately)."""
+        def attempt():
+            now = self.clock.now()
+            rec = self._read()
+            if rec is None or rec.get("holder") != self.identity:
+                return False
+            self._write({"holder": self.identity,
+                         "acquired": rec.get("acquired", now),
+                         "renew_deadline": now + self.lease_duration})
+            return True
+        return self._locked(attempt)
+
+    def release(self) -> None:
+        """Graceful handoff: delete the lease so the next candidate acquires
+        without waiting out the expiry."""
+        def attempt():
+            rec = self._read()
+            if rec is not None and rec.get("holder") == self.identity:
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+        self._locked(attempt)
+
+    def holder(self) -> Optional[str]:
+        rec = self._locked(self._read)
+        if rec is None or rec.get("renew_deadline", 0) <= self.clock.now():
+            return None
+        return rec.get("holder")

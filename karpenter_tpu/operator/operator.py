@@ -164,23 +164,64 @@ class Operator:
         """One full pass: watch fallout + singleton loops (tests/sim)."""
         self.manager.run_until_quiet()
 
+    def _lease(self):
+        """Leader-election lease when enabled (operator.go:137-141)."""
+        if not self.options.leader_elect:
+            return None
+        import os
+        import socket
+        import uuid
+        from .leaderelection import FileLease
+        path = self.options.lease_file or \
+            (self.options.state_file or "karpenter-tpu") + ".lease"
+        # pid + random suffix: two replicas (even forked, same heap layout)
+        # must never share an identity — FileLease treats a matching holder
+        # as "already mine", so a collision would be split-brain
+        identity = (f"{socket.gethostname()}-{os.getpid()}-"
+                    f"{uuid.uuid4().hex[:8]}")
+        return FileLease(path, identity,
+                         lease_duration=self.options.lease_duration,
+                         clock=self.clock)
+
     def run(self, stop=None, tick_seconds: float = 1.0) -> None:
-        """Real-time loop (kwok/main.go:33-48 equivalent)."""
+        """Real-time loop (kwok/main.go:33-48 equivalent). With leader
+        election enabled, probes/metrics serve immediately but controllers
+        only run while this process holds the lease — a standby that
+        acquires it (crash or graceful release of the leader) takes over."""
         self.log.info("starting operator",
                       cluster_name=self.options.cluster_name,
                       solver_backend=self.options.solver_backend,
                       feature_gates=self.options.feature_gates)
         self.start_serving()
+        lease = self._lease()
+        leading = lease is None
         try:
             while stop is None or not stop():
-                self.manager.run_until_quiet()
-                self.checkpoint()
+                if lease is not None:
+                    held = lease.renew() if leading else lease.try_acquire()
+                    if held and not leading:
+                        self.log.info("acquired leadership",
+                                      lease=lease.path,
+                                      identity=lease.identity)
+                    elif not held and leading:
+                        self.log.error("lost leadership lease; standing by",
+                                       lease=lease.path)
+                    leading = held
+                if leading:
+                    self.manager.run_until_quiet()
+                    self.checkpoint()
                 time.sleep(tick_seconds)
         finally:
             try:
-                self.checkpoint()
+                if leading:
+                    self.checkpoint()
             except Exception as exc:  # must not mask the loop's exception
                 self.log.error("final checkpoint failed", error=str(exc))
+            if lease is not None and leading:
+                try:
+                    lease.release()
+                except Exception as exc:  # ditto: never mask or block exit
+                    self.log.error("lease release failed", error=str(exc))
             self.stop_serving()
 
     def metrics_text(self) -> str:

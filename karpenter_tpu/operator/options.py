@@ -56,6 +56,10 @@ class Options:
     solver_backend: str = "tensor"   # tensor | sidecar
     solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint
     solver_devices: int = 0          # 0 = all visible
+    # HA: only the lease holder runs controllers (operator.go:137-141)
+    leader_elect: bool = False
+    lease_file: str = ""             # default: <state_file>.lease
+    lease_duration: float = 15.0
 
     @property
     def gates(self) -> FeatureGates:

@@ -157,7 +157,10 @@ def _out_shardings(mesh: Mesh):
     return (mg, gmt, gmt, g0, g0, g0)
 
 
-_sharded_cache = {}
+from collections import OrderedDict
+
+_sharded_cache: OrderedDict = OrderedDict()
+_SHARDED_CACHE_MAX = 16
 
 
 def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensors:
@@ -169,13 +172,17 @@ def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensor
     key = (mesh, tuple(sorted(statics.items())))
     fn = _sharded_cache.get(key)
     if fn is None:
-        if len(_sharded_cache) >= 16:
-            _sharded_cache.clear()
+        if len(_sharded_cache) >= _SHARDED_CACHE_MAX:
+            # LRU single eviction (was: clear-all, a recompile storm when
+            # two meshes alternate at the cap)
+            _sharded_cache.popitem(last=False)
         fn = jax.jit(
             lambda *a: binpack.precompute_kernel(*a, **statics),
             in_shardings=_arg_shardings(mesh),
             out_shardings=_out_shardings(mesh))
         _sharded_cache[key] = fn
+    else:
+        _sharded_cache.move_to_end(key)
     out = fn(*args)
     compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
         jax.device_get(out)

@@ -69,7 +69,9 @@ class _CatalogEncoding:
     device_cache: dict
 
 
-_CATALOG_CACHE: "Dict[tuple, _CatalogEncoding]" = {}
+from collections import OrderedDict
+
+_CATALOG_CACHE: "OrderedDict[tuple, _CatalogEncoding]" = OrderedDict()
 _CATALOG_CACHE_MAX = 4
 
 
@@ -315,8 +317,14 @@ class TensorScheduler:
             ce = self._encode_catalog(catalog, templates, groups)
             if ckey not in _CATALOG_CACHE and \
                     len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
-                _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
+                # LRU: catalogs alternate under multi-provider or prefix
+                # probing — evicting the least-recently-USED entry keeps the
+                # hot ones device-resident (was: arbitrary pop)
+                _CATALOG_CACHE.popitem(last=False)
             _CATALOG_CACHE[ckey] = ce
+        # mark most-recently-used on hit AND on (re-)encode: a vocab-overflow
+        # re-encode overwrites in place, which alone preserves LRU position
+        _CATALOG_CACHE.move_to_end(ckey)
         vocab = ce.vocab
         zone_key, captype_key = ce.zone_key, ce.captype_key
         it_enc, it_alloc, it_capacity = ce.it_enc, ce.it_alloc, ce.it_capacity

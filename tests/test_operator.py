@@ -227,3 +227,70 @@ class TestConsistencyTaintCheck:
         Consistency(store, recorder, clock).reconcile(store.get(NodeClaim, "nc1"))
         msgs = [e.message for e in recorder.for_object("nc1")]
         assert any("taint" in m for m in msgs), msgs
+
+
+class TestManagerTimerDedup:
+    def test_requeue_coalesces_per_object(self):
+        """workqueue AddAfter dedup: repeated requeue_after results from
+        event-driven reconciles must keep ONE pending timer per
+        (controller, object) — the earliest — not spawn a chain per event."""
+        from karpenter_tpu.controllers.manager import Controller, Manager, Result
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock)
+        fired = []
+
+        class Periodic(Controller):
+            name = "test.periodic"
+            kinds = (Pod,)
+
+            def reconcile(self, obj):
+                fired.append(clock.now())
+                return Result(requeue_after=300.0)
+
+        mgr = Manager(store, clock)
+        mgr.register(Periodic())
+        pod = make_pod(cpu="100m")
+        store.create(pod)
+        mgr.drain()
+        # a burst of unrelated events re-reconciles the pod repeatedly
+        for _ in range(5):
+            store.update(pod)
+            mgr.drain()
+        assert len(mgr._timer_pending) == 1
+        n = len(fired)
+        clock.step(301)
+        mgr.drain()
+        assert len(fired) == n + 1          # ONE timer fired, not six
+        assert len(mgr._timer_pending) == 1  # and it rearmed exactly once
+
+    def test_earlier_requeue_wins(self):
+        from karpenter_tpu.controllers.manager import Controller, Manager, Result
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock)
+        delays = iter([300.0, 5.0])
+        fired = []
+
+        class C(Controller):
+            name = "test.varying"
+            kinds = (Pod,)
+
+            def reconcile(self, obj):
+                fired.append(clock.now())
+                return Result(requeue_after=next(delays, None))
+
+        mgr = Manager(store, clock)
+        mgr.register(C())
+        pod = make_pod(cpu="100m")
+        store.create(pod)
+        mgr.drain()          # schedules +300
+        store.update(pod)
+        mgr.drain()          # schedules +5 -> must supersede the +300
+        clock.step(6)
+        mgr.drain()
+        assert len(fired) == 3  # the 5s timer fired; 300s entry was stale
