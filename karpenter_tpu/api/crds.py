@@ -50,8 +50,12 @@ def _requirement_schema() -> dict:
                      "!has(self.values) || size(self.values) == 0",
              "message": "operator Exists/DoesNotExist forbids values"},
             {"rule": "(self.operator != 'Gt' && self.operator != 'Lt') || "
-                     "(has(self.values) && size(self.values) == 1)",
+                     "(has(self.values) && size(self.values) == 1 && "
+                     "self.values.all(x, x.matches('^[0-9]+$')))",
              "message": "operator Gt/Lt requires a single positive integer"},
+            {"rule": "!has(self.minValues) || self.operator != 'In' || "
+                     "self.minValues <= size(self.values)",
+             "message": "minValues cannot exceed the number of values"},
         ],
     }
 

@@ -204,7 +204,13 @@ class HostPort:
 
 @dataclass(frozen=True)
 class PVCRef:
+    """A pod volume backed by a PVC. For generic ephemeral volumes
+    (pod.spec.volumes[].ephemeral), claim_name is the VOLUME name — the
+    controller-created claim is '<pod-name>-<volume-name>' — and
+    storage_class_name carries the volumeClaimTemplate's class."""
     claim_name: str
+    ephemeral: bool = False
+    storage_class_name: str = ""
 
 
 @dataclass

@@ -53,6 +53,47 @@ class PersistentVolumeClaim:
         return self.metadata.namespace
 
 
+# storageclass.kubernetes.io/is-default-class (suite_test.go:2981-3282)
+DEFAULT_SC_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
+
+
+def default_storage_class(store) -> "Optional[StorageClass]":
+    """The cluster's default StorageClass; with several annotated, the
+    NEWEST wins (suite_test.go:3076-3180)."""
+    cands = [sc for sc in store.list(StorageClass)
+             if sc.metadata.annotations.get(DEFAULT_SC_ANNOTATION) == "true"]
+    if not cands:
+        return None
+    return max(cands, key=lambda sc: sc.metadata.creation_timestamp or 0)
+
+
+def ephemeral_claim_name(pod, ref) -> str:
+    """Generic-ephemeral-volume claim naming: '<pod-name>-<volume-name>'."""
+    return f"{pod.name}-{ref.claim_name}"
+
+
+def resolve_volume(store, pod, ref):
+    """-> (pvc_or_None, storage_class_name). Honors ephemeral naming
+    (ephemeral_claim_name), the ephemeral template's class, and
+    default-class fallback when no class is named anywhere."""
+    ephemeral = getattr(ref, "ephemeral", False)
+    name = ephemeral_claim_name(pod, ref) if ephemeral else ref.claim_name
+    pvc = store.get(PersistentVolumeClaim, name, pod.namespace)
+    if pvc is None and not ephemeral:
+        # callers treat a missing non-ephemeral claim as skip/error; don't
+        # pay the default-class scan for a result they discard
+        return None, ""
+    sc_name = ""
+    if pvc is not None:
+        sc_name = pvc.spec.storage_class_name or ""
+    else:
+        sc_name = ref.storage_class_name or ""
+    if not sc_name and (pvc is None or not pvc.spec.volume_name):
+        sc = default_storage_class(store)
+        sc_name = sc.metadata.name if sc is not None else ""
+    return pvc, sc_name
+
+
 @dataclass
 class TopologySelector:
     """StorageClass.allowedTopologies entry: key -> allowed values."""

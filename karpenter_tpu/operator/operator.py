@@ -183,6 +183,37 @@ class Operator:
                          lease_duration=self.options.lease_duration,
                          clock=self.clock)
 
+    def _start_renewal(self, lease):
+        """Background lease renewal, independent of reconcile duration: a
+        reconcile pass longer than the lease duration must not let a
+        standby steal the lease mid-pass (client-go renews on its own
+        goroutine with renewDeadline < leaseDuration for the same reason).
+        Sets _lease_lost when a renewal fails."""
+        import threading
+        self._lease_lost = threading.Event()
+        self._renew_stop = threading.Event()
+
+        def loop():
+            period = max(0.2, lease.lease_duration / 3.0)
+            while not self._renew_stop.wait(period):
+                try:
+                    if not lease.renew():
+                        self._lease_lost.set()
+                        return
+                except Exception:
+                    self._lease_lost.set()
+                    return
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="karpenter-lease-renewal")
+        t.start()
+        return t
+
+    def _stop_renewal(self) -> None:
+        ev = getattr(self, "_renew_stop", None)
+        if ev is not None:
+            ev.set()
+
     def run(self, stop=None, tick_seconds: float = 1.0) -> None:
         """Real-time loop (kwok/main.go:33-48 equivalent). With leader
         election enabled, probes/metrics serve immediately but controllers
@@ -198,20 +229,23 @@ class Operator:
         try:
             while stop is None or not stop():
                 if lease is not None:
-                    held = lease.renew() if leading else lease.try_acquire()
-                    if held and not leading:
+                    if leading and self._lease_lost.is_set():
+                        self.log.error("lost leadership lease; standing by",
+                                       lease=lease.path)
+                        self._stop_renewal()
+                        leading = False
+                    if not leading and lease.try_acquire():
                         self.log.info("acquired leadership",
                                       lease=lease.path,
                                       identity=lease.identity)
-                    elif not held and leading:
-                        self.log.error("lost leadership lease; standing by",
-                                       lease=lease.path)
-                    leading = held
+                        leading = True
+                        self._start_renewal(lease)
                 if leading:
                     self.manager.run_until_quiet()
                     self.checkpoint()
                 time.sleep(tick_seconds)
         finally:
+            self._stop_renewal()
             try:
                 if leading:
                     self.checkpoint()

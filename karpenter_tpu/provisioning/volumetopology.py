@@ -20,18 +20,19 @@ from ..api.storage import (PersistentVolume, PersistentVolumeClaim,
 
 
 def _volume_requirements(store, pod: Pod) -> List[NodeSelectorRequirement]:
+    from ..api.storage import resolve_volume
     reqs: List[NodeSelectorRequirement] = []
     for ref in pod.spec.volumes:
-        pvc = store.get(PersistentVolumeClaim, ref.claim_name, pod.namespace)
-        if pvc is None:
+        pvc, sc_name = resolve_volume(store, pod, ref)
+        if pvc is None and not ref.ephemeral:
             continue
-        if pvc.spec.volume_name:
+        if pvc is not None and pvc.spec.volume_name:
             pv = store.get(PersistentVolume, pvc.spec.volume_name)
             if pv is not None:
                 for term in pv.spec.node_affinity_terms:
                     reqs.extend(term.match_expressions)
-        elif pvc.spec.storage_class_name:
-            sc = store.get(StorageClass, pvc.spec.storage_class_name)
+        elif sc_name:
+            sc = store.get(StorageClass, sc_name)
             if sc is not None:
                 for topo in sc.allowed_topologies:
                     reqs.append(NodeSelectorRequirement(
@@ -65,16 +66,22 @@ def inject_volume_topology_requirements(store, pod: Pod) -> Pod:
 
 def validate_persistent_volume_claims(store, pod: Pod) -> Optional[str]:
     """volumetopology.go:152-199: a pod referencing a missing PVC or a PVC
-    with a missing StorageClass can't schedule."""
+    with a missing StorageClass can't schedule. Ephemeral volumes validate
+    against their template's (or the default) class instead of an existing
+    claim — the ephemeral controller creates the claim after scheduling."""
+    from ..api.storage import resolve_volume
     for ref in pod.spec.volumes:
-        pvc = store.get(PersistentVolumeClaim, ref.claim_name, pod.namespace)
+        pvc, sc_name = resolve_volume(store, pod, ref)
         if pvc is None:
-            return f'pvc "{pod.namespace}/{ref.claim_name}" not found'
+            if not ref.ephemeral:
+                return f'pvc "{pod.namespace}/{ref.claim_name}" not found'
+            if sc_name and store.get(StorageClass, sc_name) is None:
+                return f'storageclass "{sc_name}" not found'
+            continue
         if pvc.spec.volume_name:
             if store.get(PersistentVolume, pvc.spec.volume_name) is None:
                 return f'volume "{pvc.spec.volume_name}" not found'
             continue
-        sc_name = pvc.spec.storage_class_name
         if sc_name and store.get(StorageClass, sc_name) is None:
             return f'storageclass "{sc_name}" not found'
     return None

@@ -30,27 +30,33 @@ class Volumes(dict):
 
 def get_volumes(store, pod: Pod) -> Volumes:
     """volumeusage.go:83-115: pod -> PVC -> driver resolution; missing PVCs
-    are skipped (manually-deleted PVC must not wedge state)."""
+    are skipped (manually-deleted PVC must not wedge state) EXCEPT ephemeral
+    ones, whose claim is derived from the volumeClaimTemplate before the
+    ephemeral controller creates it."""
+    from ..api.storage import ephemeral_claim_name, resolve_volume
     out = Volumes()
     for ref in pod.spec.volumes:
-        pvc = store.get(PersistentVolumeClaim, ref.claim_name, pod.namespace)
-        if pvc is None:
+        pvc, sc_name = resolve_volume(store, pod, ref)
+        if pvc is None and not ref.ephemeral:
             continue
-        driver = _resolve_driver(store, pvc)
+        driver = _resolve_driver(store, pvc, sc_name)
         if driver:
-            out.add(driver, f"{pvc.namespace}/{pvc.name}")
+            name = pvc.name if pvc is not None else \
+                ephemeral_claim_name(pod, ref)
+            out.add(driver, f"{pod.namespace}/{name}")
     return out
 
 
-def _resolve_driver(store, pvc: PersistentVolumeClaim) -> str:
+def _resolve_driver(store, pvc: "Optional[PersistentVolumeClaim]",
+                    sc_name: str = "") -> str:
     """volumeusage.go:117-151: bound PV's CSI driver wins, else the
-    StorageClass provisioner."""
-    if pvc.spec.volume_name:
+    (resolved) StorageClass provisioner."""
+    if pvc is not None and pvc.spec.volume_name:
         pv = store.get(PersistentVolume, pvc.spec.volume_name)
         if pv is not None and pv.spec.csi is not None:
             return pv.spec.csi.driver
-    if pvc.spec.storage_class_name:
-        sc = store.get(StorageClass, pvc.spec.storage_class_name)
+    if sc_name:
+        sc = store.get(StorageClass, sc_name)
         if sc is not None:
             return sc.provisioner
     return ""

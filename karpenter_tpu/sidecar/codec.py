@@ -22,8 +22,9 @@ from ..api.nodepool import (Budget, Disruption, NodeClaimTemplate,
 from ..api.objects import (Affinity, HostPort, LabelSelector, NodeAffinity,
                            NodeSelectorRequirement, NodeSelectorTerm, ObjectMeta,
                            OwnerReference, Pod, PodAffinity, PodAffinityTerm,
-                           PodSpec, PreferredSchedulingTerm, Taint, Toleration,
-                           TopologySpreadConstraint, WeightedPodAffinityTerm)
+                           PodSpec, PreferredSchedulingTerm, PVCRef, Taint,
+                           Toleration, TopologySpreadConstraint,
+                           WeightedPodAffinityTerm)
 from ..cloudprovider.types import (InstanceType, InstanceTypeOverhead, Offering,
                                    Offerings)
 from ..scheduling.requirement import Requirement
@@ -189,6 +190,9 @@ def pod_to_dict(p: Pod) -> dict:
                    for c in p.spec.topology_spread_constraints],
         "host_ports": [{"port": hp.port, "protocol": hp.protocol,
                         "host_ip": hp.host_ip} for hp in p.spec.host_ports],
+        "volumes": [{"claim_name": v.claim_name, "ephemeral": v.ephemeral,
+                     "storage_class_name": v.storage_class_name}
+                    for v in p.spec.volumes],
         "priority": p.spec.priority,
         "node_name": p.spec.node_name,
         "requests": [dict(r) for r in p.container_requests],
@@ -222,6 +226,7 @@ def encode_pod_batch(pods) -> dict:
                tuple(tuple(r.items()) for r in p.init_container_requests),
                tuple((hp.port, hp.protocol, hp.host_ip)
                      for hp in spec.host_ports),
+               tuple(spec.volumes),  # PVCRef is frozen/hashable
                p.metadata.namespace, spec.priority, p.is_daemonset_pod,
                tuple(p.metadata.annotations.items()))
         i = tmpl_idx.get(key)
@@ -258,6 +263,7 @@ def decode_pod_batch(d: dict) -> "List[Pod]":
                 topology_spread_constraints=
                     pr.spec.topology_spread_constraints,
                 host_ports=pr.spec.host_ports,
+                volumes=pr.spec.volumes,
                 priority=pr.spec.priority,
                 node_name=node_name),
             container_requests=pr.container_requests,
@@ -286,6 +292,10 @@ def pod_from_dict(d: dict) -> Pod:
             host_ports=[HostPort(port=hp["port"], protocol=hp["protocol"],
                                  host_ip=hp["host_ip"])
                         for hp in d["host_ports"]],
+            volumes=[PVCRef(claim_name=v["claim_name"],
+                            ephemeral=v.get("ephemeral", False),
+                            storage_class_name=v.get("storage_class_name", ""))
+                     for v in d.get("volumes", [])],
             priority=d["priority"],
             node_name=d.get("node_name", "")),
         container_requests=[dict(r) for r in d["requests"]],

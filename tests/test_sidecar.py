@@ -70,6 +70,17 @@ class TestCodec:
                   for i in range(2)]
         wire2 = codec.encode_pod_batch(ported)
         assert len(wire2["templates"]) == 2
+        # volumes survive the batch path and key the templates: dropping
+        # them server-side would bypass CSI attach-limit tracking entirely
+        from karpenter_tpu.api.objects import PVCRef
+        vol = make_pod(cpu="100m")
+        vol.spec.volumes.append(PVCRef(claim_name="data"))
+        plain = make_pod(cpu="100m")
+        wire3 = codec.encode_pod_batch([vol, plain])
+        assert len(wire3["templates"]) == 2
+        back3 = codec.decode_pod_batch(wire3)
+        assert back3[0].spec.volumes[0].claim_name == "data"
+        assert not back3[1].spec.volumes
 
     def test_instance_type_round_trip(self):
         it = construct_instance_types()[0]

@@ -100,6 +100,121 @@ class TestVolumeTopology:
         assert env.store.list(Node) == []
 
 
+class TestVolumeScenarios:
+    """suite_test.go:2726-3282 (VolumeUsage context)."""
+
+    def test_shared_pvc_pods_share_a_node(self, env):
+        """suite_test.go:2777-2830: many pods over ONE PVC count a single
+        attachment — no spurious node fan-out."""
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        env.store.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="shared", namespace="default"),
+            spec=PVCSpec(storage_class_name="sc")))
+        env.store.create(make_nodepool(name="default"))
+        for i in range(4):
+            env.store.create(make_volume_pod("shared", cpu="100m",
+                                             name=f"sharer-{i}"))
+        settle(env)
+        assert len(env.store.list(Node)) == 1
+        for p in env.store.list(Pod):
+            assert p.spec.node_name
+
+    def test_nfs_volumes_unconstrained(self, env):
+        """suite_test.go:2831-2868: non-CSI volumes have no attach limit
+        and never block scheduling."""
+        env.store.create(PersistentVolume(
+            metadata=ObjectMeta(name="nfs-pv", namespace=""),
+            spec=PersistentVolumeSpec()))  # no CSI source
+        env.store.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="nfs-pvc", namespace="default"),
+            spec=PVCSpec(volume_name="nfs-pv")))
+        env.store.create(make_nodepool(name="default"))
+        for i in range(3):
+            env.store.create(make_volume_pod("nfs-pvc", cpu="100m",
+                                             name=f"nfs-{i}"))
+        settle(env)
+        assert len(env.store.list(Node)) == 1
+
+    def test_ephemeral_volume_with_named_storage_class(self, env):
+        """suite_test.go:2869-2980: the ephemeral template's class drives
+        topology before the claim exists."""
+        zone = KWOK_ZONES[3]
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="eph-sc", namespace=""),
+            provisioner="ebs.csi",
+            allowed_topologies=[TopologySelector(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, values=[zone])]))
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="100m")
+        pod.spec.volumes.append(PVCRef(claim_name="scratch", ephemeral=True,
+                                       storage_class_name="eph-sc"))
+        env.store.create(pod)
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE] == zone
+
+    def test_ephemeral_volume_missing_class_unschedulable(self, env):
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="100m")
+        pod.spec.volumes.append(PVCRef(claim_name="scratch", ephemeral=True,
+                                       storage_class_name="no-such-sc"))
+        env.store.create(pod)
+        settle(env)
+        assert env.store.list(Node) == []
+
+    def test_ephemeral_volume_default_storage_class(self, env):
+        """suite_test.go:2981-3075: no class named anywhere -> the default-
+        annotated StorageClass resolves."""
+        from karpenter_tpu.api.storage import DEFAULT_SC_ANNOTATION
+        zone = KWOK_ZONES[0]
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="cluster-default", namespace="",
+                                annotations={DEFAULT_SC_ANNOTATION: "true"}),
+            provisioner="ebs.csi",
+            allowed_topologies=[TopologySelector(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, values=[zone])]))
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="100m")
+        pod.spec.volumes.append(PVCRef(claim_name="scratch", ephemeral=True))
+        env.store.create(pod)
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE] == zone
+
+    def test_newest_default_storage_class_wins(self, env):
+        """suite_test.go:3076-3180: multiple default-annotated classes —
+        the newest one resolves."""
+        from karpenter_tpu.api.storage import DEFAULT_SC_ANNOTATION
+        old_zone, new_zone = KWOK_ZONES[1], KWOK_ZONES[2]
+        old = StorageClass(
+            metadata=ObjectMeta(name="old-default", namespace="",
+                                annotations={DEFAULT_SC_ANNOTATION: "true"}),
+            provisioner="ebs.csi",
+            allowed_topologies=[TopologySelector(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, values=[old_zone])])
+        env.store.create(old)
+        env.clock.step(10)
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="new-default", namespace="",
+                                annotations={DEFAULT_SC_ANNOTATION: "true"}),
+            provisioner="ebs.csi",
+            allowed_topologies=[TopologySelector(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, values=[new_zone])]))
+        env.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="100m")
+        pod.spec.volumes.append(PVCRef(claim_name="scratch", ephemeral=True))
+        env.store.create(pod)
+        settle(env)
+        nodes = env.store.list(Node)
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE] == \
+            new_zone
+
+
 class TestAttachLimits:
     def test_csi_attach_limit_forces_second_node(self, env):
         env.store.create(StorageClass(
