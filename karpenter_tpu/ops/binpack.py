@@ -123,6 +123,14 @@ class PackTensors:
     exist_cap: np.ndarray      # int32 [G, N]
 
 
+def zone_pack_layout(Z: int):
+    """(storage dtype, word count) for the packed zone bitfield — the ONE
+    place this is decided: the kernel packs with it and _output_layout
+    decodes with it, so they can never drift apart."""
+    dtype = np.uint8 if Z <= 8 else (np.uint16 if Z <= 16 else np.uint32)
+    return dtype, -(-Z // np.iinfo(dtype).bits)
+
+
 def precompute_kernel(group, template, it, group_req, daemon, alloc,
                       template_its, off_zone, off_captype, off_available,
                       zone_values, allow_undefined, tol_template,
@@ -171,9 +179,9 @@ def precompute_kernel(group, template, it, group_req, daemon, alloc,
     # pack the zone axis into a bitfield: Wz fetched words instead of Z+1
     # bool planes (it_ok_any == any bit set, derived host-side). Multi-word
     # so Z > 32 packs losslessly.
-    pack_dtype = jnp.uint8 if Z <= 8 else (jnp.uint16 if Z <= 16 else jnp.uint32)
+    np_dtype, Wz = zone_pack_layout(Z)
+    pack_dtype = jnp.dtype(np_dtype)
     word_bits = jnp.iinfo(pack_dtype).bits
-    Wz = -(-Z // word_bits)
     z_pad = Wz * word_bits - Z
     padded_ok = jnp.pad(it_ok_z, ((0, 0), (0, 0), (0, 0), (0, z_pad)))
     weights = (jnp.ones((), pack_dtype)
@@ -201,8 +209,44 @@ def precompute_kernel(group, template, it, group_req, daemon, alloc,
     return (compat_tm, it_okz_packed, ppn16, zone_adm_gmz, exist_ok, exist_cap)
 
 
-_precompute_device = partial(jax.jit, static_argnames=(
-    "zone_key", "captype_key", "has_exist"))(precompute_kernel)
+def _pack_outputs(outs):
+    """Flatten the kernel's six outputs into ONE uint8 buffer on device:
+    jax.device_get pays a host<->device round trip per array, and through a
+    network tunnel (axon) that latency — not bandwidth — dominates the
+    fetch. Multi-byte dtypes are bitcast to uint8 lanes; booleans widen."""
+    import jax.lax as lax
+    parts = []
+    for o in outs:
+        if o.dtype == jnp.uint8:
+            parts.append(o.reshape(-1))
+        elif o.dtype == jnp.bool_:
+            parts.append(o.astype(jnp.uint8).reshape(-1))
+        else:
+            parts.append(
+                lax.bitcast_convert_type(o.reshape(-1), jnp.uint8).reshape(-1))
+    return jnp.concatenate(parts)
+
+
+def _precompute_packed_kernel(*args, **statics):
+    return _pack_outputs(precompute_kernel(*args, **statics))
+
+
+_precompute_packed = partial(jax.jit, static_argnames=(
+    "zone_key", "captype_key", "has_exist"))(_precompute_packed_kernel)
+
+
+def _split_packed(flat: np.ndarray, shapes_dtypes):
+    """Host-side inverse of _pack_outputs."""
+    out = []
+    off = 0
+    for shape, dtype, logical in shapes_dtypes:
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        chunk = flat[off:off + n].view(dtype).reshape(shape)
+        off += n
+        out.append(chunk.astype(bool) if logical == "bool" else chunk)
+    assert off == flat.size, \
+        f"packed output layout desync: consumed {off} of {flat.size} bytes"
+    return out
 
 
 def _offering_value_ok(mask_b, key: int, off_val):
@@ -257,14 +301,33 @@ def device_args(p: PackProblem):
     return args, statics
 
 
+def _output_layout(p: PackProblem, has_exist: bool):
+    """(shape, storage-dtype, logical) per kernel output, matching
+    precompute_kernel's return order."""
+    G = p.group_req.shape[0]
+    M = p.daemon_overhead.shape[0]
+    T = p.it_alloc.shape[0]
+    Z = p.zone_values.shape[0]
+    N = p.exist_avail.shape[0] if has_exist else 1
+    pack_dtype, Wz = zone_pack_layout(Z)
+    return [
+        ((M, G), np.uint8, "bool"),            # compat_tm
+        ((G, M, T, Wz), pack_dtype, "raw"),    # it_okz_packed
+        ((G, M, T), np.int16, "raw"),          # ppn
+        ((G, M, Z), np.uint8, "bool"),         # zone_adm
+        ((G, N), np.uint8, "bool"),            # exist_ok
+        ((G, N), np.int32, "raw"),             # exist_cap
+    ]
+
+
 def precompute(p: PackProblem) -> PackTensors:
     args, statics = device_args(p)
-    out = _precompute_device(*args, **statics)
-    # one bulk fetch: per-array np.asarray pays a host<->device round trip
-    # per tensor, which dominates when the device sits behind a network
-    # tunnel (axon)
+    # single packed fetch: per-array device_get pays a host<->device round
+    # trip per tensor, and through a network tunnel (axon) the LATENCY of
+    # those trips — not the bytes — dominates the fetch
+    flat = np.asarray(_precompute_packed(*args, **statics))
     compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
-        jax.device_get(out)
+        _split_packed(flat, _output_layout(p, statics["has_exist"]))
     return unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,
                           exist_ok, exist_cap, p.zone_values.shape[0])
 

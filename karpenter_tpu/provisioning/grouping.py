@@ -250,14 +250,19 @@ def partition_pods(pods: List[Pod]):
               else tuple(tok(r, items_key) for r in reqs))
         spread = spec.topology_spread_constraints
         sig = (
-            tok(spec.node_selector, items_key),
+            # node_selector dicts are stamped fresh per pod, so the id-memo
+            # never hits; the common empty case skips the content hash
+            -1 if not spec.node_selector else tok(spec.node_selector, items_key),
             -1 if aff is None else tok(aff, lambda a, p=pod: _affinity_key(p)),
             tok(spread[0], ident) if len(spread) == 1
             else tuple(tok(c, ident) for c in spread),
-            tuple(tok(t, ident) for t in spec.tolerations),
+            # empty collections are the common case: skip the generator
+            () if not spec.tolerations
+            else tuple(tok(t, ident) for t in spec.tolerations),
             lt,
             rt,
-            tuple(tok(r, items_key) for r in pod.init_container_requests),
+            () if not pod.init_container_requests
+            else tuple(tok(r, items_key) for r in pod.init_container_requests),
             (not spec.host_ports, not spec.volumes),
         )
         g = groups.get(sig)

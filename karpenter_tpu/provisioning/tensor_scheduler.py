@@ -123,7 +123,7 @@ class TensorNodeClaim:
 
     def to_nodeclaim(self) -> APINodeClaim:
         t = self.template
-        reqs = Requirements(self.requirements.values())
+        reqs = self.requirements.copy()
         instance_types = self.instance_type_options[:MAX_INSTANCE_TYPES]
         mv = reqs.get(api_labels.LABEL_INSTANCE_TYPE).min_values
         reqs.add(Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
@@ -713,7 +713,7 @@ class TensorScheduler:
                            for t in self._cohort_price_order(problem, cohort,
                                                              it_names)]
                 order_cache[okey] = ordered
-            base_reqs = Requirements(templates[cohort.m].requirements.values())
+            base_reqs = templates[cohort.m].requirements.copy()
             for g in cohort.pods_by_group:
                 base_reqs.add(*groups[g].requirements.values())
             if cohort.zone is not None:
@@ -727,7 +727,7 @@ class TensorScheduler:
                 for rname, v in groups[g].requests.items():
                     requests[rname] = requests.get(rname, 0) + v * fill
             for _ in range(cohort.n):
-                reqs = Requirements(base_reqs.values())
+                reqs = base_reqs.copy()
                 pods: List[Pod] = []
                 for g, fill in cohort.pods_by_group.items():
                     pods.extend(take(g, fill))
