@@ -42,6 +42,7 @@ CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical floor
 
 EVICTION_BASE_DELAY = 0.1   # terminator/eviction.go:49
 EVICTION_MAX_DELAY = 10.0   # terminator/eviction.go:50
+DEFAULT_POD_GRACE_SECONDS = 30.0  # core/v1 terminationGracePeriodSeconds default
 
 log = get_logger("node.termination")
 
@@ -126,6 +127,20 @@ class NodeTermination(Controller):
         now = self.clock.now()
         term_time = self._termination_time(node)
         expired = term_time is not None and now >= term_time
+
+        # kubelet-sim: a pod already terminating finishes dying once its own
+        # grace period elapses (nothing else removes it in the standalone
+        # runtime; with a real kubelet this is its SIGKILL)
+        for p in self._pods_on(node):
+            if p.metadata.deletion_timestamp is not None:
+                grace = p.spec.termination_grace_period_seconds
+                grace = DEFAULT_POD_GRACE_SECONDS if grace is None else grace
+                # the node's terminationGracePeriod is a HARD deadline: past
+                # it, even a long pod grace is cut short (terminator.go
+                # :140-177 force-deletes everything after expiry)
+                if expired or now >= p.metadata.deletion_timestamp + grace:
+                    self.store.delete(p)
+
         pods = [p for p in self._pods_on(node) if pod_utils.is_evictable(p)]
 
         # TGP preemptive deletes: pods whose own grace period no longer fits
@@ -171,7 +186,18 @@ class NodeTermination(Controller):
                 limits.record_eviction(p)
             # one priority group per pass (terminator.go:119-138)
             break
-        return len([p for p in self._pods_on(node) if pod_utils.is_evictable(p)])
+        # the node is drained only when nothing is still WAITING on it:
+        # evictable pods AND already-terminating (non-daemonset) pods that
+        # haven't finished dying (IsWaitingEviction — the reference keeps
+        # the node alive while a terminating StatefulSet pod lingers, which
+        # is exactly the window the provisioner uses to model its
+        # replacement capacity)
+        return len([p for p in self._pods_on(node)
+                    if pod_utils.is_evictable(p)
+                    or (p.metadata.deletion_timestamp is not None
+                        and not pod_utils.is_terminal(p)
+                        and not pod_utils.is_owned_by_daemonset(p)
+                        and not pod_utils.is_owned_by_node(p))])
 
     def _attached_volumes(self, node: Node) -> List[str]:
         """VolumeAttachments that must detach before instance delete

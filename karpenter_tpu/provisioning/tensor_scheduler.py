@@ -69,10 +69,14 @@ class _CatalogEncoding:
     device_cache: dict
 
 
+import threading
 from collections import OrderedDict
 
 _CATALOG_CACHE: "OrderedDict[tuple, _CatalogEncoding]" = OrderedDict()
 _CATALOG_CACHE_MAX = 4
+# the sidecar serves concurrent solves from a thread pool; the cache (and
+# its LRU reordering) is the only cross-request mutable state on this path
+_CATALOG_CACHE_LOCK = threading.Lock()
 
 
 def _reqs_digest(reqs) -> tuple:
@@ -310,21 +314,29 @@ class TensorScheduler:
         G = len(groups)
 
         ckey = _catalog_cache_key(catalog)
-        ce = _CATALOG_CACHE.get(ckey)
+        with _CATALOG_CACHE_LOCK:
+            ce = _CATALOG_CACHE.get(ckey)
         if ce is not None and not self._fits_vocab(ce.vocab, templates, groups):
             ce = None
         if ce is None:
             ce = self._encode_catalog(catalog, templates, groups)
-            if ckey not in _CATALOG_CACHE and \
-                    len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
-                # LRU: catalogs alternate under multi-provider or prefix
-                # probing — evicting the least-recently-USED entry keeps the
-                # hot ones device-resident (was: arbitrary pop)
-                _CATALOG_CACHE.popitem(last=False)
-            _CATALOG_CACHE[ckey] = ce
-        # mark most-recently-used on hit AND on (re-)encode: a vocab-overflow
-        # re-encode overwrites in place, which alone preserves LRU position
-        _CATALOG_CACHE.move_to_end(ckey)
+        with _CATALOG_CACHE_LOCK:
+            existing = _CATALOG_CACHE.get(ckey)
+            if existing is not None and existing is not ce and \
+                    self._fits_vocab(existing.vocab, templates, groups):
+                ce = existing  # a concurrent request encoded it first
+            else:
+                if ckey not in _CATALOG_CACHE and \
+                        len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
+                    # LRU: catalogs alternate under multi-provider or prefix
+                    # probing — evicting the least-recently-USED entry keeps
+                    # the hot ones device-resident (was: arbitrary pop)
+                    _CATALOG_CACHE.popitem(last=False)
+                _CATALOG_CACHE[ckey] = ce
+            # mark most-recently-used on hit AND on (re-)encode: a vocab-
+            # overflow re-encode overwrites in place, which alone preserves
+            # LRU position
+            _CATALOG_CACHE.move_to_end(ckey)
         vocab = ce.vocab
         zone_key, captype_key = ce.zone_key, ce.captype_key
         it_enc, it_alloc, it_capacity = ce.it_enc, ce.it_alloc, ce.it_capacity

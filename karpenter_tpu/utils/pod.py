@@ -40,8 +40,15 @@ def is_provisionable(pod: Pod) -> bool:
 
 
 def is_reschedulable(pod: Pod) -> bool:
-    """Pods that must be re-placed when their node is disrupted."""
-    return is_active(pod) and not pod.is_daemonset_pod and not is_owned_by_node(pod)
+    """Pods that must be re-placed when their node is disrupted
+    (pod.go IsReschedulable). Terminating STATEFULSET pods still count:
+    their sticky identity means the replacement pod can't be created until
+    the old one dies, so capacity must be modeled for it now — higher
+    availability than waiting for the recreate."""
+    return ((is_active(pod)
+             or (is_terminating(pod) and is_owned_by_statefulset(pod)))
+            and not is_owned_by_daemonset(pod)
+            and not is_owned_by_node(pod))
 
 
 def is_evictable(pod: Pod) -> bool:
@@ -62,3 +69,7 @@ def is_owned_by_node(pod: Pod) -> bool:
 def is_owned_by_daemonset(pod: Pod) -> bool:
     return pod.is_daemonset_pod or any(
         ref.kind == "DaemonSet" for ref in pod.metadata.owner_refs)
+
+
+def is_owned_by_statefulset(pod: Pod) -> bool:
+    return any(ref.kind == "StatefulSet" for ref in pod.metadata.owner_refs)

@@ -1,0 +1,111 @@
+"""Concurrency hazards (SURVEY §5 race-detection row): the sidecar serves
+solves from a thread pool, so everything on the solve path that is shared
+across requests — the catalog-encoding LRU, the jit caches — must be
+thread-safe and produce thread-count-independent results."""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.cloudprovider import kwok
+from karpenter_tpu.provisioning.tensor_scheduler import (_CATALOG_CACHE,
+                                                         TensorScheduler)
+
+from factories import make_nodepool, make_pod, make_pods, spread_zone
+
+
+@pytest.fixture(autouse=True)
+def clean_cache():
+    saved = dict(_CATALOG_CACHE)
+    _CATALOG_CACHE.clear()
+    yield
+    _CATALOG_CACHE.clear()
+    _CATALOG_CACHE.update(saved)
+
+
+def one_solve(catalog, n_pods=24):
+    pods = (make_pods(n_pods, cpu="500m")
+            + make_pods(n_pods // 2, cpu="250m", labels={"app": "s"},
+                        spread=[spread_zone(key="app", value="s")]))
+    ts = TensorScheduler([make_nodepool()], {"default": list(catalog)},
+                         force_tensor=True)
+    r = ts.solve(pods)
+    assert ts.fallback_reason == ""
+    return sorted((nc.template.nodepool_name,
+                   tuple(it.name for it in nc.instance_type_options),
+                   len(nc.pods)) for nc in r.new_nodeclaims)
+
+
+class TestConcurrentSolves:
+    def test_parallel_solves_agree_with_serial(self):
+        """16 concurrent solves over 3 alternating catalogs (cache churn
+        across the LRU cap) must produce exactly the serial results and a
+        structurally intact cache."""
+        its = kwok.construct_instance_types()
+        catalogs = [its[i:i + 24] for i in range(3)]
+        serial = [one_solve(c) for c in catalogs]
+
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = one_solve(catalogs[i % 3])
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == 16
+        for i, r in results.items():
+            assert r == serial[i % 3], f"thread {i} diverged"
+        # cache stayed within its bound and entries are coherent
+        from karpenter_tpu.provisioning import tensor_scheduler as ts_mod
+        assert len(_CATALOG_CACHE) <= ts_mod._CATALOG_CACHE_MAX
+        for ce in _CATALOG_CACHE.values():
+            assert ce.vocab is not None
+
+    def test_sidecar_concurrent_requests(self):
+        """End-to-end over gRPC: the server's thread pool handles a burst
+        of identical requests; every response matches."""
+        import grpc
+
+        from karpenter_tpu.sidecar.client import RemoteScheduler
+        from karpenter_tpu.sidecar.server import serve
+
+        its = kwok.construct_instance_types()[:24]
+        server, port = serve(max_workers=4)
+        try:
+            def solve_once():
+                rs = RemoteScheduler(f"127.0.0.1:{port}", [make_nodepool()],
+                                     {"default": its})
+                pods = make_pods(12, cpu="500m")
+                r = rs.solve(pods)
+                rs._channel.close()
+                return (len(r.new_nodeclaims),
+                        sorted(len(nc.pods) for nc in r.new_nodeclaims),
+                        len(r.pod_errors))
+
+            want = solve_once()
+            got, errors = [], []
+
+            def worker():
+                try:
+                    got.append(solve_once())
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert got and all(g == want for g in got)
+        finally:
+            server.stop(0)

@@ -164,3 +164,99 @@ class TestProvisioningE2E:
         settle(env)
         assert len(env.store.list(Node)) == n_nodes
         assert all(p.spec.node_name for p in env.store.list(Pod))
+
+
+class TestDeletingNodeCarryover:
+    """suite_test.go:3443-3645: which pods on a deleting node get modeled
+    as reschedulable while capacity is replaced."""
+
+    def _deleting_node_with(self, env, pod):
+        env.store.create(make_nodepool(name="default"))
+        anchor = make_pod(cpu="500m", name="anchor")
+        env.store.create(anchor)
+        settle(env)
+        node = env.store.list(Node)[0]
+        pod.spec.node_name = node.name
+        pod.status.phase = "Running"
+        env.store.create(pod)
+        settle(env)
+        env.store.delete(node)
+        return node
+
+    def test_terminal_pods_not_rescheduled(self, env):
+        """suite_test.go:3469-3495: Succeeded/Failed pods on a deleting
+        node need no replacement capacity."""
+        done = make_pod(cpu="3500m", name="finished")
+        node = self._deleting_node_with(env, done)
+        done.status.phase = "Succeeded"
+        env.store.update(done)
+        settle(env)
+        assert env.store.get(Node, node.name) is None
+        # only the anchor pod needed a home: one live node, no extra
+        live = env.store.list(Node)
+        assert len(live) == 1
+        assert env.store.get(Pod, "anchor", "default").spec.node_name == \
+            live[0].name
+
+    def test_daemonset_pods_not_rescheduled(self, env):
+        """suite_test.go:3496-3552."""
+        from karpenter_tpu.api.objects import OwnerReference
+        ds = make_pod(cpu="3500m", name="ds-pod")
+        ds.metadata.owner_refs.append(
+            OwnerReference(kind="DaemonSet", name="ds", uid="u1"))
+        node = self._deleting_node_with(env, ds)
+        settle(env)
+        assert env.store.get(Node, node.name) is None
+        live = env.store.list(Node)
+        assert len(live) == 1  # no capacity modeled for the daemonset pod
+
+    def test_terminating_statefulset_pod_is_rescheduled(self, env):
+        """suite_test.go:3597-3645: a TERMINATING StatefulSet pod still
+        reserves replacement capacity — its sticky identity means the
+        recreate can't happen until it dies, so the capacity must already
+        exist for availability."""
+        from karpenter_tpu.api.objects import OwnerReference
+        sts = make_pod(cpu="3500m", name="sts-0")
+        sts.metadata.owner_refs.append(
+            OwnerReference(kind="StatefulSet", name="sts", uid="u2"))
+        node = self._deleting_node_with(env, sts)
+        sts.metadata.deletion_timestamp = env.clock.now()  # terminating
+        env.store.update(sts)
+        settle(env)
+        # the node lingers while the terminating pod is still dying (its
+        # kubelet grace hasn't elapsed) — and during that window the
+        # provisioner has already modeled capacity for BOTH the anchor and
+        # the future sts-0 replacement (3500m forces a big node)
+        assert env.store.get(Node, node.name) is not None
+        total_cpu = sum(n.status.allocatable.get("cpu", 0)
+                        for n in env.store.list(Node)
+                        if n.metadata.deletion_timestamp is None)
+        assert total_cpu >= 4000, total_cpu
+        # once the pod's grace period elapses the kubelet-sim finishes the
+        # kill and the node completes termination
+        env.clock.step(31)
+        settle(env)
+        assert env.store.get(Node, node.name) is None
+        assert env.store.get(Pod, "sts-0", "default") is None
+
+    def test_terminating_replicaset_pod_not_rescheduled(self, env):
+        """suite_test.go:3553-3596: terminating REPLICASET pods get
+        recreated elsewhere immediately; no capacity is modeled."""
+        from karpenter_tpu.api.objects import OwnerReference
+        rs = make_pod(cpu="3500m", name="rs-pod")
+        rs.metadata.owner_refs.append(
+            OwnerReference(kind="ReplicaSet", name="rs", uid="u3"))
+        node = self._deleting_node_with(env, rs)
+        rs.metadata.deletion_timestamp = env.clock.now()
+        env.store.update(rs)
+        settle(env)
+        # only ONE small live replacement node (the anchor's): no capacity
+        # was modeled for the dying ReplicaSet pod even while its node
+        # lingers through the kill grace
+        live = [n for n in env.store.list(Node)
+                if n.metadata.deletion_timestamp is None]
+        assert len(live) == 1
+        assert live[0].status.allocatable.get("cpu", 0) < 3500
+        env.clock.step(31)
+        settle(env)
+        assert env.store.get(Node, node.name) is None
