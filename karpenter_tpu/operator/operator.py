@@ -140,7 +140,9 @@ class Operator:
             self.serving = ServingGroup(
                 self.options.metrics_port, self.options.health_probe_port,
                 healthy=lambda: True,
-                ready=lambda: self.cluster.synced()).start()
+                ready=lambda: self.cluster.synced(),
+                profiling=self.options.enable_profiling,
+                manager=self.manager).start()
             self.log.info("serving metrics and health probes",
                           metrics_port=self.serving.metrics_port,
                           health_port=self.serving.health_port)

@@ -59,16 +59,48 @@ class _Server:
             self._thread.join(timeout=5)
 
 
+def _debug_stacks():
+    """The pprof goroutine-dump analog (operator.go:159-175 gates pprof
+    behind --enable-profiling): every thread's current Python stack, for
+    diagnosing a wedged operator without attaching a debugger."""
+    import sys
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sys._current_frames().items():
+        parts.append(f"Thread {names.get(ident, '?')} ({ident}):\n"
+                     + "".join(traceback.format_stack(frame)))
+    return 200, "text/plain", "\n".join(parts)
+
+
+def _debug_timers_factory(manager):
+    def fn():
+        if manager is None:
+            return 404, "text/plain", "no manager attached"
+        # snapshot first: the manager thread mutates these while we render
+        # (dict copy is atomic under the GIL)
+        pending = dict(manager._timer_pending)
+        lines = [f"pending_timers {len(pending)}",
+                 f"queue_depth {len(manager._queue)}"]
+        for key, fire_at in sorted(pending.items(),
+                                   key=lambda kv: kv[1])[:200]:
+            lines.append(f"{fire_at:.3f} {'/'.join(str(k) for k in key)}")
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
 class ServingGroup:
     """Metrics server + health-probe server (operator.go:142-175). Checks
     default to always-healthy; the operator wires liveness to the manager.
     Port 0 binds an ephemeral port (tests); resolved ports are exposed as
-    metrics_port/health_port."""
+    metrics_port/health_port. With profiling enabled, /debug/stacks (thread
+    dump — the pprof analog) and /debug/timers (manager work-queue state)
+    serve on the metrics port."""
 
     def __init__(self, metrics_port: int, health_probe_port: int,
                  healthy: Callable[[], bool] = lambda: True,
                  ready: Callable[[], bool] = lambda: True,
-                 registry=REGISTRY):
+                 registry=REGISTRY, profiling: bool = False, manager=None):
         def probe(check: Callable[[], bool]):
             def fn():
                 if check():
@@ -76,10 +108,14 @@ class ServingGroup:
                 return 503, "text/plain", "unhealthy"
             return fn
 
-        self._metrics = _Server(metrics_port, {
+        metrics_routes = {
             "/metrics": lambda: (200, "text/plain; version=0.0.4",
                                  registry.expose()),
-        })
+        }
+        if profiling:
+            metrics_routes["/debug/stacks"] = _debug_stacks
+            metrics_routes["/debug/timers"] = _debug_timers_factory(manager)
+        self._metrics = _Server(metrics_port, metrics_routes)
         self._health = _Server(health_probe_port, {
             "/healthz": probe(healthy),
             "/readyz": probe(ready),

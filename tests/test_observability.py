@@ -182,3 +182,38 @@ class TestCloudProviderDecorator:
         assert inner.next_create_err is not None
         assert cp.name == "fake"
         assert cp.created is inner.created
+
+
+class TestDebugEndpoints:
+    def test_debug_stacks_and_timers_gated_by_profiling(self):
+        """pprof analog (operator.go:159-175): /debug/* serves only with
+        --enable-profiling."""
+        import urllib.error
+        import urllib.request
+
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+
+        op = Operator(options=Options(metrics_port=0, health_probe_port=0,
+                                      enable_profiling=True))
+        op.start_serving()
+        try:
+            base = f"http://127.0.0.1:{op.serving.metrics_port}"
+            stacks = urllib.request.urlopen(f"{base}/debug/stacks", timeout=5).read()
+            assert b"Thread" in stacks or b"File" in stacks
+            timers = urllib.request.urlopen(f"{base}/debug/timers", timeout=5).read()
+            assert b"pending_timers" in timers
+        finally:
+            op.stop_serving()
+
+        off = Operator(options=Options(metrics_port=0, health_probe_port=0))
+        off.start_serving()
+        try:
+            base = f"http://127.0.0.1:{off.serving.metrics_port}"
+            try:
+                urllib.request.urlopen(f"{base}/debug/stacks", timeout=5)
+                raise AssertionError("expected 404 without profiling")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            off.stop_serving()
