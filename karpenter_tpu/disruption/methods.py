@@ -290,8 +290,17 @@ class consolidation(Method):
                            consolidation_type=self.consolidation_type), results
         if len(filtered.instance_type_options) < MIN_SPOT_TO_SPOT_INSTANCE_TYPES:
             return Command(reason=self.reason), None
-        filtered.instance_type_options = \
-            filtered.instance_type_options[:MIN_SPOT_TO_SPOT_INSTANCE_TYPES]
+        # cap the launch list so the launched type is always inside it (no
+        # continual-consolidation ping-pong); with minValues the cap is the
+        # MAX of the default 15 and the prefix needed to satisfy minValues
+        # (consolidation.go:281-296)
+        cap = MIN_SPOT_TO_SPOT_INSTANCE_TYPES
+        if filtered.requirements.has_min_values():
+            from ..cloudprovider.types import satisfies_min_values
+            needed, _ = satisfies_min_values(filtered.instance_type_options,
+                                             filtered.requirements)
+            cap = max(cap, needed)
+        filtered.instance_type_options = filtered.instance_type_options[:cap]
         return Command(candidates=list(candidates), replacements=[filtered],
                        reason=self.reason,
                        consolidation_type=self.consolidation_type), results

@@ -270,3 +270,80 @@ class TestEmptyProbeGroup:
                      topo=[TopoSpec(SPREAD_ZONE)])
         izc = ts.cluster_zone_counts([g], ["z1", "z2"], set())
         assert izc.shape == (1, 2) and not izc.any()
+
+
+class TestSpotToSpotTruncation:
+    """consolidation.go:229-302 + consolidation_test.go:932-1486: the
+    spot-to-spot gate, the >= 15-cheaper-types floor, and the launch-list
+    cap — max(15, minValues prefix) with minValues, flat 15 without."""
+
+    def _method(self, enabled=True):
+        from karpenter_tpu.disruption.methods import SingleNodeConsolidation
+        from karpenter_tpu.utils.clock import FakeClock
+        m = SingleNodeConsolidation.__new__(SingleNodeConsolidation)
+        m.spot_to_spot_enabled = enabled
+        m.clock = FakeClock()
+        return m
+
+    def _results(self, n_types, min_values=None):
+        from karpenter_tpu.api import labels as api_labels
+        from karpenter_tpu.cloudprovider.kwok import construct_catalog
+        from karpenter_tpu.cloudprovider.types import (order_by_price,
+                                                       satisfies_min_values)
+        from karpenter_tpu.scheduling.requirement import IN, Requirement
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        catalog = construct_catalog(max(n_types, 40))
+        reqs = Requirements()
+        if min_values is not None:
+            reqs.add(Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
+                                 [it.name for it in catalog],
+                                 min_values=min_values))
+        its = order_by_price(catalog, reqs)[:n_types]
+
+        class StubClaim:
+            def __init__(self):
+                self.requirements = reqs
+                self.instance_type_options = list(its)
+
+            def remove_instance_types_by_price_and_min_values(
+                    self, requirements, max_price):
+                self.instance_type_options = [
+                    it for it in self.instance_type_options
+                    if it.offerings.available().worst_launch_price(
+                        requirements) < max_price]
+                _, err = satisfies_min_values(self.instance_type_options,
+                                              requirements)
+                return (None, err) if err else (self, None)
+
+        class StubResults:
+            new_nodeclaims = [StubClaim()]
+
+        return StubResults()
+
+    def test_disabled_gate_blocks(self):
+        cmd, _ = self._method(enabled=False)._spot_to_spot(
+            ["c"], self._results(30), 1e9)
+        assert cmd.is_empty()
+
+    def test_fewer_than_15_cheaper_blocks(self):
+        cmd, _ = self._method()._spot_to_spot(["c"], self._results(10), 1e9)
+        assert cmd.is_empty()
+
+    def test_default_caps_at_15(self):
+        r = self._results(30)
+        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        assert not cmd.is_empty()
+        assert len(cmd.replacements[0].instance_type_options) == 15
+
+    def test_min_values_above_15_raises_cap(self):
+        r = self._results(30, min_values=20)
+        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        assert not cmd.is_empty()
+        assert len(cmd.replacements[0].instance_type_options) == 20
+
+    def test_min_values_below_15_keeps_default(self):
+        r = self._results(30, min_values=5)
+        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        assert not cmd.is_empty()
+        assert len(cmd.replacements[0].instance_type_options) == 15
