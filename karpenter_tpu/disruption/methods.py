@@ -247,6 +247,13 @@ class consolidation(Method):
             candidate_price += p
 
         replacement = results.new_nodeclaims[0]
+        # sort by price FIRST (consolidation.go:183): the ≥15-cheaper gate,
+        # the minValues prefix, and the launch-list slice are all prefix
+        # operations over a price-ordered list — host-path claims carry
+        # catalog-ordered options (the tensor path happens to pre-sort)
+        from ..cloudprovider.types import order_by_price
+        replacement.instance_type_options = order_by_price(
+            replacement.instance_type_options, replacement.requirements)
         all_spot = all(c.capacity_type == api_labels.CAPACITY_TYPE_SPOT
                        for c in candidates)
         ct_req = replacement.requirements.get(api_labels.CAPACITY_TYPE_LABEL_KEY)

@@ -299,7 +299,10 @@ class TestSpotToSpotTruncation:
             reqs.add(Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
                                  [it.name for it in catalog],
                                  min_values=min_values))
-        its = order_by_price(catalog, reqs)[:n_types]
+        # catalog order, NOT price order: the production path hands the
+        # decision catalog-ordered host-claim options; decide()'s
+        # order_by_price (consolidation.go:183) must do the sorting
+        its = list(catalog)[:n_types]
 
         class StubClaim:
             def __init__(self):
@@ -321,29 +324,50 @@ class TestSpotToSpotTruncation:
 
         return StubResults()
 
+    def _decide(self, method, results, n_candidates=1):
+        """Enter through decide() — the real path, where the price sort
+        lives (consolidation.go:183)."""
+        from karpenter_tpu.api import labels as api_labels
+
+        class StubCandidate:
+            capacity_type = api_labels.CAPACITY_TYPE_SPOT
+
+            def price(self):
+                return 1e9
+
+        return method.decide([StubCandidate()] * n_candidates, results, None)
+
+    @staticmethod
+    def _prices(claim):
+        return [it.offerings.available().cheapest().price
+                for it in claim.instance_type_options]
+
     def test_disabled_gate_blocks(self):
-        cmd, _ = self._method(enabled=False)._spot_to_spot(
-            ["c"], self._results(30), 1e9)
+        cmd, _ = self._decide(self._method(enabled=False), self._results(30))
         assert cmd.is_empty()
 
     def test_fewer_than_15_cheaper_blocks(self):
-        cmd, _ = self._method()._spot_to_spot(["c"], self._results(10), 1e9)
+        cmd, _ = self._decide(self._method(), self._results(10))
         assert cmd.is_empty()
 
-    def test_default_caps_at_15(self):
+    def test_default_caps_at_15_cheapest(self):
         r = self._results(30)
-        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        cmd, _ = self._decide(self._method(), r)
         assert not cmd.is_empty()
-        assert len(cmd.replacements[0].instance_type_options) == 15
+        prices = self._prices(cmd.replacements[0])
+        assert len(prices) == 15
+        assert prices == sorted(prices)  # the CHEAPEST 15, price-ordered
 
     def test_min_values_above_15_raises_cap(self):
         r = self._results(30, min_values=20)
-        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        cmd, _ = self._decide(self._method(), r)
         assert not cmd.is_empty()
-        assert len(cmd.replacements[0].instance_type_options) == 20
+        prices = self._prices(cmd.replacements[0])
+        assert len(prices) == 20
+        assert prices == sorted(prices)
 
     def test_min_values_below_15_keeps_default(self):
         r = self._results(30, min_values=5)
-        cmd, _ = self._method()._spot_to_spot(["c"], r, 1e9)
+        cmd, _ = self._decide(self._method(), r)
         assert not cmd.is_empty()
         assert len(cmd.replacements[0].instance_type_options) == 15
