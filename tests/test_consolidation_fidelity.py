@@ -299,10 +299,12 @@ class TestSpotToSpotTruncation:
             reqs.add(Requirement(api_labels.LABEL_INSTANCE_TYPE, IN,
                                  [it.name for it in catalog],
                                  min_values=min_values))
-        # catalog order, NOT price order: the production path hands the
-        # decision catalog-ordered host-claim options; decide()'s
-        # order_by_price (consolidation.go:183) must do the sorting
-        its = list(catalog)[:n_types]
+        # deliberately REVERSED price order: the production path hands the
+        # decision unordered host-claim options; decide()'s order_by_price
+        # (consolidation.go:183) must do the sorting, and these assertions
+        # must fail if it ever stops (the kwok catalog happens to be
+        # price-ascending, so plain catalog order would be vacuous)
+        its = list(reversed(catalog))[:n_types]
 
         class StubClaim:
             def __init__(self):

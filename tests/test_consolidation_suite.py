@@ -401,3 +401,48 @@ class TestMultiNodeClaim:
             assert not env.disruption.last_command.replacements
         for p in env.store.list(Pod):
             assert p.spec.node_name
+
+
+class TestReasonScopedBudgets:
+    """nodepool.go:305-318 + Budget schedule windows (:353-367)."""
+
+    def _pool(self, *budgets):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = list(budgets)
+        return pool
+
+    def test_reason_scoped_budget_only_binds_its_reason(self):
+        pool = self._pool(Budget(nodes="0", reasons=["Underutilized"]))
+        now = 1_000_000.0
+        assert pool.allowed_disruptions(now, 10, "Underutilized") == 0
+        assert pool.allowed_disruptions(now, 10, "Empty") > 10
+        assert pool.allowed_disruptions(now, 10, "Drifted") > 10
+
+    def test_min_across_matching_budgets(self):
+        pool = self._pool(Budget(nodes="50%"),
+                          Budget(nodes="2", reasons=["Empty"]))
+        now = 1_000_000.0
+        assert pool.allowed_disruptions(now, 10, "Empty") == 2
+        assert pool.allowed_disruptions(now, 10, "Underutilized") == 5
+
+    def test_schedule_window_activates_budget(self):
+        from datetime import datetime, timezone
+        pool = self._pool(Budget(nodes="0", schedule="0 9 * * *",
+                                 duration=2 * 3600.0))
+        inside = datetime(2026, 7, 1, 9, 30,
+                          tzinfo=timezone.utc).timestamp()
+        outside = datetime(2026, 7, 1, 13, 0,
+                           tzinfo=timezone.utc).timestamp()
+        assert pool.allowed_disruptions(inside, 10, "Empty") == 0
+        assert pool.allowed_disruptions(outside, 10, "Empty") > 10
+
+    def test_underutilized_scoped_zero_budget_lets_emptiness_run(self, env):
+        """e2e: a zero budget scoped to Underutilized must not block
+        EMPTINESS deletion."""
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", reasons=["Underutilized"])]
+        env.store.create(pool)
+        make_empty_nodes(env, 2)
+        disrupt(env)
+        assert env.store.list(Node) == []
