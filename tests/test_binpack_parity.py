@@ -209,6 +209,38 @@ class TestInstanceTypePruning:
         assert len(t.pod_errors) == len(h.pod_errors)
 
 
+class TestDeterminism:
+    def test_identical_batches_solve_identically(self):
+        """Two solves of the same batch (fresh scheduler each) must make
+        byte-identical decisions — the disruption validator depends on
+        re-simulation stability (validation.go:83-215), and tie-breaks are
+        deterministic by design (domain-name order, price-name lexsort)."""
+        its = kwok.construct_instance_types()
+
+        def batch():
+            return (make_pods(40, cpu="500m", memory="512Mi")
+                    + make_pods(12, labels={"app": "s"},
+                                spread=[spread_zone(key="app", value="s")])
+                    + make_pods(8, labels={"app": "a"},
+                                pod_anti_affinity=[
+                                    affinity_term(api_labels.LABEL_HOSTNAME,
+                                                  value="a")]))
+
+        def key(results):
+            return sorted(
+                (nc.template.nodepool_name,
+                 tuple(sorted(nc.requirements.get(
+                     api_labels.LABEL_TOPOLOGY_ZONE).values)),
+                 tuple(it.name for it in nc.instance_type_options),
+                 len(nc.pods))
+                for nc in results.new_nodeclaims)
+
+        r1 = tensor_solve([make_nodepool()], its, batch())
+        r2 = tensor_solve([make_nodepool()], its, batch())
+        assert key(r1) == key(r2)
+        assert len(r1.pod_errors) == len(r2.pod_errors)
+
+
 class TestFallback:
     def test_unsupported_topology_falls_back(self):
         # region-key spread isn't kernel-supported -> host path
