@@ -335,3 +335,16 @@ class TestExistingNodes:
         for en in h.existing_nodes:
             zones["test-zone-a"] += len(en.pods)
         assert max(zones.values()) - min(zones.values()) <= 1
+
+
+class TestExistsOperator:
+    def test_exists_requirement_does_not_overwrite_selector_value(self):
+        """suite_test.go:632-644: a pool-level Exists requirement admits any
+        value; the pod's concrete selector value wins on the claim."""
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("team", "Exists", ())])
+        h = hsolve([make_pod(node_selector={"team": "payments"})],
+                   pools=[pool])
+        assert not h.pod_errors
+        assert h.new_nodeclaims[0].requirements.get("team").values_list() \
+            == ["payments"]

@@ -132,22 +132,7 @@ class TestRemoteSolve:
         assert op.store.list(Node)
 
 
-class StaticClusterView:
-    """ClusterView stub: scheduled pods pinned to named nodes with labels."""
-
-    def __init__(self, pods_on_nodes, node_labels):
-        self._pods = list(pods_on_nodes)
-        self._node_labels = dict(node_labels)
-
-    def list_pods(self, namespace, selector):
-        return [p for p in self._pods
-                if p.namespace == namespace and selector.matches(p.labels)]
-
-    def node_labels(self, node_name):
-        return self._node_labels.get(node_name)
-
-    def for_pods_with_anti_affinity(self):
-        return []
+from factories import StaticClusterView  # noqa: E402 — shared stub
 
 
 def _scaleup_fixture():
