@@ -79,11 +79,16 @@ class Manager:
         self._queued: set = set()
         self._timers: list = []  # heap of (fire_at, seq, controller, obj)
         self._timer_seq = itertools.count()
-        # workqueue AddAfter dedup: at most one pending timer per
-        # (controller, object), keeping the EARLIEST fire time — without it,
-        # every event-driven reconcile that returns requeue_after spawns a
-        # new perpetual timer chain and the heap grows with event history
-        self._timer_pending: dict = {}
+        # AddAfter dedup, bounded per (controller, object): one LIVE heap
+        # entry (the earliest fire time) plus at most one DEFERRED later
+        # intent, re-armed when the live timer fires. client-go's delaying
+        # queue keeps a single entry per item and only moves it earlier —
+        # but silently dropping a later requeue loses a controller's
+        # periodic recheck when the earlier reconcile returns no requeue
+        # (ADVICE r3); keeping the soonest later intent preserves it while
+        # still preventing per-event perpetual timer chains
+        self._timer_pending: Dict[tuple, float] = {}
+        self._timer_deferred: Dict[tuple, tuple] = {}  # key -> (fire_at, c, obj)
         store.watch(self._on_event)
 
     # -- registration -------------------------------------------------------
@@ -116,8 +121,22 @@ class Manager:
                obj.metadata.namespace, obj.metadata.name)
         fire_at = self.clock.now() + after
         pending = self._timer_pending.get(key)
-        if pending is not None and pending <= fire_at:
-            return  # an earlier (or equal) timer already covers this
+        if pending is not None:
+            if fire_at >= pending:
+                # keep the LATEST intent to re-arm after the live timer
+                # fires: earlier intermediate intents are subsumed by the
+                # live timer's reconcile (which sees newer state and re-arms
+                # as needed), but the final periodic recheck must survive
+                if fire_at > pending:
+                    deferred = self._timer_deferred.get(key)
+                    if deferred is None or fire_at > deferred[0]:
+                        self._timer_deferred[key] = (fire_at, controller, obj)
+                return
+            # earlier than the live timer: move it up (old entry goes stale);
+            # the displaced time stays pending as the deferred later intent
+            deferred = self._timer_deferred.get(key)
+            if deferred is None or pending > deferred[0]:
+                self._timer_deferred[key] = (pending, controller, obj)
         self._timer_pending[key] = fire_at
         heapq.heappush(self._timers,
                        (fire_at, next(self._timer_seq), controller, obj))
@@ -133,6 +152,12 @@ class Manager:
             if self._timer_pending.get(key) != fire_at:
                 continue  # superseded by an earlier requeue; stale heap entry
             del self._timer_pending[key]
+            deferred = self._timer_deferred.pop(key, None)
+            if deferred is not None:
+                d_at, d_c, d_obj = deferred
+                self._timer_pending[key] = d_at
+                heapq.heappush(self._timers,
+                               (d_at, next(self._timer_seq), d_c, d_obj))
             self._enqueue(c, obj)
 
     def drain(self, max_items: int = 100_000) -> int:

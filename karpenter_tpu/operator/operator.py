@@ -190,26 +190,44 @@ class Operator:
         reconcile pass longer than the lease duration must not let a
         standby steal the lease mid-pass (client-go renews on its own
         goroutine with renewDeadline < leaseDuration for the same reason).
-        Sets _lease_lost when a renewal fails."""
+        Sets _lease_lost when a renewal fails; _renew_deadline_passed()
+        additionally covers a wedged renewal thread — client-go aborts
+        leadership when RenewDeadline elapses without a successful renew,
+        even if no renew attempt ever returned."""
         import threading
-        self._lease_lost = threading.Event()
-        self._renew_stop = threading.Event()
+        lost = self._lease_lost = threading.Event()
+        stop = self._renew_stop = threading.Event()
+        # 2/3 of the lease, mirroring client-go's 15 s lease / 10 s renew
+        # deadline ratio: leadership is surrendered BEFORE the lease can
+        # legitimately be stolen by a standby
+        self._renew_deadline = lease.lease_duration * (2.0 / 3.0)
+        self._last_renew = lease.clock.now()
 
         def loop():
+            # the closure captures ITS OWN events: a thread that wedged past
+            # its deadline and later unwedges must not renew against (or
+            # flip the lost flag of) a successor generation's events
             period = max(0.2, lease.lease_duration / 3.0)
-            while not self._renew_stop.wait(period):
+            while not stop.wait(period):
                 try:
                     if not lease.renew():
-                        self._lease_lost.set()
+                        lost.set()
                         return
                 except Exception:
-                    self._lease_lost.set()
+                    lost.set()
                     return
+                if stop.is_set() or self._renew_stop is not stop:
+                    return  # stood down while this renew was in flight
+                self._last_renew = lease.clock.now()
 
         t = threading.Thread(target=loop, daemon=True,
                              name="karpenter-lease-renewal")
+        self._renew_thread = t
         t.start()
         return t
+
+    def _renew_deadline_passed(self, lease) -> bool:
+        return (lease.clock.now() - self._last_renew) > self._renew_deadline
 
     def _stop_renewal(self) -> None:
         ev = getattr(self, "_renew_stop", None)
@@ -231,12 +249,22 @@ class Operator:
         try:
             while stop is None or not stop():
                 if lease is not None:
-                    if leading and self._lease_lost.is_set():
+                    if leading and (self._lease_lost.is_set()
+                                    or self._renew_deadline_passed(lease)):
                         self.log.error("lost leadership lease; standing by",
                                        lease=lease.path)
                         self._stop_renewal()
                         leading = False
-                    if not leading and lease.try_acquire():
+                    # after a stand-down, do not re-acquire while the old
+                    # renewal thread is still alive (wedged in renew()):
+                    # try_acquire would re-renew our own still-valid lease
+                    # and flip-flop leadership with an untrustworthy renewal
+                    # mechanism. If the thread never exits, the lease expires
+                    # naturally and a healthy standby takes over.
+                    prev = getattr(self, "_renew_thread", None)
+                    if not leading and \
+                            (prev is None or not prev.is_alive()) and \
+                            lease.try_acquire():
                         self.log.info("acquired leadership",
                                       lease=lease.path,
                                       identity=lease.identity)

@@ -511,12 +511,14 @@ class Packer:
         cohort_enc = self._node_enc(g, m, zone)
         if limits is None:
             full_nodes, rem = divmod(n_pods, per_node)
-            if full_nodes:
-                self._append_cohort(g, m, zone, it_set, per_node, cohort_enc,
-                                    n=full_nodes)
-            if rem:
-                self._append_cohort(g, m, zone, it_set, rem, cohort_enc, n=1)
-            return n_pods
+            placed = 0
+            if full_nodes and self._append_cohort(g, m, zone, it_set, per_node,
+                                                  cohort_enc, n=full_nodes):
+                placed += full_nodes * per_node
+            if rem and self._append_cohort(g, m, zone, it_set, rem,
+                                           cohort_enc, n=1):
+                placed += rem
+            return placed
         placed = 0
         while placed < n_pods:
             it_fit = it_set & self._under_limits(m, it_set)
@@ -529,8 +531,13 @@ class Packer:
             if per_fit <= 0:
                 break
             fill = min(per_fit, n_pods - placed)
+            # append BEFORE consuming limits: a fill-sizing failure must not
+            # leak a phantom node's worth of limit capacity (subtractMax
+            # models only nodes that actually open, scheduler.go:388-405)
+            if not self._append_cohort(g, m, zone, it_fit, fill, cohort_enc,
+                                       n=1):
+                break
             self._subtract_max(m, it_fit)
-            self._append_cohort(g, m, zone, it_fit, fill, cohort_enc, n=1)
             placed += fill
         return placed
 
@@ -600,14 +607,21 @@ class Packer:
 
     def _append_cohort(self, g: int, m: int, zone: Optional[int],
                        it_set: np.ndarray, fill: int,
-                       cohort_enc: EncodedRequirements, n: int = 1) -> None:
+                       cohort_enc: EncodedRequirements, n: int = 1) -> bool:
+        """Returns False (placing nothing) when the fill-sizing invariant is
+        violated — the fill outgrew every surviving instance type. Callers
+        treat that as 0 pods placed, so the group's remainder flows to the
+        normal unplaced-pods error path instead of an assert crashing the
+        whole batch (and `python -O` silently materializing an empty
+        it_set)."""
         req = self.p.group_req[g] * fill
         it_set = it_set & self._fits_requests(m, req)
-        assert it_set.any(), \
-            "cohort fill outgrew every surviving instance type (fill sizing bug)"
+        if not it_set.any():
+            return False
         self.result.cohorts.append(Cohort(
             m=m, zone=zone, it_set=it_set.copy(), requests=req.copy(), n=n,
             enc=cohort_enc, pods_by_group={g: fill}))
+        return True
 
     def _cohort_capacity(self, g: int, cohort: Cohort) -> Tuple[int, np.ndarray]:
         """Max additional pods of group g per cohort node + surviving it set.
@@ -842,9 +856,11 @@ class Packer:
             fill = min(per, c)
             if fill <= 0:
                 continue
+            if not self._append_cohort(g, m, None, it_ok, fill,
+                                       self._node_enc(g, m, None)):
+                continue
             if limits is not None:
                 self._subtract_max(m, it_ok)
-            self._append_cohort(g, m, None, it_ok, fill, self._node_enc(g, m, None))
             return fill
         return 0
 

@@ -11,7 +11,38 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..api.objects import PREFER_NO_SCHEDULE, Pod, SCHEDULE_ANYWAY, Toleration
+from ..api.objects import (Affinity, NodeAffinity, PREFER_NO_SCHEDULE,
+                           Pod, PodAffinity, SCHEDULE_ANYWAY, Toleration)
+
+
+def _own_spec_containers(pod: Pod) -> None:
+    """Give the pod its own mutable constraint containers before relaxing.
+
+    Pods stamped from one deployment (and pods decoded from the sidecar wire,
+    codec.decode_pod_batch) share their Affinity / spread-constraint objects;
+    the relaxation ladder pops terms in place, so without this, relaxing one
+    pod would strip constraints from every sibling. Term objects themselves
+    are frozen dataclasses, so a container-level clone is a full copy.
+    """
+    if getattr(pod.spec, "_owned_containers", False):
+        return
+    pod.spec._owned_containers = True
+    aff = pod.spec.affinity
+    if aff is not None:
+        pod.spec.affinity = Affinity(
+            node_affinity=(None if aff.node_affinity is None else NodeAffinity(
+                required_terms=list(aff.node_affinity.required_terms),
+                preferred=list(aff.node_affinity.preferred))),
+            pod_affinity=(None if aff.pod_affinity is None else PodAffinity(
+                required=list(aff.pod_affinity.required),
+                preferred=list(aff.pod_affinity.preferred))),
+            pod_anti_affinity=(None if aff.pod_anti_affinity is None
+                               else PodAffinity(
+                required=list(aff.pod_anti_affinity.required),
+                preferred=list(aff.pod_anti_affinity.preferred))))
+    pod.spec.topology_spread_constraints = \
+        list(pod.spec.topology_spread_constraints)
+    pod.spec.tolerations = list(pod.spec.tolerations)
 
 
 class Preferences:
@@ -19,6 +50,7 @@ class Preferences:
         self.tolerate_prefer_no_schedule = tolerate_prefer_no_schedule
 
     def relax(self, pod: Pod) -> bool:
+        _own_spec_containers(pod)
         relaxations = [
             self._remove_required_node_affinity_term,
             self._remove_preferred_pod_affinity_term,

@@ -70,6 +70,33 @@ class TestFileLease:
         assert a.holder() == "op-a"
 
 
+class TestRenewDeadline:
+    def test_wedged_renewal_thread_surrenders_leadership(self, tmp_path):
+        """client-go aborts leadership when RenewDeadline elapses without a
+        successful renew. A renewal thread that is blocked (wedged fcntl
+        lock, scheduling stall) never flips _lease_lost — the run loop's
+        deadline check must catch it BEFORE the lease expires and a standby
+        legitimately steals it, or two leaders reconcile concurrently."""
+        clock = FakeClock()
+        op = Operator(options=Options(leader_elect=True,
+                                      lease_file=str(tmp_path / "l")),
+                      clock=clock)
+        lease = op._lease()
+        assert lease.try_acquire()
+        t = op._start_renewal(lease)
+        op._renew_stop.set()  # wedge: no renew attempt will ever complete
+        t.join(timeout=5)
+        assert not op._lease_lost.is_set()
+        assert not op._renew_deadline_passed(lease)
+        clock.step(9)   # renew deadline = 2/3 * 15 s = 10 s
+        assert not op._renew_deadline_passed(lease)
+        clock.step(2)   # 11 s since last renew: deadline passed...
+        assert op._renew_deadline_passed(lease)
+        clock.step(5)   # ...and only at 16 s could a standby steal the lease
+        rival = FileLease(lease.path, "rival", lease_duration=15, clock=clock)
+        assert rival.try_acquire()
+
+
 class TestOperatorLeadership:
     def test_standby_does_not_reconcile(self, tmp_path):
         """Two operators over one lease: only the leader provisions; the

@@ -290,7 +290,84 @@ class TestManagerTimerDedup:
         store.create(pod)
         mgr.drain()          # schedules +300
         store.update(pod)
-        mgr.drain()          # schedules +5 -> must supersede the +300
+        mgr.drain()          # schedules +5 -> fires first; +300 stays pending
         clock.step(6)
         mgr.drain()
-        assert len(fired) == 3  # the 5s timer fired; 300s entry was stale
+        assert len(fired) == 3  # the 5s timer fired without waiting out 300s
+
+    def test_later_requeue_not_dropped(self):
+        """A later AddAfter must still fire even when an earlier timer is
+        pending (client-go delivers every AddAfter time; dedup happens at
+        queue insertion, not by discarding delays) — otherwise a controller
+        relying on a later periodic recheck silently misses it."""
+        from karpenter_tpu.controllers.manager import Controller, Manager, Result
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock)
+        delays = iter([5.0, 300.0])
+        fired = []
+
+        class C(Controller):
+            name = "test.later"
+            kinds = (Pod,)
+
+            def reconcile(self, obj):
+                fired.append(clock.now())
+                return Result(requeue_after=next(delays, None))
+
+        mgr = Manager(store, clock)
+        mgr.register(C())
+        pod = make_pod(cpu="100m")
+        store.create(pod)
+        mgr.drain()          # schedules +5
+        store.update(pod)
+        mgr.drain()          # schedules +300 — must NOT be dropped
+        clock.step(6)
+        mgr.drain()          # +5 fires; reconcile returns no new requeue
+        assert len(fired) == 3
+        clock.step(300)
+        mgr.drain()          # the later +300 intent still fires
+        assert len(fired) == 4
+
+    def test_latest_intent_survives_multiple_displacements(self):
+        """Periodic recheck +300, then retry backoffs +5 and +1: the
+        earliest fires first and the LATEST intent (the periodic recheck)
+        must still fire even after two displacements; the sandwiched +5 is
+        subsumed by the +1 reconcile, which saw newer state."""
+        from karpenter_tpu.controllers.manager import Controller, Manager, Result
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock)
+        delays = iter([300.0, 5.0, 1.0])
+        fired = []
+
+        class C(Controller):
+            name = "test.displaced"
+            kinds = (Pod,)
+
+            def reconcile(self, obj):
+                fired.append(clock.now())
+                return Result(requeue_after=next(delays, None))
+
+        mgr = Manager(store, clock)
+        mgr.register(C())
+        pod = make_pod(cpu="100m")
+        store.create(pod)
+        mgr.drain()          # schedules +300
+        store.update(pod)
+        mgr.drain()          # schedules +5 (displaces the +300 to deferred)
+        store.update(pod)
+        mgr.drain()          # schedules +1 (the +300 stays deferred)
+        clock.step(2)
+        mgr.drain()          # +1 fires; reconcile returns no requeue
+        assert len(fired) == 4
+        clock.step(300)
+        mgr.drain()          # the +300 periodic recheck still fires
+        assert len(fired) == 5
+        # bounded: at most one live + one deferred timer per object, ever
+        assert len(mgr._timer_pending) <= 1
+        assert len(mgr._timer_deferred) <= 1

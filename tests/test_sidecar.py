@@ -82,6 +82,42 @@ class TestCodec:
         assert back3[0].spec.volumes[0].claim_name == "data"
         assert not back3[1].spec.volumes
 
+    def test_relax_after_decode_does_not_strip_siblings(self):
+        """decode_pod_batch rebuilds pods of one template with SHARED
+        affinity/spread objects; the host-fallback relaxation ladder pops
+        terms in place. Relaxing one pod must not narrow its siblings'
+        constraints (ADVICE r3: shared-mutable wire decode vs
+        preferences.go:38-57 semantics)."""
+        from karpenter_tpu.api.objects import SCHEDULE_ANYWAY, NodeSelectorRequirement
+        from karpenter_tpu.provisioning.preferences import Preferences
+        from factories import spread_zone
+
+        term = [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE,
+                                        "In", ("test-zone-a",))]
+        tsc = spread_zone(key="app", value="d0")
+        object.__setattr__(tsc, "when_unsatisfiable", SCHEDULE_ANYWAY)
+        proto = make_pod(cpu="100m", labels={"app": "d0"}, spread=[tsc],
+                         preferred_affinity=[(10, term)], name="rx-0")
+        # deployment stamping: siblings share the SAME spec sub-objects
+        from karpenter_tpu.api.objects import ObjectMeta, Pod, PodSpec
+        pods = [proto] + [
+            Pod(metadata=ObjectMeta(name=f"rx-{i}", namespace="default",
+                                    labels=dict(proto.labels)),
+                spec=PodSpec(
+                    affinity=proto.spec.affinity,
+                    topology_spread_constraints=
+                        proto.spec.topology_spread_constraints),
+                container_requests=list(proto.container_requests))
+            for i in (1, 2)]
+        back = codec.decode_pod_batch(codec.encode_pod_batch(pods))
+        assert back[0].spec.affinity is back[1].spec.affinity  # wire sharing
+        prefs = Preferences()
+        assert prefs.relax(back[0])  # pops back[0]'s preferred node affinity
+        assert prefs.relax(back[0])  # then its ScheduleAnyway spread
+        for sibling in back[1:]:
+            assert len(sibling.spec.topology_spread_constraints) == 1
+            assert len(sibling.spec.affinity.node_affinity.preferred) == 1
+
     def test_instance_type_round_trip(self):
         it = construct_instance_types()[0]
         back = codec.instance_type_from_dict(codec.instance_type_to_dict(it))
