@@ -81,12 +81,14 @@ class Manager:
         self._timer_seq = itertools.count()
         # AddAfter dedup, bounded per (controller, object): one LIVE heap
         # entry (the earliest fire time) plus at most one DEFERRED later
-        # intent, re-armed when the live timer fires. client-go's delaying
-        # queue keeps a single entry per item and only moves it earlier —
-        # but silently dropping a later requeue loses a controller's
-        # periodic recheck when the earlier reconcile returns no requeue
-        # (ADVICE r3); keeping the soonest later intent preserves it while
-        # still preventing per-event perpetual timer chains
+        # intent — the LATEST requested fire time — re-armed when the live
+        # timer fires. client-go's delaying queue keeps a single entry per
+        # item and only moves it earlier — but silently dropping a later
+        # requeue loses a controller's periodic recheck when the earlier
+        # reconcile returns no requeue (ADVICE r3); keeping the latest
+        # intent preserves the final recheck (intermediate intents are
+        # subsumed by the earlier fire's reconcile) while still preventing
+        # per-event perpetual timer chains
         self._timer_pending: Dict[tuple, float] = {}
         self._timer_deferred: Dict[tuple, tuple] = {}  # key -> (fire_at, c, obj)
         store.watch(self._on_event)

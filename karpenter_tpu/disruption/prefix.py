@@ -124,12 +124,19 @@ class PrefixSimulator:
         izc, exist_counts, host_total = self.ts.cluster_topology_counts(
             probe_groups, self.zone_names, allowed)
         exist_counts = pad_exist_counts(self.problem, exist_counts)
+        # CSI attach limits per probe: _volume_limit_state builds fresh
+        # per-node budget dicts each call, so the packer's draw-down never
+        # leaks across probes
+        vol_group_counts, vol_node_remaining = \
+            self.ts._volume_limit_state(probe_groups)
         packer = binpack.Packer(self.problem, self.tensors, probe_groups,
                                 limits, limit_resources,
                                 initial_zone_counts=izc,
                                 exist_order=exist_order,
                                 exist_counts=exist_counts,
-                                host_match_total=host_total)
+                                host_match_total=host_total,
+                                vol_group_counts=vol_group_counts,
+                                vol_node_remaining=vol_node_remaining)
         pr = packer.pack()
         results = self.ts._materialize(
             pr, self.problem, probe_groups, self.templates, self.catalog,

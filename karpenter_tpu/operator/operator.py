@@ -57,15 +57,19 @@ class Operator:
         gates = self.options.gates
         scheduler_factory = None
         if self.options.solver_backend == "sidecar":
-            from ..sidecar.client import RemoteScheduler
+            from ..sidecar.client import RemoteScheduler, SolverSession
             address = self.options.solver_address
+            # one persistent session for the operator's lifetime: the
+            # catalog/nodepools ride the wire once, state nodes as deltas
+            self.solver_session = SolverSession(address)
+            session = self.solver_session
 
             def scheduler_factory(nodepools, instance_types, state_nodes,
                                   daemonset_pods, cluster):
                 return RemoteScheduler(address, nodepools, instance_types,
                                        state_nodes=state_nodes,
                                        daemonset_pods=daemonset_pods,
-                                       cluster=cluster)
+                                       cluster=cluster, session=session)
         self.provisioner = Provisioner(self.store, self.cluster,
                                        self.cloud_provider, self.clock,
                                        scheduler_factory=scheduler_factory)

@@ -449,7 +449,9 @@ class Packer:
                  initial_zone_counts: Optional[np.ndarray] = None,
                  exist_order: Optional[List[int]] = None,
                  exist_counts: Optional[np.ndarray] = None,
-                 host_match_total: Optional[np.ndarray] = None):
+                 host_match_total: Optional[np.ndarray] = None,
+                 vol_group_counts: Optional[list] = None,
+                 vol_node_remaining: Optional[list] = None):
         self.p = p
         self.t = t
         self.groups = groups
@@ -470,6 +472,14 @@ class Packer:
         # countDomains analog for hostname topologies, topology.go:268-321)
         self.exist_counts = exist_counts
         self.host_match_total = host_match_total
+        # CSI attach limits for per-pod (ephemeral) claims, linearized
+        # (volumeusage.go:201-208): vol_group_counts[g] = {driver: claims
+        # per pod} or None; vol_node_remaining[n] = {driver: remaining
+        # slots} for limited drivers only, or None for unlimited nodes.
+        # Shared MUTABLE per-node dicts: every group placing on a node
+        # draws down the same driver budget.
+        self.vol_group_counts = vol_group_counts
+        self.vol_node_remaining = vol_node_remaining
         # domain-name tie-break order for zone selection (host parity)
         self._zone_names = np.array(p.vocab.values[p.zone_key], dtype=object)
         self.result = PackResult()
@@ -731,9 +741,24 @@ class Packer:
                 cap = min(cap, per_node_cap)
             if node_caps is not None:
                 cap = min(cap, int(node_caps[n]))
+            vol_counts = (self.vol_group_counts[g]
+                          if self.vol_group_counts is not None else None)
+            vol_rem = None
+            if vol_counts:
+                vol_rem = (self.vol_node_remaining[n]
+                           if self.vol_node_remaining is not None
+                           and n < len(self.vol_node_remaining) else None)
+                if vol_rem:
+                    cap = min(cap, min(
+                        (vol_rem[d] // c for d, c in vol_counts.items()
+                         if d in vol_rem), default=INT32_MAX))
             fill = min(cap, remaining)
             if fill <= 0:
                 continue
+            if vol_counts and vol_rem:
+                for d, c in vol_counts.items():
+                    if d in vol_rem:
+                        vol_rem[d] -= c * fill
             self.exist_avail[n] = self.exist_avail[n] - req * fill
             self.result.existing.setdefault(n, []).append((g, fill))
             placed_total += fill

@@ -201,7 +201,7 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
     return groups, ""
 
 
-def partition_pods(pods: List[Pod]):
+def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None):
     """Returns (groups, leftover_pods, reason): every pod lands on exactly
     one side. `groups` are tensor-eligible equivalence classes; `leftover`
     pods carry constraint shapes only the host oracle understands (host
@@ -212,7 +212,13 @@ def partition_pods(pods: List[Pod]):
 
     Two-phase: a cheap structural signature buckets the pods; the expensive
     classification (Requirements construction, topology-shape analysis) runs
-    once per bucket — O(groups), not O(pods)."""
+    once per bucket — O(groups), not O(pods).
+
+    `prebuckets` is the sidecar fast path: the wire's template column
+    already partitions the batch into identical-spec buckets, so only each
+    bucket's probe needs a signature (buckets whose probes collide merge —
+    the wire keys templates by sub-object identity, which can split
+    equal-content specs that this signature reunifies)."""
     groups: Dict = {}
     order: List = []
     # structural tokens memoized by sub-object identity: pods stamped from one
@@ -237,6 +243,48 @@ def partition_pods(pods: List[Pod]):
     ident = lambda o: o
     items_key = lambda d: tuple(sorted(d.items()))
     reasons: Dict[int, str] = {}  # id(bucket) -> why it's host-path
+
+    if prebuckets is not None:
+        for bucket in prebuckets:
+            if not bucket:
+                continue
+            probe = bucket[0]
+            sig = (tuple(sorted(probe.spec.node_selector.items())),
+                   _affinity_key(probe),
+                   tuple(probe.spec.topology_spread_constraints),
+                   tuple(probe.spec.tolerations),
+                   tuple(sorted(probe.labels.items())),
+                   tuple(tuple(sorted(r.items()))
+                         for r in probe.container_requests),
+                   tuple(tuple(sorted(r.items()))
+                         for r in probe.init_container_requests),
+                   not probe.spec.host_ports,
+                   () if not probe.spec.volumes
+                   else tuple(probe.spec.volumes))
+            g = groups.get(sig)
+            if g is None:
+                reason = ""
+                if probe.spec.host_ports:
+                    reason = "host ports require per-pod conflict tracking"
+                elif not all(ref.ephemeral for ref in probe.spec.volumes):
+                    reason = ("persistent volume claims shared across pods "
+                              "require host-side limit tracking")
+                specs, relaxable = _classify_topology(probe)
+                if specs is None and not reason:
+                    reason = "unsupported topology constraint shape"
+                g = PodGroup(pods=[], requirements=pod_requirements(probe),
+                             requests=probe.requests(),
+                             tolerations=tuple(probe.spec.tolerations),
+                             labels=dict(probe.labels), topo=specs or [],
+                             has_relaxable=relaxable
+                             or has_preferred_node_affinity(probe))
+                if reason:
+                    reasons[id(g)] = reason
+                groups[sig] = g
+                order.append(g)
+            g.pods.extend(bucket)
+        return _finish_partition(order, reasons)
+
     for pod in pods:
         spec = pod.spec
         aff = spec.affinity
@@ -263,15 +311,24 @@ def partition_pods(pods: List[Pod]):
             rt,
             () if not pod.init_container_requests
             else tuple(tok(r, items_key) for r in pod.init_container_requests),
-            (not spec.host_ports, not spec.volumes),
+            not spec.host_ports,
+            # volume content keys the bucket: ephemeral groups with distinct
+            # storage classes must not merge (different CSI drivers/caps)
+            () if not spec.volumes else tuple(spec.volumes),
         )
         g = groups.get(sig)
         if g is None:
             reason = ""
             if spec.host_ports:
                 reason = "host ports require per-pod conflict tracking"
-            elif spec.volumes:
-                reason = "persistent volumes require host-side limit tracking"
+            elif not all(ref.ephemeral for ref in spec.volumes):
+                # ephemeral volumes tensorize exactly: each pod brings its
+                # own per-pod claim, so a group's CSI attach consumption is
+                # a per-node linear cap (volumeusage.go:187-220). Shared
+                # PVCs / pre-bound PVs keep set-dedup + PV-affinity
+                # semantics only the host oracle models.
+                reason = ("persistent volume claims shared across pods "
+                          "require host-side limit tracking")
             specs, relaxable = _classify_topology(pod)
             if specs is None and not reason:
                 reason = "unsupported topology constraint shape"
@@ -286,6 +343,10 @@ def partition_pods(pods: List[Pod]):
             order.append(g)
         g.pods.append(pod)
 
+    return _finish_partition(order, reasons)
+
+
+def _finish_partition(order: List[PodGroup], reasons: Dict[int, str]):
     # cross-group selector coupling: a topology selector matching another
     # bucket's labels means shared domain counts — both sides must be solved
     # by ONE solver. Any bucket coupled (transitively) to a host-path bucket

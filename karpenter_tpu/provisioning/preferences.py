@@ -16,20 +16,24 @@ from ..api.objects import (Affinity, NodeAffinity, PREFER_NO_SCHEDULE,
 
 
 def _own_spec_containers(pod: Pod) -> None:
-    """Give the pod its own mutable constraint containers before relaxing.
+    """Give the pod its own PodSpec with its own mutable constraint
+    containers before relaxing.
 
-    Pods stamped from one deployment (and pods decoded from the sidecar wire,
-    codec.decode_pod_batch) share their Affinity / spread-constraint objects;
-    the relaxation ladder pops terms in place, so without this, relaxing one
-    pod would strip constraints from every sibling. Term objects themselves
-    are frozen dataclasses, so a container-level clone is a full copy.
+    Pods stamped from one deployment (and pods rebuilt from the sidecar
+    wire, codec) can share their Affinity / spread-constraint objects — or
+    their entire PodSpec; the relaxation ladder pops terms in place, so
+    without this, relaxing one pod would strip constraints from every
+    sibling. Term objects themselves are frozen dataclasses, so cloning the
+    spec plus its mutable containers is a full copy; read-only sub-objects
+    (node_selector, host_ports, volumes) stay shared.
     """
-    if getattr(pod.spec, "_owned_containers", False):
+    import dataclasses
+    spec = pod.spec
+    if getattr(spec, "_owned_by", None) is pod:
         return
-    pod.spec._owned_containers = True
-    aff = pod.spec.affinity
+    aff = spec.affinity
     if aff is not None:
-        pod.spec.affinity = Affinity(
+        aff = Affinity(
             node_affinity=(None if aff.node_affinity is None else NodeAffinity(
                 required_terms=list(aff.node_affinity.required_terms),
                 preferred=list(aff.node_affinity.preferred))),
@@ -40,9 +44,11 @@ def _own_spec_containers(pod: Pod) -> None:
                                else PodAffinity(
                 required=list(aff.pod_anti_affinity.required),
                 preferred=list(aff.pod_anti_affinity.preferred))))
-    pod.spec.topology_spread_constraints = \
-        list(pod.spec.topology_spread_constraints)
-    pod.spec.tolerations = list(pod.spec.tolerations)
+    pod.spec = dataclasses.replace(
+        spec, affinity=aff,
+        topology_spread_constraints=list(spec.topology_spread_constraints),
+        tolerations=list(spec.tolerations))
+    pod.spec._owned_by = pod
 
 
 class Preferences:

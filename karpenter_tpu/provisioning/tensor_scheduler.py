@@ -99,6 +99,32 @@ def _catalog_cache_key(catalog: List[InstanceType]) -> tuple:
         for it in catalog)
 
 
+def _ordered_union(its_lists) -> "Tuple[List[InstanceType], Dict[str, int]]":
+    """Name-deduped instance-type union in first-seen order — THE union
+    order behind the order-dependent catalog encodings. build_problem and
+    catalog_cache_token must share it: a divergent order would key the
+    device-encoding cache with a token for a differently-ordered encoding."""
+    catalog: List[InstanceType] = []
+    it_index: Dict[str, int] = {}
+    for its in its_lists:
+        for it in its:
+            if it.name not in it_index:
+                it_index[it.name] = len(catalog)
+                catalog.append(it)
+    return catalog, it_index
+
+
+def catalog_cache_token(nodepools, instance_types) -> tuple:
+    """Precomputed catalog cache key for callers whose catalog is immutable
+    for their lifetime (the sidecar session): hashing 2k instance types per
+    solve is pure overhead when the owner guarantees no in-place mutation.
+    Uses build_problem's union order (_ordered_union; pools with no
+    instance types contribute nothing either way)."""
+    catalog, _ = _ordered_union(
+        instance_types.get(np_.name, []) for np_ in nodepools)
+    return _catalog_cache_key(catalog)
+
+
 class TensorNodeClaim:
     """A launch decision produced by the tensor packer; interface-compatible
     with provisioning.scheduler.InFlightNodeClaim for downstream consumers."""
@@ -161,7 +187,7 @@ class TensorScheduler:
                  state_nodes=(), daemonset_pods: List[Pod] = (),
                  cluster: Optional[ClusterView] = None,
                  initial_zone_counts=None, force_tensor: bool = False,
-                 mesh=None):
+                 mesh=None, catalog_token: Optional[tuple] = None):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -172,14 +198,17 @@ class TensorScheduler:
         # optional jax.sharding.Mesh: run the feasibility precompute sharded
         # over a multi-chip mesh (parallel/mesh.py) instead of single-device
         self.mesh = mesh
+        # precomputed catalog cache key (catalog_cache_token): ONLY valid
+        # when the caller guarantees the catalog is never mutated in place
+        self.catalog_token = catalog_token
         self.fallback_reason: str = ""
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
 
     # -- public -------------------------------------------------------------
 
-    def solve(self, pods: List[Pod]) -> Results:
-        groups, leftover, reason = partition_pods(pods)
+    def solve(self, pods: List[Pod], prebuckets=None) -> Results:
+        groups, leftover, reason = partition_pods(pods, prebuckets=prebuckets)
         self.partition = (sum(g.count for g in groups), len(leftover))
         if not groups:
             return self._host_solve(pods, reason)
@@ -256,6 +285,13 @@ class TensorScheduler:
                                     *(p.requests() for p in ten.pods))
             for p in ten.pods:
                 host.topology.record(p, en.requirements)
+                # seed CSI attach usage too, or a host-side volume pod
+                # double-books the slots the tensor pass just consumed
+                # (volumeusage.go:201-208)
+                if p.spec.volumes and en._volume_usage is not None \
+                        and en._store is not None:
+                    from ..scheduling.volumeusage import get_volumes
+                    en._volume_usage.add(get_volumes(en._store, p))
         tmpl_idx = {t.nodepool_name: i for i, t in enumerate(host.templates)}
         for tnc in tensor_results.new_nodeclaims:
             i = tmpl_idx.get(tnc.template.nodepool_name)
@@ -301,19 +337,15 @@ class TensorScheduler:
         if not templates:
             raise _FallbackError("no nodepools with instance types")
 
-        # union instance-type catalog
-        catalog: List[InstanceType] = []
-        it_index: Dict[str, int] = {}
-        for nct in templates:
-            for it in nct.instance_type_options:
-                if it.name not in it_index:
-                    it_index[it.name] = len(catalog)
-                    catalog.append(it)
+        # union instance-type catalog (shared order contract: _ordered_union)
+        catalog, it_index = _ordered_union(
+            nct.instance_type_options for nct in templates)
         T = len(catalog)
         M = len(templates)
         G = len(groups)
 
-        ckey = _catalog_cache_key(catalog)
+        ckey = (self.catalog_token if self.catalog_token is not None
+                else _catalog_cache_key(catalog))
         with _CATALOG_CACHE_LOCK:
             ce = _CATALOG_CACHE.get(ckey)
         if ce is not None and not self._fits_vocab(ce.vocab, templates, groups):
@@ -665,13 +697,69 @@ class TensorScheduler:
                                          self.state_nodes[i].name()))
         if exist_counts is not None:
             exist_counts = pad_exist_counts(problem, exist_counts)
+        vol_group_counts, vol_node_remaining = \
+            self._volume_limit_state(groups)
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
                                 initial_zone_counts=izc, exist_order=sn_order,
                                 exist_counts=exist_counts,
-                                host_match_total=host_total)
+                                host_match_total=host_total,
+                                vol_group_counts=vol_group_counts,
+                                vol_node_remaining=vol_node_remaining)
         pr = packer.pack()
         return self._materialize(pr, problem, groups, templates, catalog,
                                  vocab, zone_key)
+
+    def _volume_limit_state(self, groups):
+        """CSI attach-limit inputs for the packer's existing-node pass
+        (volumeusage.go:187-220 linearized). Groups reaching the tensor path
+        carry only EPHEMERAL volumes (grouping demotes the rest), so each
+        pod consumes {driver: count} fresh attach slots on its node.
+        Returns (vol_group_counts[g] = {driver: per-pod claims} | None,
+        vol_node_remaining[n] = {driver: remaining slots} for limited
+        drivers | None). Resolution order mirrors the host oracle: a wire
+        pre-resolution rider when present, else the store reachable through
+        the cluster view; unresolvable volumes impose no limits, exactly as
+        a missing CSINode imposes none (volumeusage.go:187-199)."""
+        vol_gis = [gi for gi, g in enumerate(groups)
+                   if g.pods and g.pods[0].spec.volumes]
+        if not vol_gis or not self.state_nodes:
+            return None, None
+        store = getattr(self.cluster, "store", None)
+        group_counts: List[Optional[dict]] = [None] * len(groups)
+        any_counts = False
+        for gi in vol_gis:
+            probe = groups[gi].pods[0]
+            counts = getattr(probe.spec, "_volume_drivers", None)
+            if counts is None and store is not None:
+                from ..scheduling.volumeusage import get_volumes
+                counts = {d: len(keys)
+                          for d, keys in get_volumes(store, probe).items()}
+            if counts:
+                group_counts[gi] = dict(counts)
+                any_counts = True
+        if not any_counts:
+            return None, None
+        remaining: List[Optional[dict]] = []
+        for sn in self.state_nodes:
+            limits = getattr(sn, "volume_limits", None)
+            if limits is None and store is not None:
+                from ..scheduling.volumeusage import node_volume_limits
+                limits = node_volume_limits(store, sn.name())
+            limits = {d: lm for d, lm in (limits or {}).items()
+                      if lm is not None}
+            if not limits:
+                remaining.append(None)
+                continue
+            used = getattr(sn, "volume_used", None)
+            if used is None:
+                vu = getattr(sn, "volume_usage", None)
+                used = ({d: len(s) for d, s in vu().volumes.items()}
+                        if vu is not None else {})
+            remaining.append({d: max(0, lm - used.get(d, 0))
+                              for d, lm in limits.items()})
+        if all(r is None for r in remaining):
+            return None, None
+        return group_counts, remaining
 
     @staticmethod
     def _cohort_price_order(problem, cohort, it_names: np.ndarray) -> np.ndarray:
@@ -715,7 +803,7 @@ class TensorScheduler:
         # cohorts from one solve overwhelmingly share (it_set, zone/captype
         # admission) — memoize the ordering per distinct key
         order_cache: dict = {}
-        for cohort in pr.cohorts:
+        for ci, cohort in enumerate(pr.cohorts):
             okey = (cohort.it_set.tobytes(),
                     cohort.enc.mask[problem.zone_key].tobytes(),
                     cohort.enc.mask[problem.captype_key].tobytes())
@@ -743,8 +831,14 @@ class TensorScheduler:
                 pods: List[Pod] = []
                 for g, fill in cohort.pods_by_group.items():
                     pods.extend(take(g, fill))
-                new_claims.append(TensorNodeClaim(
-                    templates[cohort.m], reqs, ordered, pods, dict(requests)))
+                tnc = TensorNodeClaim(
+                    templates[cohort.m], reqs, ordered, pods, dict(requests))
+                # sibling claims of one cohort differ only in their pods —
+                # the sidecar result codec interns the claim shape by this
+                # id so n identical nodes encode once (codec.py
+                # encode_solve_response_rows)
+                tnc.cohort_id = ci
+                new_claims.append(tnc)
         existing: List[TensorExistingNode] = []
         for n, fills in pr.existing.items():
             pods = []

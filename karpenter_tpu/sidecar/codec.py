@@ -213,22 +213,7 @@ def encode_pod_batch(pods) -> dict:
     tmpl_idx: dict = {}
     rows: list = []
     for p in pods:
-        # identity tokens for stamped-and-shared sub-objects, insertion-order
-        # content for per-pod dicts: distinct-but-equal objects just cost an
-        # extra template, never correctness (the template holds full content)
-        spec = p.spec
-        key = (id(spec.affinity),
-               tuple(map(id, spec.topology_spread_constraints)),
-               tuple(map(id, spec.tolerations)),
-               tuple(spec.node_selector.items()),
-               tuple(p.metadata.labels.items()),
-               tuple(tuple(r.items()) for r in p.container_requests),
-               tuple(tuple(r.items()) for r in p.init_container_requests),
-               tuple((hp.port, hp.protocol, hp.host_ip)
-                     for hp in spec.host_ports),
-               tuple(spec.volumes),  # PVCRef is frozen/hashable
-               p.metadata.namespace, spec.priority, p.is_daemonset_pod,
-               tuple(p.metadata.annotations.items()))
+        key = _pod_template_key(p)
         i = tmpl_idx.get(key)
         if i is None:
             d = pod_to_dict(p)
@@ -303,7 +288,252 @@ def pod_from_dict(d: dict) -> Pod:
         is_daemonset_pod=d["daemonset"])
 
 
-# -- instance types ---------------------------------------------------------
+# -- columnar pod rows (session protocol) -----------------------------------
+
+
+def _pod_template_key(p: Pod):
+    """Identity tokens for stamped-and-shared sub-objects, insertion-order
+    content for per-pod dicts: distinct-but-equal objects just cost an extra
+    template, never correctness (the template holds full content)."""
+    spec = p.spec
+    return (id(spec.affinity),
+            tuple(map(id, spec.topology_spread_constraints)),
+            tuple(map(id, spec.tolerations)),
+            tuple(spec.node_selector.items()),
+            tuple(p.metadata.labels.items()),
+            tuple(tuple(r.items()) for r in p.container_requests),
+            tuple(tuple(r.items()) for r in p.init_container_requests),
+            tuple((hp.port, hp.protocol, hp.host_ip)
+                  for hp in spec.host_ports),
+            tuple(spec.volumes),  # PVCRef is frozen/hashable
+            p.metadata.namespace, spec.priority, p.is_daemonset_pod,
+            tuple(p.metadata.annotations.items()))
+
+
+def encode_pod_rows(pods):
+    """Columnar twin of encode_pod_batch for the session protocol: returns
+    (templates, tmpl_idx, timestamps). Row order == batch order; responses
+    reference pods by row index, so no per-pod JSON (and no names/uids —
+    server-side pod identity is synthetic, see build_wire_pods) rides the
+    wire: only a uint32 template column and the creation-timestamp column
+    (host-queue sort tiebreak, scheduler.py Queue). Identity-token memo
+    mirrors grouping.partition_pods so the per-pod cost is a small-tuple
+    hash, not a structural one."""
+    import numpy as _np
+    templates: list = []
+    tmpl_idx_map: dict = {}
+    n = len(pods)
+    tmpl_idx = _np.empty(n, dtype=_np.uint32)
+    ts = _np.empty(n, dtype=_np.float64)
+    # content tokens memoized by sub-object identity (the partition_pods
+    # trick): deployment-stamped pods share their request dicts / constraint
+    # elements even when the containers are stamped fresh per pod
+    id_memo: dict = {}
+    struct_tokens: dict = {}
+    id_get = id_memo.get
+    tok_setdefault = struct_tokens.setdefault
+
+    def tok(obj, content):
+        t = id_get(id(obj))
+        if t is None:
+            t = tok_setdefault(content(), len(struct_tokens))
+            id_memo[id(obj)] = t
+        return t
+
+    for i, p in enumerate(pods):
+        spec = p.spec
+        meta = p.metadata
+        labels = meta.labels
+        reqs = p.container_requests
+        key = (
+            -1 if spec.affinity is None else id(spec.affinity),
+            tuple(map(id, spec.topology_spread_constraints)),
+            () if not spec.tolerations else tuple(map(id, spec.tolerations)),
+            -1 if not spec.node_selector
+            else tok_setdefault(tuple(sorted(spec.node_selector.items())),
+                                len(struct_tokens)),
+            tok_setdefault(tuple(labels.items()), len(struct_tokens)),
+            (tok(reqs[0], lambda: tuple(reqs[0].items()))
+             if len(reqs) == 1 else
+             tuple(tok(r, lambda r=r: tuple(r.items())) for r in reqs)),
+            () if not p.init_container_requests
+            else tuple(tok(r, lambda r=r: tuple(r.items()))
+                       for r in p.init_container_requests),
+            () if not spec.host_ports else tuple(map(id, spec.host_ports)),
+            () if not spec.volumes else tuple(spec.volumes),
+            meta.namespace, spec.priority, p.is_daemonset_pod,
+            -1 if not meta.annotations
+            else tok_setdefault(tuple(meta.annotations.items()),
+                                len(struct_tokens)),
+        )
+        t = tmpl_idx_map.get(key)
+        if t is None:
+            d = pod_to_dict(p)
+            for f in ("name", "uid", "creation_timestamp", "node_name"):
+                d.pop(f, None)
+            t = tmpl_idx_map[key] = len(templates)
+            templates.append(d)
+        tmpl_idx[i] = t
+        ts[i] = p.metadata.creation_timestamp
+    return templates, tmpl_idx, ts
+
+
+_SHARED_POD_STATUS = None
+
+
+def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
+    """Server-side fast rebuild of a columnar pod batch.
+
+    One full prototype Pod is decoded per template; every row then shares
+    the prototype's ENTIRE PodSpec, labels/annotations dicts, request lists
+    and a common PodStatus — only ObjectMeta (uid/name/timestamp) is
+    per-row. Sharing the whole spec is safe: the solver treats pod specs as
+    read-only, and the one mutating path (the relaxation ladder) clones the
+    spec per pod first (preferences._own_spec_containers). Pods carry their
+    row index as `_row`, and a synthetic `r<row>` uid/name — results
+    reference the batch by row index, and real identities never ride the
+    wire (pending pods can't be topology-counted server-side anyway:
+    topology.py ignored_for_topology drops node-less pods)."""
+    global _SHARED_POD_STATUS
+    from ..api.objects import PodStatus
+    if _SHARED_POD_STATUS is None:
+        _SHARED_POD_STATUS = PodStatus()
+    status = _SHARED_POD_STATUS
+    protos = []
+    for t in templates:
+        full = dict(t)
+        full.update(name="", uid="", creation_timestamp=0.0, node_name="")
+        pr = pod_from_dict(full)
+        if "volume_drivers" in t:
+            # client-resolved CSI driver counts rider (the server has no
+            # store); consumed by TensorScheduler._volume_limit_state
+            pr.spec._volume_drivers = dict(t["volume_drivers"])
+        protos.append(pr)
+    proto_parts = [(pr.spec, pr.metadata.namespace, pr.metadata.labels,
+                    pr.metadata.annotations, pr.container_requests,
+                    pr.init_container_requests, pr.is_daemonset_pod)
+                   for pr in protos]
+    out = []
+    meta_new = ObjectMeta.__new__
+    pod_new = Pod.__new__
+    # numpy iteration yields boxed scalars; plain lists are ~3x faster here
+    tmpl_list = tmpl_idx.tolist() if hasattr(tmpl_idx, "tolist") else tmpl_idx
+    ts_list = ts.tolist() if hasattr(ts, "tolist") else ts
+    for i, (t, created) in enumerate(zip(tmpl_list, ts_list)):
+        spec, ns, labels, annotations, reqs, ireqs, is_ds = proto_parts[t]
+        uid = f"r{i}"
+        m = meta_new(ObjectMeta)
+        m.__dict__ = {
+            "name": uid, "namespace": ns, "uid": uid, "labels": labels,
+            "annotations": annotations, "finalizers": (), "owner_refs": (),
+            "creation_timestamp": created, "deletion_timestamp": None,
+            "resource_version": 0, "generation": 0}
+        p = pod_new(Pod)
+        p.__dict__ = {
+            "metadata": m, "spec": spec, "status": status,
+            "container_requests": reqs, "init_container_requests": ireqs,
+            "is_daemonset_pod": is_ds, "_row": i}
+        out.append(p)
+    return out
+
+
+# -- row-based results (session protocol) -----------------------------------
+
+
+def encode_solve_response_rows(results, fallback_reason: str,
+                               it_idx_by_id: dict, it_idx_by_name: dict,
+                               ) -> bytes:
+    """Interned, row-referencing response frame. Claims from one packer
+    cohort share everything but their pods, so the full NodeClaim shape
+    (labels/taints/requirements + the surviving instance-type set as catalog
+    indices) is emitted once per cohort; per-claim data is just a span into
+    one shared row-index blob. Claim NAMES are assigned client-side
+    (they're fresh unique identifiers either way), so none ride the wire."""
+    from ..api import labels as api_labels
+    from . import wire
+    shapes: list = []
+    shape_idx: dict = {}
+    claims: list = []
+    all_rows: List[int] = []
+    all_its: List[int] = []
+    its_span_by_id: dict = {}
+
+    def it_span(its) -> list:
+        """Surviving instance types as catalog indices in the shared blob.
+        Cohorts overwhelmingly share their price-ordered options LIST
+        (tensor_scheduler's order_cache interns it), so spans dedup by list
+        identity."""
+        span = its_span_by_id.get(id(its))
+        if span is None:
+            off = len(all_its)
+            for it in its:
+                i = it_idx_by_id.get(id(it))
+                if i is None:
+                    i = it_idx_by_name[it.name]
+                all_its.append(i)
+            span = its_span_by_id[id(its)] = (its, [off, len(its)])
+        return span[1]
+
+    for nc in results.new_nodeclaims:
+        key = getattr(nc, "cohort_id", None)
+        si = shape_idx.get(key) if key is not None else None
+        if si is None:
+            nc.finalize()
+            api_nc = nc.to_nodeclaim()
+            d = api_nodeclaim_to_dict(api_nc)
+            d.pop("name", None)
+            # the instance-type requirement's value list (60 names) is
+            # redundant: the client's to_nodeclaim() rewrites it from the
+            # options list after price filtering — ship it empty
+            for rd in d["requirements"]:
+                if rd["key"] == api_labels.LABEL_INSTANCE_TYPE:
+                    rd["values"] = []
+            si = len(shapes)
+            shapes.append({
+                "nodeclaim": d,
+                "nodepool": nc.template.nodepool_name,
+                "requirements": reqs_to_list(nc.requirements),
+                "its": it_span(nc.instance_type_options),
+            })
+            if key is not None:
+                shape_idx[key] = si
+        off = len(all_rows)
+        rows = [p._row for p in nc.pods]
+        all_rows.extend(rows)
+        claims.append([si, off, len(rows)])
+
+    existing = []
+    for en in results.existing_nodes:
+        off = len(all_rows)
+        rows = [p._row for p in en.pods]
+        all_rows.extend(rows)
+        existing.append([en.name, off, len(rows)])
+
+    # errors: intern by message (identical verdicts repeat across a group);
+    # stub uids are synthetic "r<row>", so keys compress to row indices
+    err_rows_by_msg: Dict[str, list] = {}
+    for uid, msg in results.pod_errors.items():
+        err_rows_by_msg.setdefault(msg, []).append(int(uid[1:]))
+    err_rows: List[int] = []
+    errors = []
+    for msg, rows in err_rows_by_msg.items():
+        errors.append([msg, len(err_rows), len(rows)])
+        err_rows.extend(rows)
+
+    its_u16 = not all_its or max(all_its) < 0x10000
+    header = {
+        "fallback_reason": fallback_reason,
+        "shapes": shapes,
+        "claims": claims,
+        "existing": existing,
+        "errors": errors,
+        "its_u16": its_u16,
+    }
+    return wire.pack(header, {
+        "rows": wire.pack_u32(all_rows),
+        "its": (wire.pack_u16(all_its) if its_u16
+                else wire.pack_u32(all_its)),
+        "err_rows": wire.pack_u32(err_rows)})
 
 
 def instance_type_to_dict(it: InstanceType) -> dict:
@@ -391,8 +621,8 @@ class _MinValuesReq:
 # -- state nodes ------------------------------------------------------------
 
 
-def state_node_to_dict(sn) -> dict:
-    return {
+def state_node_to_dict(sn, store=None) -> dict:
+    out = {
         "name": sn.name(), "labels": dict(sn.labels()),
         "taints": [taint_to_dict(t) for t in sn.taints()],
         "allocatable": dict(sn.allocatable()),
@@ -402,6 +632,19 @@ def state_node_to_dict(sn) -> dict:
                                in sn.daemonset_pod_requests.items()},
         "initialized": sn.initialized(),
     }
+    # CSI attach-limit facts ride with the node: the server has no store to
+    # resolve CSINode limits or current usage (volumeusage.go:187-220)
+    vu = getattr(sn, "volume_usage", None)
+    if vu is not None:
+        used = {d: len(s) for d, s in vu().volumes.items()}
+        if used:
+            out["volume_used"] = used
+    if store is not None:
+        from ..scheduling.volumeusage import node_volume_limits
+        limits = node_volume_limits(store, sn.name())
+        if limits:
+            out["volume_limits"] = {d: lm for d, lm in limits.items()}
+    return out
 
 
 class WireStateNode:
@@ -417,6 +660,10 @@ class WireStateNode:
         self._hpu = HostPortUsage()
         self.pod_requests = dict(d["pod_requests"])
         self.daemonset_pod_requests = dict(d["daemonset_requests"])
+        # attach-limit riders consumed by TensorScheduler._volume_limit_state
+        self.volume_used = dict(d.get("volume_used", {}))
+        self.volume_limits = {k: v for k, v in
+                              d.get("volume_limits", {}).items()}
         total = (res.merge(*self.pod_requests.values())
                  if self.pod_requests else {})
         self._available = res.subtract(dict(d["allocatable"]), total)
@@ -566,6 +813,50 @@ class WireClusterView:
                 labels = self._node_labels.get(p.spec.node_name)
                 if labels is not None:
                     yield p, labels
+
+
+def union_catalog(instance_types: Dict[str, List[InstanceType]]) -> list:
+    """Name-deduped instance-type union in SORTED pool order — the index
+    space shared by the session client and server for result instance-type
+    references. Both sides MUST use this one function: a divergent order
+    silently remaps every claim's surviving instance types."""
+    catalog, seen = [], set()
+    for pool in sorted(instance_types):
+        for it in instance_types[pool]:
+            if it.name not in seen:
+                seen.add(it.name)
+                catalog.append(it)
+    return catalog
+
+
+def encode_session_request(nodepools,
+                           instance_types: Dict[str, List[InstanceType]]
+                           ) -> bytes:
+    """Session bootstrap: the heavy slow-changing inputs, sent once and then
+    referenced by session id (state nodes/daemonset pods ride as deltas on
+    each solve instead)."""
+    catalog: Dict[str, dict] = {}
+    per_pool: Dict[str, List[str]] = {}
+    for pool, its in instance_types.items():
+        per_pool[pool] = [it.name for it in its]
+        for it in its:
+            if it.name not in catalog:
+                catalog[it.name] = instance_type_to_dict(it)
+    payload = {
+        "nodepools": [nodepool_to_dict(np) for np in nodepools],
+        "catalog": list(catalog.values()),
+        "pool_instance_types": per_pool,
+    }
+    return json.dumps(payload).encode()
+
+
+def decode_session_request(data: bytes):
+    d = json.loads(data.decode())
+    catalog = {it["name"]: instance_type_from_dict(it) for it in d["catalog"]}
+    instance_types = {pool: [catalog[n] for n in names]
+                      for pool, names in d["pool_instance_types"].items()}
+    return ([nodepool_from_dict(np) for np in d["nodepools"]],
+            instance_types)
 
 
 def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]],

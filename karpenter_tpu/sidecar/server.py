@@ -3,25 +3,124 @@
 The north-star deployment (BASELINE.json) keeps the controllers in their own
 process and calls the TPU solver through a gRPC boundary hidden behind the
 Scheduler interface. This server owns the TPU devices, keeps the jit cache
-warm across solves, and exposes one method:
+warm across solves, and exposes:
 
-    /karpenter.v1.Solver/Solve   (bytes in, bytes out — codec.py JSON)
+    /karpenter.v1.Solver/CreateSession  JSON in (catalog + nodepools),
+                                        JSON out {"session": id}
+    /karpenter.v1.Solver/SolveSession   KTPW frame in (columnar pod rows +
+                                        state deltas), KTPW frame out
+                                        (interned row-referencing results)
+    /karpenter.v1.Solver/Solve          legacy one-shot JSON contract
 
+Sessions hold the decoded catalog, nodepools, state nodes and daemonset
+pods server-side so the per-solve wire traffic is just the pod batch and
+the result frame (VERDICT r3 #1: the JSON codec + per-request scheduler
+construction kept the deployed path ~3x off the in-process north star).
 Generic byte-level gRPC handlers keep the contract free of generated stubs;
-the message schema lives in codec.py.
+the message schemas live in codec.py / wire.py.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
+from collections import OrderedDict
 from concurrent import futures
-from typing import Optional
+from typing import Dict, List, Optional
 
 import grpc
 
 from ..provisioning.tensor_scheduler import TensorScheduler
-from . import codec
+from . import codec, wire
 
 SERVICE = "karpenter.v1.Solver"
+
+
+class _Session:
+    def __init__(self, session_id: str, nodepools, instance_types):
+        from ..provisioning.tensor_scheduler import catalog_cache_token
+        self.id = session_id
+        self.nodepools = nodepools
+        self.instance_types = instance_types
+        # the session owns its decoded catalog (nothing mutates it), so the
+        # content hash that guards the device encoding cache is computed
+        # once here instead of on every solve
+        self.catalog_token = catalog_cache_token(nodepools, instance_types)
+        # union catalog + index maps for result encoding (codec.union_catalog
+        # defines the index space shared with the client decoder)
+        self.catalog = codec.union_catalog(instance_types)
+        self.it_idx_by_id = {id(it): i for i, it in enumerate(self.catalog)}
+        self.it_idx_by_name = {it.name: i for i, it in enumerate(self.catalog)}
+        self.state_nodes: "OrderedDict[str, codec.WireStateNode]" = OrderedDict()
+        self.daemonset_pods: list = []
+        self.lock = threading.Lock()
+
+
+_SESSIONS: "OrderedDict[str, _Session]" = OrderedDict()
+_SESSIONS_LOCK = threading.Lock()
+_SESSIONS_MAX = 8
+_session_seq = itertools.count(1)
+
+
+def _create_session(request: bytes, context=None) -> bytes:
+    import json
+    import uuid
+    nodepools, instance_types = codec.decode_session_request(request)
+    # random id: sequential ids reset on restart, letting a stale client
+    # silently attach to a DIFFERENT client's new session instead of
+    # getting the NOT_FOUND that triggers its recreate-and-retry path
+    sid = f"s{next(_session_seq)}-{uuid.uuid4().hex[:12]}"
+    session = _Session(sid, nodepools, instance_types)
+    with _SESSIONS_LOCK:
+        while len(_SESSIONS) >= _SESSIONS_MAX:
+            _SESSIONS.popitem(last=False)
+        _SESSIONS[sid] = session
+    return json.dumps({"session": sid}).encode()
+
+
+def _solve_session(request: bytes, context=None) -> bytes:
+    header, blobs = wire.unpack(request)
+    sid = header["session"]
+    with _SESSIONS_LOCK:
+        session = _SESSIONS.get(sid)
+        if session is not None:
+            _SESSIONS.move_to_end(sid)
+    if session is None:
+        if context is not None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"unknown session {sid}")
+        raise KeyError(f"unknown session {sid}")
+
+    tmpl_idx = wire.unpack_u32(blobs["tmpl_idx"])
+    ts = wire.unpack_f64(blobs["ts"])
+    pods = codec.build_wire_pods(header["templates"], tmpl_idx, ts)
+
+    with session.lock:
+        for d in header.get("state_upsert", ()):
+            session.state_nodes[d["name"]] = codec.WireStateNode(d)
+        for name in header.get("state_remove", ()):
+            session.state_nodes.pop(name, None)
+        if "daemonset" in header:
+            session.daemonset_pods = [codec.pod_from_dict(p)
+                                      for p in header["daemonset"]]
+        state_nodes = list(session.state_nodes.values())
+        daemonset_pods = list(session.daemonset_pods)
+
+    cluster = codec.WireClusterView(header.get("cluster"))
+    ts_sched = TensorScheduler(session.nodepools, session.instance_types,
+                               state_nodes=state_nodes,
+                               daemonset_pods=daemonset_pods,
+                               cluster=cluster,
+                               catalog_token=session.catalog_token)
+    # the wire's template column already buckets identical-spec pods:
+    # hand the buckets to partition_pods so grouping is O(templates)
+    buckets: List[list] = [[] for _ in header["templates"]]
+    tl = tmpl_idx.tolist()
+    for p, t in zip(pods, tl):
+        buckets[t].append(p)
+    results = ts_sched.solve(pods, prebuckets=buckets)
+    return codec.encode_solve_response_rows(
+        results, ts_sched.fallback_reason,
+        session.it_idx_by_id, session.it_idx_by_name)
 
 
 def _solve(request: bytes, context=None) -> bytes:
@@ -33,18 +132,26 @@ def _solve(request: bytes, context=None) -> bytes:
     return codec.encode_solve_response(results, ts.fallback_reason)
 
 
+_METHODS = {
+    f"/{SERVICE}/Solve": _solve,
+    f"/{SERVICE}/CreateSession": _create_session,
+    f"/{SERVICE}/SolveSession": _solve_session,
+}
+
+
 class SolverServicer(grpc.GenericRpcHandler):
     def service(self, handler_call_details):
-        if handler_call_details.method == f"/{SERVICE}/Solve":
+        fn = _METHODS.get(handler_call_details.method)
+        if fn is not None:
             return grpc.unary_unary_rpc_method_handler(
-                _solve,
+                fn,
                 request_deserializer=None,   # raw bytes
                 response_serializer=None)
         return None
 
 
-# a 50k-pod solve request is ~30 MB of codec JSON; the gRPC default (4 MB)
-# would cap the solver at ~7k pods per call
+# a 50k-pod one-shot solve request is ~30 MB of codec JSON; the gRPC default
+# (4 MB) would cap the solver at ~7k pods per call. Session solves are ~2 MB.
 MAX_MESSAGE_BYTES = 256 * 1024 * 1024
 
 GRPC_OPTIONS = [

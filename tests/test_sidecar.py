@@ -238,3 +238,143 @@ class TestClusterViewOverWire:
             zs = sorted(req.values_list())
             remote_zones.extend(zs * len(nc.pods) if len(zs) == 1 else [])
         assert sorted(remote_zones) == local_zones
+
+
+class TestSessionProtocol:
+    """The session wire (VERDICT r3 #1): catalog/nodepools sent once,
+    columnar pod rows per solve, row-referencing interned results."""
+
+    def _session_pair(self, sidecar, its, pool, **kw):
+        from karpenter_tpu.sidecar.client import SolverSession
+        session = SolverSession(sidecar)
+        return RemoteScheduler(sidecar, [pool], {"default": its},
+                               session=session, **kw), session
+
+    def test_session_parity_with_local(self, sidecar):
+        its = construct_instance_types()[:48]
+        pool = make_nodepool(name="default")
+        pods = (make_pods(10, cpu="500m", memory="256Mi")
+                + make_pods(6, cpu="1000m", labels={"app": "s"},
+                            spread=[spread_zone(key="app", value="s")])
+                + make_pods(3, cpu="250m", labels={"app": "anti"},
+                            pod_anti_affinity=[
+                                affinity_term(api_labels.LABEL_HOSTNAME,
+                                              key="app", value="anti")]))
+        local = TensorScheduler([pool], {"default": its}).solve(pods)
+        rs, session = self._session_pair(sidecar, its, pool)
+        remote = rs.solve(pods)
+        assert rs.fallback_reason == ""
+        assert remote.pod_errors == local.pod_errors
+        key = lambda nc: (tuple(it.name for it in nc.instance_type_options),
+                          len(nc.pods))
+        assert sorted(map(key, remote.new_nodeclaims)) == \
+            sorted(map(key, local.new_nodeclaims))
+        # API claims are complete: instance-type values filled from options
+        api_nc = remote.new_nodeclaims[0].to_nodeclaim()
+        it_req = next(r for r in api_nc.spec.requirements
+                      if r.key == api_labels.LABEL_INSTANCE_TYPE)
+        assert 0 < len(it_req.values) <= 60
+        assert it_req.values[0] == \
+            remote.new_nodeclaims[0].instance_type_options[0].name
+        # errors map back to REAL pod uids (server side is synthetic rows)
+        for uid in remote.pod_errors:
+            assert any(p.uid == uid for p in pods)
+        session.close()
+
+    def test_session_reused_across_solves(self, sidecar):
+        from karpenter_tpu.sidecar import server as srv
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        rs, session = self._session_pair(sidecar, its, pool)
+        rs.solve(make_pods(4, cpu="500m"))
+        sid = session._session_id
+        assert sid is not None
+        rs.solve(make_pods(5, cpu="250m"))
+        assert session._session_id == sid  # no re-create
+        # same catalog content in NEW list objects: still no re-create
+        its2 = construct_instance_types()[:16]
+        rs2 = RemoteScheduler(rs.address, [pool], {"default": its2},
+                              session=session)
+        rs2.solve(make_pods(2, cpu="100m"))
+        assert session._session_id == sid
+        # changed catalog content: a new session is created
+        rs3 = RemoteScheduler(rs.address, [pool],
+                              {"default": construct_instance_types()[:8]},
+                              session=session)
+        rs3.solve(make_pods(2, cpu="100m"))
+        assert session._session_id != sid
+        session.close()
+
+    def test_session_eviction_recovery(self, sidecar):
+        from karpenter_tpu.sidecar import server as srv
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        rs, session = self._session_pair(sidecar, its, pool)
+        r1 = rs.solve(make_pods(4, cpu="500m"))
+        assert not r1.pod_errors
+        # simulate server restart: drop all sessions
+        with srv._SESSIONS_LOCK:
+            srv._SESSIONS.clear()
+        r2 = rs.solve(make_pods(4, cpu="500m"))  # NOT_FOUND -> retry once
+        assert not r2.pod_errors
+        assert session._session_id is not None
+        session.close()
+
+    def test_state_node_delta_updates(self, sidecar):
+        """An existing node added between solves must be visible server-side
+        via the delta (VERDICT: delta-update state nodes instead of
+        re-sending)."""
+        from factories import make_state_node
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        rs, session = self._session_pair(sidecar, its, pool)
+        r1 = rs.solve(make_pods(2, cpu="500m"))
+        assert r1.new_nodeclaims and not r1.existing_nodes
+        sn = make_state_node("live-node-1", zone="test-zone-a")
+        rs2 = RemoteScheduler(rs.address, [pool], {"default": its},
+                              state_nodes=[sn], session=session)
+        r2 = rs2.solve(make_pods(2, cpu="500m"))
+        assert [en.name for en in r2.existing_nodes] == ["live-node-1"]
+        assert not r2.new_nodeclaims
+        # removing the node flows through as a delete delta
+        rs3 = RemoteScheduler(rs.address, [pool], {"default": its},
+                              session=session)
+        r3 = rs3.solve(make_pods(2, cpu="500m"))
+        assert r3.new_nodeclaims and not r3.existing_nodes
+        session.close()
+
+    def test_session_host_fallback_relax(self, sidecar):
+        """Pods whose preferences must relax ride the host ladder server-side
+        over FULLY-SHARED specs (build_wire_pods): relaxation must not strip
+        siblings, and results must match the in-process solve."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        term = [NodeSelectorRequirement(api_labels.LABEL_TOPOLOGY_ZONE,
+                                        "In", ("no-such-zone",))]
+        pods = make_pods(4, cpu="500m", labels={"app": "px"},
+                         preferred_affinity=[(10, term)])
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        local = TensorScheduler([pool], {"default": its}).solve(
+            [p for p in pods])
+        rs, session = self._session_pair(sidecar, its, pool)
+        remote = rs.solve(pods)
+        assert remote.pod_errors == local.pod_errors == {}
+        assert sorted(len(nc.pods) for nc in remote.new_nodeclaims) == \
+            sorted(len(nc.pods) for nc in local.new_nodeclaims)
+        session.close()
+
+    def test_encode_pod_rows_dedup(self):
+        spread = [spread_zone(key="app", value="d0")]
+        a = [make_pod(cpu="500m", labels={"app": "d0"}, spread=spread,
+                      name=f"a-{i}") for i in range(5)]
+        b = [make_pod(cpu="250m", labels={"app": "d1"}, name=f"b-{i}")
+             for i in range(3)]
+        templates, tmpl_idx, ts = codec.encode_pod_rows(a + b)
+        assert len(templates) <= 3  # shared elements may still merge content
+        assert list(tmpl_idx[:5]) == [tmpl_idx[0]] * 5
+        assert list(tmpl_idx[5:]) == [tmpl_idx[5]] * 3
+        back = codec.build_wire_pods(templates, tmpl_idx, ts)
+        assert len(back) == 8
+        assert back[0].spec is back[1].spec  # fully shared spec per template
+        assert back[0]._row == 0 and back[7]._row == 7
+        assert back[0].requests() == a[0].requests()

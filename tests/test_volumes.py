@@ -238,3 +238,175 @@ class TestAttachLimits:
         assert len(env.store.list(Node)) == 2
         for p in env.store.list(Pod):
             assert p.spec.node_name
+
+
+class TestTensorVolumePath:
+    """Ephemeral-volume pods ride the TENSOR path (VERDICT r4 item 2):
+    per-pod claims linearize CSI attach limits into per-node caps, so the
+    blanket host demotion is lifted for the common dynamic-PVC shape."""
+
+    def _eph_pods(self, n, sc="sc", cpu="100m"):
+        ref = PVCRef(claim_name="scratch", ephemeral=True,
+                     storage_class_name=sc)
+        pods = []
+        for i in range(n):
+            p = make_pod(cpu=cpu, name=f"eph-{i}")
+            p.spec.volumes.append(ref)
+            pods.append(p)
+        return pods
+
+    def _env_cluster(self, env):
+        from karpenter_tpu.provisioning.provisioner import StateClusterView
+        from karpenter_tpu.state.cluster import Cluster
+        return StateClusterView(env.store, Cluster(env.store, env.clock))
+
+    def test_ephemeral_pods_stay_on_tensor_path(self, env):
+        from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+        from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        its = construct_instance_types()[:16]
+        ts = TensorScheduler([make_nodepool(name="default")],
+                             {"default": its},
+                             cluster=self._env_cluster(env))
+        r = ts.solve(self._eph_pods(6))
+        assert ts.fallback_reason == ""
+        assert ts.partition == (6, 0)  # no host stragglers
+        assert not r.pod_errors
+
+    def test_shared_pvc_still_demotes(self, env):
+        """Non-ephemeral claims keep set-dedup semantics only the host
+        oracle models; the partition must route them host-side."""
+        from karpenter_tpu.provisioning.grouping import partition_pods
+        pods = [make_volume_pod("shared-claim", cpu="100m")
+                for _ in range(3)]
+        groups, leftover, reason = partition_pods(pods)
+        assert not groups and len(leftover) == 3
+        assert "host-side" in reason
+
+    def test_attach_limit_parity_with_host_oracle(self, env):
+        """Existing node with a CSINode attach limit: tensor and host
+        solves place the same pods on the node and open the same number of
+        fresh nodes (volumeusage.go:201-208)."""
+        from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+        from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+        from factories import make_scheduler, make_state_node
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        sn = make_state_node("big-node", cpu="64", memory="256Gi",
+                             zone=KWOK_ZONES[0])
+        env.store.create(CSINode(
+            metadata=ObjectMeta(name="big-node", namespace=""),
+            drivers=[CSINodeDriver(name="ebs.csi", allocatable_count=2)]))
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        pods = self._eph_pods(5)
+        view = self._env_cluster(env)
+        ts = TensorScheduler([pool], {"default": its}, state_nodes=[sn],
+                             cluster=view)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == ""
+        assert not r.pod_errors
+        on_node = sum(len(en.pods) for en in r.existing_nodes)
+        assert on_node == 2  # capacity admits all 5; the attach limit gates
+        host = make_scheduler([pool], {"default": its}, pods,
+                              state_nodes=[sn], cluster=view)
+        hr = host.solve(pods)
+        host_on_node = sum(len(en.pods) for en in hr.existing_nodes)
+        assert host_on_node == on_node
+        assert len(hr.new_nodeclaims) == len(r.new_nodeclaims)
+
+    def test_groups_share_node_driver_budget(self, env):
+        """Two groups drawing on one driver: the node budget is shared, not
+        per-group (the limit is per node+driver, volumeusage.go:201-208)."""
+        from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+        from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+        from factories import make_state_node
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        sn = make_state_node("big-node", cpu="64", memory="256Gi",
+                             zone=KWOK_ZONES[0])
+        env.store.create(CSINode(
+            metadata=ObjectMeta(name="big-node", namespace=""),
+            drivers=[CSINodeDriver(name="ebs.csi", allocatable_count=3)]))
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        pods = (self._eph_pods(2, cpu="100m")
+                + self._eph_pods(2, cpu="200m"))
+        ts = TensorScheduler([pool], {"default": its}, state_nodes=[sn],
+                             cluster=self._env_cluster(env))
+        r = ts.solve(pods)
+        assert ts.fallback_reason == ""
+        assert not r.pod_errors
+        on_node = sum(len(en.pods) for en in r.existing_nodes)
+        assert on_node == 3  # two groups, ONE shared 3-slot budget
+
+    def test_attach_limits_over_the_wire(self, env):
+        """Sidecar session path: volume facts ride as state-node riders and
+        per-template driver counts; the server enforces the same caps."""
+        from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+        from karpenter_tpu.sidecar.client import RemoteScheduler, SolverSession
+        from karpenter_tpu.sidecar.server import serve
+        from factories import make_state_node
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        sn = make_state_node("big-node", cpu="64", memory="256Gi",
+                             zone=KWOK_ZONES[0])
+        env.store.create(CSINode(
+            metadata=ObjectMeta(name="big-node", namespace=""),
+            drivers=[CSINodeDriver(name="ebs.csi", allocatable_count=2)]))
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        pods = self._eph_pods(5)
+        server, port = serve()
+        try:
+            session = SolverSession(f"127.0.0.1:{port}")
+            rs = RemoteScheduler(f"127.0.0.1:{port}", [pool],
+                                 {"default": its}, state_nodes=[sn],
+                                 cluster=self._env_cluster(env),
+                                 session=session)
+            r = rs.solve(pods)
+            assert rs.fallback_reason == ""
+            assert not r.pod_errors
+            assert sum(len(en.pods) for en in r.existing_nodes) == 2
+            session.close()
+        finally:
+            server.stop(0)
+
+    def test_partition_seam_shares_attach_budget(self, env):
+        """Mixed batch: ephemeral pods (tensor side) + shared-PVC pods
+        (host side) against one limited node — the host pass must see the
+        slots the tensor pass consumed (no double-booking across the
+        partition seam)."""
+        from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+        from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+        from factories import make_state_node
+        env.store.create(StorageClass(
+            metadata=ObjectMeta(name="sc", namespace=""),
+            provisioner="ebs.csi"))
+        for i in range(2):
+            env.store.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"host-pvc-{i}", namespace="default"),
+                spec=PVCSpec(storage_class_name="sc")))
+        sn = make_state_node("big-node", cpu="64", memory="256Gi",
+                             zone=KWOK_ZONES[0])
+        env.store.create(CSINode(
+            metadata=ObjectMeta(name="big-node", namespace=""),
+            drivers=[CSINodeDriver(name="ebs.csi", allocatable_count=2)]))
+        its = construct_instance_types()[:16]
+        pool = make_nodepool(name="default")
+        pods = (self._eph_pods(2)
+                + [make_volume_pod(f"host-pvc-{i}", cpu="100m")
+                   for i in range(2)])
+        ts = TensorScheduler([pool], {"default": its}, state_nodes=[sn],
+                             cluster=self._env_cluster(env))
+        r = ts.solve(pods)
+        assert ts.partition == (2, 2)  # ephemeral tensor-side, shared host
+        assert not r.pod_errors
+        on_node = sum(len(en.pods) for en in r.existing_nodes)
+        assert on_node == 2  # limit 2: tensor takes both; host opens fresh
+        assert r.new_nodeclaims
