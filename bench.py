@@ -133,6 +133,43 @@ def _pods(hostport_pct: float = 0.0, pvc_pct: float = 0.0):
     return pods
 
 
+def _host_pods(n: int):
+    """A 100% host-path batch: every pod carries a distinct host port, so
+    the whole solve runs on the host oracle (per-pod conflict tracking).
+    This pins the floor of the tensor/host degradation envelope."""
+    from karpenter_tpu.api.objects import HostPort
+    req = res.parse_list({"cpu": "100m", "memory": "128Mi"})
+    return [Pod(
+        metadata=ObjectMeta(name=f"hp-{i}", namespace="default",
+                            labels={"app": f"hp-{i % 16}"}),
+        spec=PodSpec(host_ports=[HostPort(port=1000 + i % 60000)]),
+        container_requests=[req]) for i in range(n)]
+
+
+def bench_host_floor():
+    """100% host-fraction line (VERDICT r4 #3): the envelope floor."""
+    pods = _host_pods(N_PODS)
+    ts = _scheduler(0)
+    r = ts.solve(pods)
+    assert ts.partition == (0, len(pods)), ts.partition
+    assert not r.pod_errors
+    best = float("inf")
+    for _ in range(max(1, REPEATS - 1)):
+        ts = _scheduler(0)
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
+                   "144 instance types, 100% host-port pods (pure host-"
+                   "oracle floor of the degradation envelope)"),
+        "value": round(len(pods) / best, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(len(pods) / best / 100.0, 2),
+        "seconds": round(best, 3),
+    }), flush=True)
+
+
 def _catalog(n_its=None):
     n = N_ITS if n_its is None else n_its
     return construct_catalog(n) if n else construct_instance_types()
@@ -588,6 +625,13 @@ def main():
         mix_desc="reference benchmark pod mix + 15% ephemeral-PVC pods "
                  "(dynamic provisioning, tensor path end to end)")),
         flush=True)
+    # the tensor/host degradation envelope (VERDICT r4 #3): 10% host
+    # fraction and the pure-host floor, alongside the 1% line above
+    print(json.dumps(bench_provisioning(
+        _pods(hostport_pct=10.0), 0, mixed=True,
+        mix_desc="reference benchmark pod mix + 10% host-port stragglers "
+                 "(partitioned tensor+host solve)")), flush=True)
+    bench_host_floor()
     if MODE == "all":
         # mesh first: the multichip-at-scale line is the one the budget
         # gate must never sacrifice

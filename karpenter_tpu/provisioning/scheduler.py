@@ -77,9 +77,19 @@ class InFlightNodeClaim:
         self.topology = topology
         self.host_port_usage = HostPortUsage()
         self.pods: List[Pod] = []
+        # (sig, ok_ids): instance types passing the requirements-only checks
+        # for the last-added pod signature. Claim requirements only TIGHTEN
+        # and adding an identical pod-requirement set tightens nothing, so
+        # for successive same-signature adds (with no topology-derived
+        # requirements) compat/offering verdicts are invariant — only the
+        # fits check moves as requests accumulate. This collapses the
+        # reference's per-pod re-filter (nodeclaim.go:108-117) to a
+        # fits-only pass on the deployment-stamped hot path.
+        self._compat_cache: Optional[tuple] = None
 
     def add(self, pod: Pod, pod_requests: dict,
-            pod_reqs: Optional[Requirements] = None) -> Optional[str]:
+            pod_reqs: Optional[Requirements] = None,
+            sig=None) -> Optional[str]:
         """Returns an error string, or None on success (nodeclaim.go:67-122)."""
         errs = scheduling_taints.tolerates(self.template.taints, pod)
         if errs:
@@ -111,11 +121,37 @@ class InFlightNodeClaim:
         nodeclaim_requirements.add(*topo_reqs.values())
 
         requests = res.merge(self.requests, pod_requests)
-        remaining, reason = filter_instance_types(
-            self.instance_type_options, nodeclaim_requirements, requests)
-        if not remaining:
-            return (f"no instance type satisfied resources "
-                    f"{res.merge(self.daemon_resources, pod_requests)} and requirements ({reason})")
+        remaining = None
+        cacheable = sig is not None and not self.topology.last_add_tightened
+        if cacheable and self._compat_cache is not None \
+                and self._compat_cache[0] == sig:
+            ok = self._compat_cache[1]
+            fast = [it for it in self.instance_type_options
+                    if id(it) in ok and res.fits(requests, it.allocatable())]
+            if fast and nodeclaim_requirements.has_min_values():
+                _, err = satisfies_min_values(fast, nodeclaim_requirements)
+                if err is not None:
+                    fast = []
+            if fast:
+                remaining = fast
+            # empty fast result: fall through to the full filter for the
+            # exact failure-attribution message
+        if remaining is None:
+            ok_ids: Optional[set] = set() if cacheable else None
+            remaining, reason = filter_instance_types(
+                self.instance_type_options, nodeclaim_requirements, requests,
+                ok_ids=ok_ids)
+            if not remaining:
+                return (f"no instance type satisfied resources "
+                        f"{res.merge(self.daemon_resources, pod_requests)} and requirements ({reason})")
+            if cacheable:
+                self._compat_cache = (sig, ok_ids)
+
+        if not cacheable:
+            # this add may have tightened requirements in ways the cached
+            # verdicts don't reflect (different signature / topology-derived
+            # requirements): drop the cache rather than serve stale compat
+            self._compat_cache = None
 
         self.pods.append(pod)
         self.instance_type_options = remaining
@@ -264,9 +300,13 @@ class ExistingNode:
 
 
 def filter_instance_types(instance_types: List[InstanceType], requirements: Requirements,
-                          requests: dict):
+                          requests: dict, ok_ids: Optional[set] = None):
     """Per-IT compat x fits x offering filter with failure attribution
-    (nodeclaim.go:248-293 + FailureReason :182-245)."""
+    (nodeclaim.go:248-293 + FailureReason :182-245). When `ok_ids` is
+    given, it is filled with id(it) of every type passing the
+    requirements-only checks (compat AND offering, regardless of fits) —
+    the claim-side cache that lets successive same-signature adds skip the
+    requirement re-evaluation (only fits changes as requests accumulate)."""
     remaining = []
     any_compat = any_fits = any_offer = False
     compat_and_fits = compat_and_offer = fits_and_offer = False
@@ -280,6 +320,8 @@ def filter_instance_types(instance_types: List[InstanceType], requirements: Requ
         compat_and_fits |= compat and fits_ and not offer
         compat_and_offer |= compat and offer and not fits_
         fits_and_offer |= fits_ and offer and not compat
+        if compat and offer and ok_ids is not None:
+            ok_ids.add(id(it))
         if compat and fits_ and offer:
             remaining.append(it)
     if requirements.has_min_values() and remaining:
@@ -317,10 +359,11 @@ class Queue:
     """Pod retry queue with progress detection (queue.go:31-74)."""
 
     def __init__(self, pods: List[Pod], pod_requests: Dict[str, dict]):
-        self.pods = sorted(pods, key=lambda p: (
+        from collections import deque
+        self.pods = deque(sorted(pods, key=lambda p: (
             -pod_requests[p.uid].get(res.CPU, 0),
             -pod_requests[p.uid].get(res.MEMORY, 0),
-            p.metadata.creation_timestamp, p.uid))
+            p.metadata.creation_timestamp, p.uid)))
         self.last_len: Dict[str, int] = {}
 
     def pop(self):
@@ -329,7 +372,7 @@ class Queue:
         p = self.pods[0]
         if self.last_len.get(p.uid) == len(self.pods):
             return None
-        self.pods.pop(0)
+        self.pods.popleft()
         return p
 
     def push(self, pod: Pod, relaxed: bool) -> None:
@@ -401,6 +444,9 @@ class Scheduler:
         # pod_requirements(pod) is pure until relax() mutates the pod; memo
         # per uid saves rebuilding it on every claim attempt of the scan loop
         self._cached_pod_reqs: Dict[str, Requirements] = {}
+        # content signatures backing the claims' compat caches; invalidated
+        # together with _cached_pod_reqs when relax() mutates a pod
+        self._pod_sigs: Dict[str, tuple] = {}
         self._calculate_existing_nodes(state_nodes)
 
     def _calculate_existing_nodes(self, state_nodes) -> None:
@@ -432,6 +478,9 @@ class Scheduler:
         for p in pods:
             self.cached_pod_requests[p.uid] = p.requests()
         q = Queue(pods, self.cached_pod_requests)
+        # establish the fewest-pods-first invariant once; _add maintains it
+        # incrementally afterwards (stable-sort-equivalent repositioning)
+        self.new_nodeclaims.sort(key=lambda n: len(n.pods))
         while True:
             pod = q.pop()
             if pod is None:
@@ -445,11 +494,50 @@ class Scheduler:
             q.push(pod, relaxed)
             if relaxed:
                 self._cached_pod_reqs.pop(pod.uid, None)
+                self._pod_sigs.pop(pod.uid, None)
                 self.topology.update(pod)
         for nc in self.new_nodeclaims:
             nc.finalize()
         return Results(new_nodeclaims=self.new_nodeclaims,
                        existing_nodes=self.existing_nodes, pod_errors=errors)
+
+    def _pod_sig(self, pod: Pod, pod_reqs: Requirements,
+                 pod_requests: dict):
+        """Content signature over everything the claim compat cache depends
+        on: requirement set, request vector, tolerations. Pods sharing a
+        signature get identical taints/compat/offering verdicts from a
+        claim in a given state."""
+        sig = self._pod_sigs.get(pod.uid)
+        if sig is None:
+            from .grouping import _req_signature
+            sig = (_req_signature(pod_reqs),
+                   tuple(sorted(pod_requests.items())),
+                   tuple(pod.spec.tolerations))
+            self._pod_sigs[pod.uid] = sig
+        return sig
+
+    def _reposition(self, idx: int) -> None:
+        """Restore sorted order after claims[idx] grew by one pod — the
+        stable-sort-equivalent move: past every claim with a smaller count,
+        before existing claims of the new count (they were later in the
+        pre-sort order)."""
+        claims = self.new_nodeclaims
+        L = len(claims[idx].pods)
+        j = idx
+        while j + 1 < len(claims) and len(claims[j + 1].pods) < L:
+            j += 1
+        if j != idx:
+            claims.insert(j, claims.pop(idx))
+
+    def _insert_sorted(self, nc: "InFlightNodeClaim") -> None:
+        """Append-equivalent of the stable sort: a fresh claim lands after
+        existing claims with <= its count and before any larger."""
+        claims = self.new_nodeclaims
+        L = len(nc.pods)
+        j = len(claims)
+        while j > 0 and len(claims[j - 1].pods) > L:
+            j -= 1
+        claims.insert(j, nc)
 
     def _add(self, pod: Pod) -> Optional[str]:
         """scheduler.go:267-315: existing nodes -> in-flight claims (fewest pods
@@ -459,12 +547,13 @@ class Scheduler:
         if pod_reqs is None:
             pod_reqs = pod_requirements(pod)
             self._cached_pod_reqs[pod.uid] = pod_reqs
+        sig = self._pod_sig(pod, pod_reqs, pod_requests)
         for node in self.existing_nodes:
             if node.add(pod, pod_requests, pod_reqs) is None:
                 return None
-        self.new_nodeclaims.sort(key=lambda n: len(n.pods))
-        for nc in self.new_nodeclaims:
-            if nc.add(pod, pod_requests, pod_reqs) is None:
+        for i, nc in enumerate(self.new_nodeclaims):
+            if nc.add(pod, pod_requests, pod_reqs, sig=sig) is None:
+                self._reposition(i)
                 return None
         errs = []
         for i, nct in enumerate(self.templates):
@@ -477,12 +566,12 @@ class Scheduler:
                     errs.append(f'all available instance types exceed limits for nodepool: "{nct.nodepool_name}"')
                     continue
             nc = InFlightNodeClaim(nct, self.topology, self.daemon_overhead[i], instance_types)
-            err = nc.add(pod, pod_requests, pod_reqs)
+            err = nc.add(pod, pod_requests, pod_reqs, sig=sig)
             if err is not None:
                 nc.destroy()
                 errs.append(f'incompatible with nodepool "{nct.nodepool_name}", {err}')
                 continue
-            self.new_nodeclaims.append(nc)
+            self._insert_sorted(nc)
             if remaining is not None:
                 self.remaining_resources[nct.nodepool_name] = _subtract_max(
                     remaining, nc.instance_type_options)

@@ -294,8 +294,13 @@ class Topology:
                          node_requirements: Requirements, pod: Pod,
                          allow_undefined: frozenset = frozenset()):
         """Tighten node requirements with topology domain selections; returns
-        (Requirements, None) or (None, error) (topology.go:166-188)."""
+        (Requirements, None) or (None, error) (topology.go:166-188). Sets
+        `self.last_add_tightened` (valid until the next call — the solve is
+        single-threaded) so callers can tell whether any topology group
+        actually constrained this pod: a non-tightening result depends only
+        on the inputs, which backs the claims' compat cache."""
         requirements = Requirements(node_requirements.values())
+        self.last_add_tightened = False
         for tg in self._matching_topologies(pod, node_requirements, allow_undefined):
             pod_domains = pod_requirements.get(tg.key)
             node_domains = node_requirements.get(tg.key)
@@ -304,6 +309,7 @@ class Topology:
                 return None, (f"unsatisfiable topology constraint for {tg.type}, "
                               f"key={tg.key}")
             requirements.add(domains)
+            self.last_add_tightened = True
         return requirements, None
 
     def register(self, topology_key: str, domain: str) -> None:

@@ -138,6 +138,7 @@ class SolverSession:
         diff would see nothing to resend)."""
         sig = self._catalog_signature(nodepools, instance_types)
         recreate = self._session_id is None
+        key = None
         if not recreate and sig != self._id_sig:
             key = self._content_digest(nodepools, instance_types)
             recreate = key != self._content_key
@@ -148,7 +149,9 @@ class SolverSession:
             self._session_id = resp["session"]
             self._state_sent = {}
             self._ds_sent = None
-            self._content_key = self._content_digest(nodepools, instance_types)
+            self._content_key = (key if key is not None else
+                                 self._content_digest(nodepools,
+                                                      instance_types))
         self._id_sig = sig
         self._id_refs = (list(nodepools), dict(instance_types))
         header: dict = {"session": self._session_id}
@@ -182,21 +185,26 @@ class SolverSession:
             nodepools, instance_types, state_nodes, daemonset_pods,
             store=store)
         templates, tmpl_idx, ts = codec.encode_pod_rows(pods)
-        if store is not None and any(t.get("volumes") for t in templates):
+        vol_templates = ({t for t, d in enumerate(templates)
+                          if d.get("volumes")} if store is not None else set())
+        if vol_templates:
             # pre-resolve volume->CSI-driver counts per template: the server
             # has no store to run the PVC/StorageClass resolution
             # (volumeusage.go:83-151)
             from ..scheduling.volumeusage import get_volumes
             probes: dict = {}
+            need = set(vol_templates)
             for i, t in enumerate(tmpl_idx.tolist()):
-                if t not in probes:
+                if t in need:
                     probes[t] = pods[i]
-            for t, d in enumerate(templates):
-                if d.get("volumes"):
-                    counts = {dr: len(keys) for dr, keys
-                              in get_volumes(store, probes[t]).items()}
-                    if counts:
-                        d["volume_drivers"] = counts
+                    need.discard(t)
+                    if not need:
+                        break
+            for t in vol_templates:
+                counts = {dr: len(keys) for dr, keys
+                          in get_volumes(store, probes[t]).items()}
+                if counts:
+                    templates[t]["volume_drivers"] = counts
         header["templates"] = templates
         if cluster is not None:
             header["cluster"] = codec.cluster_view_to_dict(cluster, pods)
@@ -218,8 +226,8 @@ class SolverSession:
             else:
                 raise
         commit()
-        catalog = _union_catalog(instance_types)
-        return decode_results_rows(response, pods, catalog)
+        return decode_results_rows(response, pods,
+                                   codec.union_catalog(instance_types))
 
 
 def _freeze(obj):
@@ -229,10 +237,6 @@ def _freeze(obj):
     if isinstance(obj, (list, tuple)):
         return tuple(_freeze(v) for v in obj)
     return obj
-
-
-def _union_catalog(instance_types) -> list:
-    return codec.union_catalog(instance_types)
 
 
 def _stamp_api_claim(proto, name: str):

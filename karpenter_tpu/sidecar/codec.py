@@ -416,7 +416,9 @@ def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
     out = []
     meta_new = ObjectMeta.__new__
     pod_new = Pod.__new__
-    # numpy iteration yields boxed scalars; plain lists are ~3x faster here
+    # numpy iteration yields boxed scalars; plain lists are ~3x faster here.
+    # Callers that already hold the list form (server prebucketing) pass it
+    # directly so the 50k-row conversion happens once.
     tmpl_list = tmpl_idx.tolist() if hasattr(tmpl_idx, "tolist") else tmpl_idx
     ts_list = ts.tolist() if hasattr(ts, "tolist") else ts
     for i, (t, created) in enumerate(zip(tmpl_list, ts_list)):

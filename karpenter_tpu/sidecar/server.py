@@ -90,9 +90,9 @@ def _solve_session(request: bytes, context=None) -> bytes:
             context.abort(grpc.StatusCode.NOT_FOUND, f"unknown session {sid}")
         raise KeyError(f"unknown session {sid}")
 
-    tmpl_idx = wire.unpack_u32(blobs["tmpl_idx"])
+    tmpl_list = wire.unpack_u32(blobs["tmpl_idx"]).tolist()
     ts = wire.unpack_f64(blobs["ts"])
-    pods = codec.build_wire_pods(header["templates"], tmpl_idx, ts)
+    pods = codec.build_wire_pods(header["templates"], tmpl_list, ts)
 
     with session.lock:
         for d in header.get("state_upsert", ()):
@@ -114,8 +114,7 @@ def _solve_session(request: bytes, context=None) -> bytes:
     # the wire's template column already buckets identical-spec pods:
     # hand the buckets to partition_pods so grouping is O(templates)
     buckets: List[list] = [[] for _ in header["templates"]]
-    tl = tmpl_idx.tolist()
-    for p, t in zip(pods, tl):
+    for p, t in zip(pods, tmpl_list):
         buckets[t].append(p)
     results = ts_sched.solve(pods, prebuckets=buckets)
     return codec.encode_solve_response_rows(
