@@ -34,6 +34,10 @@ class Event:
     obj: object
 
 
+class InvalidError(Exception):
+    """Admission rejection — the apiserver's 422 (kube/admission.py)."""
+
+
 class ConflictError(Exception):
     """Object already exists on create / vanished on update."""
 
@@ -93,6 +97,11 @@ class Store:
         k = _key(obj)
         if k in coll:
             raise ConflictError(f"{kind.__name__} {k} already exists")
+        from . import admission
+        errs = admission.validate(obj)
+        if errs:
+            raise InvalidError(f"{kind.__name__} {k} is invalid: "
+                               + "; ".join(errs))
         if not obj.metadata.creation_timestamp:
             obj.metadata.creation_timestamp = self.clock.now()
         self._bump(obj)
@@ -124,6 +133,12 @@ class Store:
         k = _key(obj)
         if k not in coll:
             raise NotFoundError(f"{kind.__name__} {k} not found")
+        old = coll[k]
+        from . import admission
+        errs = admission.validate(obj, old if old is not obj else None)
+        if errs:
+            raise InvalidError(f"{kind.__name__} {k} is invalid: "
+                               + "; ".join(errs))
         self._bump(obj)
         coll[k] = obj
         if obj.metadata.uid:
@@ -173,16 +188,19 @@ class Store:
                      "NodeClaim", "Node", "PodDisruptionBudget")
 
     def save(self, path: str) -> int:
-        """Atomic snapshot (tmp + rename). Returns objects written."""
+        """Atomic snapshot (tmp + rename) in the versioned JSON wire format
+        (kube/snapshot.py) — stable across code upgrades, unlike pickle.
+        Returns objects written."""
         import os
-        import pickle
         import tempfile
-        data = {"objs": self._objs, "rv": self._rv}
+
+        from . import snapshot
+        payload = snapshot.dump(self._objs, self._rv)
         d = os.path.dirname(os.path.abspath(path))
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".store-")
         try:
             with os.fdopen(fd, "wb") as f:
-                pickle.dump(data, f)
+                f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())  # a crash must not truncate the snapshot
             os.replace(tmp, path)
@@ -196,10 +214,20 @@ class Store:
         """Replay a snapshot: existing keys are kept (live state wins), new
         objects are announced as ADDED in dependency order (pools/claims/
         nodes before pods) so the cluster cache rebuilds coherently. Returns
-        objects restored."""
-        import pickle
+        objects restored. Reads the versioned JSON format; legacy pickle
+        snapshots (pre-format upgrades) still restore."""
+        from . import snapshot
         with open(path, "rb") as f:
-            data = pickle.load(f)
+            raw = f.read()
+        if raw[:1] == b"{":
+            objects, rv = snapshot.load(raw)
+            by_kind: Dict[type, dict] = {}
+            for obj in objects:
+                by_kind.setdefault(type(obj), {})[_key(obj)] = obj
+            data = {"objs": by_kind, "rv": rv}
+        else:
+            import pickle
+            data = pickle.loads(raw)
         kinds = sorted(data["objs"],
                        key=lambda k: (self._REPLAY_ORDER.index(k.__name__)
                                       if k.__name__ in self._REPLAY_ORDER

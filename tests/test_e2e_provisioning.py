@@ -260,3 +260,101 @@ class TestDeletingNodeCarryover:
         env.clock.step(31)
         settle(env)
         assert env.store.get(Node, node.name) is None
+
+
+class TestBindTimeTaintCheck:
+    """VERDICT r4 #8: a node tainted between nomination and bind must not
+    receive the pod — the kube-scheduler the reference delegates binding to
+    honors taints at bind time."""
+
+    def _provision_until_registered(self, env):
+        """Run everything EXCEPT the binder until the node is registered."""
+        for _ in range(6):
+            env.mgr.run_until_quiet()
+            env.clock.step(1.1)
+        env.mgr.run_until_quiet()
+
+    def test_disrupt_between_nominate_and_bind(self):
+        from karpenter_tpu.api.objects import Taint
+        clock = FakeClock()
+        store = Store(clock)
+        cluster = Cluster(store, clock)
+        wire_informers(store, cluster)
+        provider = KwokCloudProvider(store=store)
+        mgr = Manager(store, clock)
+        provisioner = Provisioner(store, cluster, provider, clock)
+        binder = Binder(store, cluster, provisioner)
+        # binder deliberately NOT registered: the test controls bind timing
+        mgr.register(provisioner, PodTrigger(provisioner),
+                     NodeClaimLifecycle(store, cluster, provider, clock))
+
+        class E:
+            pass
+        env = E()
+        env.mgr, env.clock = mgr, clock
+
+        store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        store.create(pod)
+        self._provision_until_registered(env)
+        nodes = store.list(Node)
+        assert len(nodes) == 1
+        assert provisioner.nominations, "expected a nomination"
+        assert not store.get(Pod, pod.name, pod.namespace).spec.node_name
+
+        # the disruption controller taints the node before the bind lands
+        node = nodes[0]
+        node.spec.taints = list(node.spec.taints) + [
+            Taint(key=api_labels.DISRUPTED_TAINT_KEY, effect="NoSchedule")]
+        store.update(node)
+
+        binder.reconcile()
+        live = store.get(Pod, pod.name, pod.namespace)
+        assert not live.spec.node_name, \
+            "pod bound onto a disrupted node (stale-bind race)"
+        assert not provisioner.nominations  # dropped, pod back in the pool
+
+        # the re-plan nominates a fresh node and the bind succeeds there
+        self._provision_until_registered(env)
+        binder.reconcile()
+        live = store.get(Pod, pod.name, pod.namespace)
+        assert live.spec.node_name
+        bound_node = store.get(Node, live.spec.node_name)
+        assert not any(t.key == api_labels.DISRUPTED_TAINT_KEY
+                       for t in bound_node.spec.taints)
+
+    def test_startup_taints_do_not_block_bind(self):
+        """The claim's own startup taints clear during initialization; they
+        must not bounce the nomination (that would re-plan forever)."""
+        from karpenter_tpu.api.objects import Taint
+        clock = FakeClock()
+        store = Store(clock)
+        cluster = Cluster(store, clock)
+        wire_informers(store, cluster)
+        provider = KwokCloudProvider(store=store)
+        mgr = Manager(store, clock)
+        provisioner = Provisioner(store, cluster, provider, clock)
+        binder = Binder(store, cluster, provisioner)
+        mgr.register(provisioner, PodTrigger(provisioner),
+                     NodeClaimLifecycle(store, cluster, provider, clock))
+
+        class E:
+            pass
+        env = E()
+        env.mgr, env.clock = mgr, clock
+
+        store.create(make_nodepool(
+            name="default",
+            startup_taints=[Taint(key="example.com/agent-not-ready",
+                                  effect="NoSchedule")]))
+        pod = make_pod(cpu="500m")
+        store.create(pod)
+        self._provision_until_registered(env)
+        # re-add the startup taint as if initialization hadn't cleared it yet
+        node = store.list(Node)[0]
+        node.spec.taints = list(node.spec.taints) + [
+            Taint(key="example.com/agent-not-ready", effect="NoSchedule")]
+        store.update(node)
+        binder.reconcile()
+        live = store.get(Pod, pod.name, pod.namespace)
+        assert live.spec.node_name  # startup taint didn't bounce the bind

@@ -217,3 +217,49 @@ class TestDebugEndpoints:
                 assert e.code == 404
         finally:
             off.stop_serving()
+
+    def test_debug_profile_samples_busy_thread(self):
+        """curl :PORT/debug/profile?seconds=N returns a usable sampling
+        profile (folded stacks incl. the busy function) — VERDICT r4 #10."""
+        import threading
+        import urllib.request
+
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+
+        stop = threading.Event()
+
+        def busy_spinning_loop():
+            x = 0
+            while not stop.is_set():
+                x += 1
+            return x
+
+        t = threading.Thread(target=busy_spinning_loop, daemon=True,
+                             name="busy-worker")
+        t.start()
+        op = Operator(options=Options(metrics_port=0, health_probe_port=0,
+                                      enable_profiling=True))
+        op.start_serving()
+        try:
+            base = f"http://127.0.0.1:{op.serving.metrics_port}"
+            body = urllib.request.urlopen(
+                f"{base}/debug/profile?seconds=0.4", timeout=15).read()
+            text = body.decode()
+            assert "folded stacks" in text
+            assert "busy_spinning_loop" in text
+            # folded format: semicolon-joined frames, trailing sample count
+            line = next(l for l in text.splitlines()
+                        if "busy_spinning_loop" in l)
+            assert line.rsplit(" ", 1)[1].isdigit()
+            # bad input is a 400, not a crash
+            import urllib.error
+            try:
+                urllib.request.urlopen(f"{base}/debug/profile?seconds=x",
+                                       timeout=5)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            stop.set()
+            op.stop_serving()

@@ -147,6 +147,11 @@ class TestTaintTable:
 
 class TestOperatorLevelRejection:
     def test_nodepool_condition_set_false(self):
+        """Runtime validation catches what admission can't: duplicate taint
+        Key/Effect pairs aren't schema-expressible, so the apiserver admits
+        them and the validation controller flags the condition
+        (validation/controller.go:51-76)."""
+        from karpenter_tpu.api.objects import Taint
         from karpenter_tpu.controllers.nodepool_aux import (
             COND_VALIDATION_SUCCEEDED, NodePoolValidation)
         from karpenter_tpu.kube.store import Store
@@ -154,13 +159,14 @@ class TestOperatorLevelRejection:
         store = Store(FakeClock())
         pool = make_nodepool(
             name="bad",
-            requirements=[req("kubernetes.io/custom", "In", ["x"])])
-        store.create(pool)
+            taints=[Taint(key="example.com/k", effect="NoSchedule"),
+                    Taint(key="example.com/k", effect="NoSchedule")])
+        store.create(pool)  # schema admits duplicate taints
         NodePoolValidation(store).reconcile(pool)
         cond = next(c for c in pool.status.conditions
                     if c["type"] == COND_VALIDATION_SUCCEEDED)
         assert cond["status"] == "False"
-        assert "restricted" in cond["message"]
+        assert "duplicate" in cond["message"]
 
     def test_nodepool_condition_true_when_valid(self):
         from karpenter_tpu.controllers.nodepool_aux import (
@@ -176,3 +182,131 @@ class TestOperatorLevelRejection:
         cond = next(c for c in pool.status.conditions
                     if c["type"] == COND_VALIDATION_SUCCEEDED)
         assert cond["status"] == "True"
+
+class TestStoreAdmission:
+    """VERDICT r4 #6: the store enforces the CRD schema at create/update —
+    a malformed NodePool/NodeClaim is rejected the way the reference's
+    apiserver rejects it (karpenter.sh_nodepools.yaml CEL + patterns,
+    nodeclaim_validation.go battery's schema subset)."""
+
+    def _store(self):
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        return Store(FakeClock())
+
+    def _rejects(self, store, obj, needle=""):
+        from karpenter_tpu.kube.store import InvalidError
+        import pytest as _pytest
+        with _pytest.raises(InvalidError) as ei:
+            store.create(obj)
+        assert needle in str(ei.value)
+
+    def test_accept_reject_table(self):
+        """The accept/reject table from nodeclaim_validation.go:1-151's
+        schema-enforced subset, driven against Store.create."""
+        from karpenter_tpu.api import labels as api_labels
+        store = self._store()
+        # accepted shapes
+        store.create(make_nodepool(name="ok-plain"))
+        store.create(make_nodepool(
+            name="ok-reqs",
+            requirements=[req(api_labels.LABEL_ARCH, "In", ["amd64"]),
+                          req("example.com/team", "NotIn", ["infra"]),
+                          req("example.com/gen", "Gt", ["3"]),
+                          req("example.com/feature", "Exists", [])]))
+        # rejected shapes
+        self._rejects(self._store(), make_nodepool(
+            name="bad-op",
+            requirements=[req(api_labels.LABEL_ARCH, "Weird", ["x"])]),
+            "unsupported operator")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-in-empty",
+            requirements=[req(api_labels.LABEL_ARCH, "In", [])]),
+            "must have a value")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-gt",
+            requirements=[req("example.com/gen", "Gt", ["three"])]),
+            "single positive integer")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-gt-neg",
+            requirements=[req("example.com/gen", "Lt", ["-3"])]),
+            "single positive integer")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-restricted",
+            requirements=[req("kubernetes.io/custom", "In", ["x"])]),
+            "restricted")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-nodepool-label",
+            requirements=[req(api_labels.NODEPOOL_LABEL_KEY, "In", ["x"])]),
+            "restricted")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-key",
+            requirements=[req("-bad-key-", "In", ["x"])]),
+            "qualified name")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-value",
+            requirements=[req("example.com/t", "In", ["bad value!"])]),
+            "label value")
+        self._rejects(self._store(), make_nodepool(
+            name="bad-exists-values",
+            requirements=[req("example.com/t", "Exists", ["x"])]),
+            "forbids values")
+
+    def test_minvalues_rules(self):
+        from karpenter_tpu.api import labels as api_labels
+        r = req(api_labels.LABEL_ARCH, "In", ["amd64"], min_values=2)
+        self._rejects(self._store(), make_nodepool(
+            name="bad-minvalues", requirements=[r]), "minimum number")
+        r2 = req(api_labels.LABEL_ARCH, "In", ["amd64", "arm64"],
+                 min_values=51)
+        self._rejects(self._store(), make_nodepool(
+            name="bad-minvalues-51", requirements=[r2]), "between 1 and 50")
+
+    def test_nodepool_field_bounds(self):
+        from karpenter_tpu.api.nodepool import Budget
+        pool = make_nodepool(name="bad-weight")
+        pool.spec.weight = 101
+        self._rejects(self._store(), pool, "between 1 and 100")
+        pool = make_nodepool(name="bad-budget")
+        pool.spec.disruption.budgets = [Budget(nodes="150%")]
+        self._rejects(self._store(), pool, "absolute count")
+        pool = make_nodepool(name="bad-budget-sched")
+        pool.spec.disruption.budgets = [Budget(nodes="10%",
+                                               schedule="0 9 * * 1")]
+        self._rejects(self._store(), pool, "'schedule' must be set with")
+        pool = make_nodepool(name="ok-budget")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="10%", schedule="0 9 * * 1", duration=3600.0)]
+        self._store().create(pool)
+
+    def test_nodeclaim_admission_and_spec_immutability(self):
+        import dataclasses
+        from karpenter_tpu.api import labels as api_labels
+        from karpenter_tpu.api.nodeclaim import NodeClaim, NodeClaimSpec
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.kube.store import InvalidError
+        from karpenter_tpu.provisioning.scheduler import _SelectorReq
+        store = self._store()
+        nc = NodeClaim(
+            metadata=ObjectMeta(name="nc-ok", namespace=""),
+            spec=NodeClaimSpec(requirements=[
+                _SelectorReq(api_labels.LABEL_ARCH, "In", ("amd64",))]))
+        store.create(nc)
+        # status/condition updates on the SAME object are fine
+        nc.status.provider_id = "t://x"
+        store.update(nc)
+        # a replacement object with a mutated spec is rejected
+        clone = NodeClaim(
+            metadata=ObjectMeta(name="nc-ok", namespace="",
+                                uid=nc.metadata.uid),
+            spec=NodeClaimSpec(requirements=[
+                _SelectorReq(api_labels.LABEL_ARCH, "In", ("arm64",))]))
+        with pytest.raises(InvalidError) as ei:
+            store.update(clone)
+        assert "immutable" in str(ei.value)
+        bad = NodeClaim(
+            metadata=ObjectMeta(name="nc-bad", namespace=""),
+            spec=NodeClaimSpec(requirements=[
+                _SelectorReq("kubernetes.io/custom", "In", ("x",))]))
+        with pytest.raises(InvalidError):
+            store.create(bad)
