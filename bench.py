@@ -567,6 +567,114 @@ def bench_mesh_local():
     }), flush=True)
 
 
+def bench_mesh_headroom_local():
+    """Mesh HEADROOM (VERDICT r4 #7): a 2x-north-star, group-heavy problem
+    (defaults 100k pods x 4000 instance types x 2000 distinct groups)
+    sharded over the mesh vs single-device, plus the compiler's own memory
+    analysis — per-device peak bytes sharded vs single-device — since the
+    point of the mesh is lifting the one-chip memory ceiling, not CPU
+    wall-clock."""
+    import jax
+
+    from karpenter_tpu.ops import binpack
+    from karpenter_tpu.parallel.mesh import (CATALOG_AXIS, GROUPS_AXIS,
+                                             _arg_shardings, _out_shardings,
+                                             make_solver_mesh, pad_problem)
+    from karpenter_tpu.provisioning.grouping import group_pods
+
+    assert len(jax.devices()) >= MESH_DEVICES, jax.devices()
+    mesh = make_solver_mesh(MESH_DEVICES)
+    pods = _pods()
+    groups, reason = group_pods(pods)
+    assert groups is not None, reason
+    n_its = N_ITS or 4000
+    ts = _scheduler(n_its)
+    problem, _, _ = ts.build_problem(groups)
+
+    def peak_bytes(compiled) -> int:
+        m = compiled.memory_analysis()
+        return int(m.temp_size_in_bytes + m.argument_size_in_bytes
+                   + m.output_size_in_bytes)
+
+    args, statics = binpack.device_args(problem)
+    single_exe = jax.jit(
+        lambda *a: binpack.precompute_kernel(*a, **statics)).lower(
+        *args).compile()
+    single_peak = peak_bytes(single_exe)
+    padded, _, _ = pad_problem(problem, mesh.shape[GROUPS_AXIS],
+                               mesh.shape[CATALOG_AXIS])
+    pargs, pstatics = binpack.device_args(padded)
+    sharded_exe = jax.jit(
+        lambda *a: binpack.precompute_kernel(*a, **pstatics),
+        in_shardings=_arg_shardings(mesh),
+        out_shardings=_out_shardings(mesh)).lower(*pargs).compile()
+    sharded_peak = peak_bytes(sharded_exe)
+
+    def timed(mesh_or_none):
+        best, results = float("inf"), None
+        for _ in range(max(2, REPEATS)):  # first pass warms the jit cache
+            s = _scheduler(n_its)
+            s.mesh = mesh_or_none
+            t0 = time.perf_counter()
+            results = s.solve(pods)
+            best = min(best, time.perf_counter() - t0)
+            assert s.fallback_reason == "", s.fallback_reason
+        return best, results
+
+    t_single, r_single = timed(None)
+    t_mesh, r_mesh = timed(mesh)
+    key = lambda nc: (tuple(it.name for it in nc.instance_type_options),
+                      len(nc.pods))
+    assert sorted(map(key, r_mesh.new_nodeclaims)) == \
+        sorted(map(key, r_single.new_nodeclaims))
+    assert r_mesh.pod_errors == r_single.pod_errors
+    print(json.dumps({
+        "metric": (f"mesh headroom: {len(pods)} pods x {n_its} instance "
+                   f"types x {len(groups)} groups on a {MESH_DEVICES}-device "
+                   f"mesh — per-device peak bytes vs single device "
+                   f"[platform={jax.devices()[0].platform}]"),
+        "value": round(single_peak / max(1, sharded_peak), 2),
+        "unit": "x less per-device memory",
+        "vs_baseline": round(single_peak / max(1, sharded_peak), 2),
+        "seconds": round(t_mesh, 3),
+        "single_device_seconds": round(t_single, 3),
+        "single_device_peak_bytes": single_peak,
+        "per_device_peak_bytes_sharded": sharded_peak,
+        "exact_match_vs_single_device": True,
+    }), flush=True)
+
+
+def bench_mesh_headroom():
+    """bench_mesh_headroom_local under a virtual MESH_DEVICES-device CPU
+    platform (single-chip driver box), at the headroom problem size."""
+    import jax
+
+    from __graft_entry__ import run_under_virtual_devices
+
+    code = (
+        "import bench\n"
+        "bench.N_PODS = 100_000\n"
+        "bench.N_DEPLOYS = 2000\n"
+        "bench.N_ITS = 4000\n"
+        "bench.REPEATS = 2\n"
+        "bench.bench_mesh_headroom_local()\n")
+    if len(jax.devices()) >= MESH_DEVICES:
+        global N_PODS, N_DEPLOYS, N_ITS
+        saved = (N_PODS, N_DEPLOYS, N_ITS)
+        N_PODS, N_DEPLOYS, N_ITS = 100_000, 2000, 4000
+        try:
+            bench_mesh_headroom_local()
+        finally:
+            # later benches in the `all` loop read these globals: the
+            # headroom problem size must not leak into their metrics
+            N_PODS, N_DEPLOYS, N_ITS = saved
+        return
+    out = run_under_virtual_devices(code, MESH_DEVICES, timeout=1800)
+    for line in out.splitlines():
+        if line.startswith("{"):
+            print(line, flush=True)
+
+
 def bench_mesh():
     """Run bench_mesh_local, re-execing under a virtual MESH_DEVICES-device
     CPU platform when the host has fewer real chips (the driver box has one
@@ -599,13 +707,17 @@ def main():
     if MODE == "mesh-local":
         bench_mesh_local()
         return
+    if MODE == "mesh-headroom":
+        bench_mesh_headroom()
+        return
     if MODE == "sidecar":
         bench_sidecar()
         return
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
-            "all|provisioning|consolidation|spot|mesh|mesh-local|sidecar")
+            "all|provisioning|consolidation|spot|mesh|mesh-local|"
+            "mesh-headroom|sidecar")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))
@@ -636,7 +748,7 @@ def main():
         # mesh first: the multichip-at-scale line is the one the budget
         # gate must never sacrifice
         for aux in (bench_mesh, bench_consolidation, bench_spot_repack,
-                    bench_sidecar):
+                    bench_mesh_headroom, bench_sidecar):
             if time.perf_counter() - t0 > BUDGET_SECONDS:
                 print(f"auxiliary bench {aux.__name__} skipped: past the "
                       f"{BUDGET_SECONDS:.0f}s budget (headline must land)",

@@ -44,7 +44,12 @@ class Operator:
         configure_logging(self.options.log_level)
         self.log = get_logger("operator")
         self.clock = clock or Clock()
-        self.store = Store(self.clock)
+        if self.options.store_backend == "kube":
+            from ..kube.apiserver import KubeApiStore
+            self.store = KubeApiStore.from_kubeconfig(
+                self.options.kubeconfig or None, clock=self.clock)
+        else:
+            self.store = Store(self.clock)
         self.cluster = Cluster(self.store, self.clock)
         wire_informers(self.store, self.cluster)
         # every SPI call is timed + error-counted (cloudprovider/metrics.py)
@@ -248,6 +253,9 @@ class Operator:
                       solver_backend=self.options.solver_backend,
                       feature_gates=self.options.feature_gates)
         self.start_serving()
+        start_watches = getattr(self.store, "start_watches", None)
+        if start_watches is not None:
+            start_watches()
         lease = self._lease()
         leading = lease is None
         try:
@@ -274,6 +282,15 @@ class Operator:
                                       identity=lease.identity)
                         leading = True
                         self._start_renewal(lease)
+                # apiserver backend: watch streams queue events on their own
+                # threads; deliver them HERE so the deterministic single-
+                # dispatch model holds (kube/apiserver.py). Standbys pump
+                # too — informers stay warm for fast takeover and the queue
+                # stays bounded (client-go runs informers on non-leaders for
+                # the same reason); only reconciling is leader-gated.
+                pump = getattr(self.store, "pump_events", None)
+                if pump is not None:
+                    pump()
                 if leading:
                     self.manager.run_until_quiet()
                     self.checkpoint()

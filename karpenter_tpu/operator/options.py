@@ -56,6 +56,11 @@ class Options:
     solver_backend: str = "tensor"   # tensor | sidecar
     solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint
     solver_devices: int = 0          # 0 = all visible
+    # state backend: "memory" = in-process store (DEVIATIONS #6),
+    # "kube" = a real Kubernetes apiserver via kube/apiserver.py
+    # (operator.go:105-206 deployment model; requires the generated CRDs)
+    store_backend: str = "memory"    # memory | kube
+    kubeconfig: str = ""             # "" = $KUBECONFIG / ~/.kube/config
     # HA: only the lease holder runs controllers (operator.go:137-141)
     leader_elect: bool = False
     lease_file: str = ""             # default: <state_file>.lease
