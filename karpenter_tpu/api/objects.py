@@ -43,6 +43,7 @@ class OwnerReference:
     uid: str = ""
     controller: bool = False
     block_owner_deletion: bool = False
+    api_version: str = ""  # owner's real group/version (e.g. apps/v1)
 
 
 # Taint effects

@@ -29,6 +29,7 @@ import ssl
 import tempfile
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, List, Optional
 
@@ -177,13 +178,18 @@ class KubeApiStore:
         return None
 
     def list(self, kind: type, namespace: Optional[str] = None,
-             predicate: Optional[Callable] = None) -> list:
+             predicate: Optional[Callable] = None,
+             field_selector: Optional[str] = None) -> list:
         _, _, _, _, dec = self._route(kind)
         # namespace=None means CLUSTER-WIDE (the in-process store contract:
         # provisioner/disruption/termination all list pods across namespaces)
+        query = ""
+        if field_selector is not None:
+            query = "fieldSelector=" + urllib.parse.quote(field_selector,
+                                                          safe="=")
         out = self._request(
             "GET", self._url(kind, namespace=namespace or "",
-                             all_namespaces=namespace is None))
+                             all_namespaces=namespace is None, query=query))
         items = [dec(i) for i in out.get("items", [])]
         if namespace is not None:
             items = [o for o in items if o.metadata.namespace == namespace]
@@ -315,7 +321,7 @@ class KubeApiStore:
     def start_watches(self, kinds=None) -> None:
         """Spawn one watch stream per kind; events queue until the caller
         drains them with pump_events() (the manager dispatch thread)."""
-        kinds = list(kinds or k8s_codec.ROUTES)
+        kinds = list(kinds or k8s_codec.WATCH_KINDS)
         for kind in kinds:
             t = threading.Thread(target=self._watch_loop, args=(kind,),
                                  daemon=True,
@@ -345,17 +351,31 @@ class KubeApiStore:
     def _watch_loop(self, kind: type) -> None:
         _, _, _, _, dec = self._route(kind)
         rv = ""
+        # (namespace, name) -> obj: what this stream believes exists, so a
+        # relist after a dropped stream can synthesize DELETED for objects
+        # that vanished during the gap (client-go reflector replace semantics)
+        known: dict = {}
         while not self._stop.is_set():
             try:
                 if not rv:
-                    # seed: list, emit ADDED, then watch from that version
-                    out = self._request("GET", self._url(kind))
+                    # seed: list cluster-wide, emit ADDED, then watch from
+                    # that version
+                    out = self._request(
+                        "GET", self._url(kind, all_namespaces=True))
+                    live = {}
                     for item in out.get("items", []):
-                        self._events.put(Event(ADDED, kind, dec(item)))
+                        obj = dec(item)
+                        live[(obj.metadata.namespace, obj.metadata.name)] = obj
+                        self._events.put(Event(ADDED, kind, obj))
+                    for key, obj in known.items():
+                        if key not in live:
+                            self._events.put(Event(DELETED, kind, obj))
+                    known = live
                     rv = (out.get("metadata") or {}).get("resourceVersion",
                                                          "0")
                 url = self._url(
-                    kind, query=f"watch=true&resourceVersion={rv}"
+                    kind, all_namespaces=True,
+                    query=f"watch=true&resourceVersion={rv}"
                     "&timeoutSeconds=60&allowWatchBookmarks=true")
                 req = urllib.request.Request(url)
                 req.add_header("Accept", "application/json")
@@ -379,7 +399,13 @@ class KubeApiStore:
                         mapped = {"ADDED": ADDED, "MODIFIED": MODIFIED,
                                   "DELETED": DELETED}.get(etype)
                         if mapped:
-                            self._events.put(Event(mapped, kind, dec(item)))
+                            obj = dec(item)
+                            key = (obj.metadata.namespace, obj.metadata.name)
+                            if mapped is DELETED:
+                                known.pop(key, None)
+                            else:
+                                known[key] = obj
+                            self._events.put(Event(mapped, kind, obj))
             except Exception as exc:
                 if self._stop.is_set():
                     return

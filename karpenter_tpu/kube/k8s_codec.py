@@ -89,6 +89,18 @@ def resources_from_k8s(d: Optional[dict]) -> dict:
 # -- metadata ----------------------------------------------------------------
 
 
+_OWNER_API_VERSIONS = {
+    "DaemonSet": "apps/v1", "Deployment": "apps/v1", "StatefulSet": "apps/v1",
+    "ReplicaSet": "apps/v1", "Job": "batch/v1", "CronJob": "batch/v1",
+    "Node": "v1", "Pod": "v1",
+    "NodeClaim": GROUP_VERSION, "NodePool": GROUP_VERSION,
+}
+
+
+def _owner_api_version(kind: str) -> str:
+    return _OWNER_API_VERSIONS.get(kind, "v1")
+
+
 def meta_to_k8s(m: ObjectMeta, namespaced: bool) -> dict:
     out: dict = {"name": m.name}
     if namespaced:
@@ -105,7 +117,8 @@ def meta_to_k8s(m: ObjectMeta, namespaced: bool) -> dict:
         out["resourceVersion"] = str(m.resource_version)
     if m.owner_refs:
         out["ownerReferences"] = [
-            {"apiVersion": GROUP_VERSION, "kind": o.kind, "name": o.name,
+            {"apiVersion": o.api_version or _owner_api_version(o.kind),
+             "kind": o.kind, "name": o.name,
              "uid": o.uid, "blockOwnerDeletion": o.block_owner_deletion,
              "controller": o.controller}
             for o in m.owner_refs]
@@ -131,7 +144,8 @@ def meta_from_k8s(d: dict) -> ObjectMeta:
                                    uid=o.get("uid", ""),
                                    controller=o.get("controller", False),
                                    block_owner_deletion=o.get(
-                                       "blockOwnerDeletion", False))
+                                       "blockOwnerDeletion", False),
+                                   api_version=o.get("apiVersion", ""))
                     for o in d.get("ownerReferences") or []],
         creation_timestamp=ts_from_k8s(d.get("creationTimestamp")),
         deletion_timestamp=(ts_from_k8s(d["deletionTimestamp"])
@@ -651,7 +665,163 @@ def nodepool_from_k8s(d: dict) -> NodePool:
             weight=spec.get("weight")))
 
 
+# -- storage + policy kinds --------------------------------------------------
+# The solver reads these (volume topology, CSI limits, PDB gating); the
+# operator never writes them, but the codec round-trips both directions so
+# tests and the kwok harness can seed them through the same adapter.
+
+def pvc_to_k8s(pvc) -> dict:
+    spec: dict = {}
+    if pvc.spec.storage_class_name is not None:
+        spec["storageClassName"] = pvc.spec.storage_class_name
+    if pvc.spec.volume_name:
+        spec["volumeName"] = pvc.spec.volume_name
+    return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": meta_to_k8s(pvc.metadata, True), "spec": spec}
+
+
+def pvc_from_k8s(d: dict):
+    from ..api.storage import PersistentVolumeClaim, PVCSpec
+    spec = d.get("spec") or {}
+    return PersistentVolumeClaim(
+        metadata=meta_from_k8s(d.get("metadata") or {}),
+        spec=PVCSpec(storage_class_name=spec.get("storageClassName"),
+                     volume_name=spec.get("volumeName", "")))
+
+
+def pv_to_k8s(pv) -> dict:
+    spec: dict = {}
+    if pv.spec.storage_class_name:
+        spec["storageClassName"] = pv.spec.storage_class_name
+    if pv.spec.csi is not None:
+        spec["csi"] = {"driver": pv.spec.csi.driver}
+    if pv.spec.node_affinity_terms:
+        spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+            _nsterm_to_k8s(t) for t in pv.spec.node_affinity_terms]}}
+    return {"apiVersion": "v1", "kind": "PersistentVolume",
+            "metadata": meta_to_k8s(pv.metadata, False), "spec": spec}
+
+
+def pv_from_k8s(d: dict):
+    from ..api.storage import (CSIVolumeSource, PersistentVolume,
+                               PersistentVolumeSpec)
+    spec = d.get("spec") or {}
+    csi = spec.get("csi")
+    terms = (((spec.get("nodeAffinity") or {}).get("required") or {})
+             .get("nodeSelectorTerms") or [])
+    return PersistentVolume(
+        metadata=meta_from_k8s(d.get("metadata") or {}),
+        spec=PersistentVolumeSpec(
+            csi=CSIVolumeSource(driver=csi.get("driver", "")) if csi else None,
+            node_affinity_terms=[_nsterm_from_k8s(t) for t in terms],
+            storage_class_name=spec.get("storageClassName", "")))
+
+
+def storageclass_to_k8s(sc) -> dict:
+    out = {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+           "metadata": meta_to_k8s(sc.metadata, False),
+           "provisioner": sc.provisioner}
+    if sc.allowed_topologies:
+        out["allowedTopologies"] = [
+            {"matchLabelExpressions": [{"key": t.key,
+                                        "values": list(t.values)}]}
+            for t in sc.allowed_topologies]
+    return out
+
+
+def storageclass_from_k8s(d: dict):
+    from ..api.storage import StorageClass, TopologySelector
+    topos = []
+    for sel in d.get("allowedTopologies") or []:
+        for e in sel.get("matchLabelExpressions") or []:
+            topos.append(TopologySelector(key=e.get("key", ""),
+                                          values=list(e.get("values") or [])))
+    return StorageClass(metadata=meta_from_k8s(d.get("metadata") or {}),
+                        provisioner=d.get("provisioner", ""),
+                        allowed_topologies=topos)
+
+
+def csinode_to_k8s(cn) -> dict:
+    return {"apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+            "metadata": meta_to_k8s(cn.metadata, False),
+            "spec": {"drivers": [
+                {"name": dr.name, "nodeID": cn.metadata.name,
+                 **({"allocatable": {"count": dr.allocatable_count}}
+                    if dr.allocatable_count is not None else {})}
+                for dr in cn.drivers]}}
+
+
+def csinode_from_k8s(d: dict):
+    from ..api.storage import CSINode, CSINodeDriver
+    drivers = []
+    for dr in ((d.get("spec") or {}).get("drivers")) or []:
+        alloc = dr.get("allocatable") or {}
+        drivers.append(CSINodeDriver(name=dr.get("name", ""),
+                                     allocatable_count=alloc.get("count")))
+    return CSINode(metadata=meta_from_k8s(d.get("metadata") or {}),
+                   drivers=drivers)
+
+
+def volumeattachment_to_k8s(va) -> dict:
+    return {"apiVersion": "storage.k8s.io/v1", "kind": "VolumeAttachment",
+            "metadata": meta_to_k8s(va.metadata, False),
+            "spec": {"nodeName": va.spec.node_name,
+                     "source": {"persistentVolumeName":
+                                va.spec.persistent_volume_name},
+                     "attacher": ""}}
+
+
+def volumeattachment_from_k8s(d: dict):
+    from ..api.storage import VolumeAttachment, VolumeAttachmentSpec
+    spec = d.get("spec") or {}
+    return VolumeAttachment(
+        metadata=meta_from_k8s(d.get("metadata") or {}),
+        spec=VolumeAttachmentSpec(
+            node_name=spec.get("nodeName", ""),
+            persistent_volume_name=(spec.get("source")
+                                    or {}).get("persistentVolumeName")))
+
+
+def pdb_to_k8s(pdb) -> dict:
+    spec: dict = {}
+    if pdb.spec.selector is not None:
+        spec["selector"] = _selector_to_k8s(pdb.spec.selector)
+    for attr, key in (("min_available", "minAvailable"),
+                      ("max_unavailable", "maxUnavailable")):
+        v = getattr(pdb.spec, attr)
+        if v is not None:
+            # int-ish strings ride as ints, percents as strings
+            spec[key] = int(v) if str(v).lstrip("-").isdigit() else v
+    return {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": meta_to_k8s(pdb.metadata, True), "spec": spec}
+
+
+def pdb_from_k8s(d: dict):
+    from ..api.policy import PDBSpec, PDBStatus, PodDisruptionBudget
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+
+    def intstr(v):
+        return None if v is None else str(v)
+
+    return PodDisruptionBudget(
+        metadata=meta_from_k8s(d.get("metadata") or {}),
+        spec=PDBSpec(selector=_selector_from_k8s(spec.get("selector")),
+                     min_available=intstr(spec.get("minAvailable")),
+                     max_unavailable=intstr(spec.get("maxUnavailable"))),
+        status=PDBStatus(
+            disruptions_allowed=status.get("disruptionsAllowed", 0),
+            current_healthy=status.get("currentHealthy", 0),
+            desired_healthy=status.get("desiredHealthy", 0),
+            expected_pods=status.get("expectedPods", 0)))
+
+
 # -- registry ----------------------------------------------------------------
+
+from ..api.policy import PodDisruptionBudget  # noqa: E402
+from ..api.storage import (CSINode, PersistentVolume,  # noqa: E402
+                           PersistentVolumeClaim, StorageClass,
+                           VolumeAttachment)
 
 # kind -> (api prefix, plural, namespaced, encoder, decoder)
 ROUTES = {
@@ -661,4 +831,20 @@ ROUTES = {
                 nodeclaim_to_k8s, nodeclaim_from_k8s),
     NodePool: (f"apis/{GROUP_VERSION}", "nodepools", False,
                nodepool_to_k8s, nodepool_from_k8s),
+    PersistentVolumeClaim: ("api/v1", "persistentvolumeclaims", True,
+                            pvc_to_k8s, pvc_from_k8s),
+    PersistentVolume: ("api/v1", "persistentvolumes", False,
+                       pv_to_k8s, pv_from_k8s),
+    StorageClass: ("apis/storage.k8s.io/v1", "storageclasses", False,
+                   storageclass_to_k8s, storageclass_from_k8s),
+    CSINode: ("apis/storage.k8s.io/v1", "csinodes", False,
+              csinode_to_k8s, csinode_from_k8s),
+    VolumeAttachment: ("apis/storage.k8s.io/v1", "volumeattachments",
+                       False, volumeattachment_to_k8s,
+                       volumeattachment_from_k8s),
+    PodDisruptionBudget: ("apis/policy/v1", "poddisruptionbudgets", True,
+                          pdb_to_k8s, pdb_from_k8s),
 }
+
+# kinds the operator watches (the rest are read on demand)
+WATCH_KINDS = (Pod, Node, NodeClaim, NodePool, PodDisruptionBudget)

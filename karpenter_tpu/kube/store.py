@@ -115,12 +115,21 @@ class Store:
         return self._objs.get(kind, {}).get((_ns(kind, namespace), name))
 
     def list(self, kind: type, namespace: Optional[str] = None,
-             predicate: Optional[Callable] = None) -> List[object]:
+             predicate: Optional[Callable] = None,
+             field_selector: Optional[str] = None) -> List[object]:
         out = []
         if namespace is not None:
             namespace = _ns(kind, namespace)
+        node_name = None
+        if field_selector is not None:
+            # only the selector the controllers use (spec.nodeName=<node>)
+            if not field_selector.startswith("spec.nodeName="):
+                raise ValueError(f"unsupported field selector {field_selector}")
+            node_name = field_selector.split("=", 1)[1]
         for (ns, _), obj in self._objs.get(kind, {}).items():
             if namespace is not None and ns != namespace:
+                continue
+            if node_name is not None and obj.spec.node_name != node_name:
                 continue
             if predicate is not None and not predicate(obj):
                 continue

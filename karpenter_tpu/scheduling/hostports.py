@@ -45,7 +45,9 @@ class HostPortUsage:
         errs = []
         for p in ports:
             for existing in self._entries:
-                if p.conflicts(existing):
+                # a pod never conflicts with its own tracked ports
+                # (hostportusage.go Conflicts:75-86)
+                if existing.pod_uid != pod.uid and p.conflicts(existing):
                     errs.append(
                         f"port {p.port}/{p.protocol} on ip {p.ip} conflicts with existing usage")
         return errs

@@ -11,13 +11,14 @@ store standing in for the API server (:96-150).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim
 from ..api.objects import Node, Pod
 from ..kube.store import Store
 from ..utils.clock import Clock
+from ..utils.pod import is_terminal
 from .statenode import StateNode
 
 # nomination window: how long a node is reserved for a nominated pod
@@ -38,7 +39,11 @@ class Cluster:
         self.nodes: Dict[str, StateNode] = {}          # providerID -> StateNode
         self.node_name_to_provider_id: Dict[str, str] = {}
         self.nodeclaim_name_to_provider_id: Dict[str, str] = {}
-        self.bindings: Dict[str, str] = {}             # pod key -> node name
+        # pod key -> (node name, pod uid). The uid rides along so a pod that
+        # was deleted and re-created under the same name on a different node
+        # (missed DELETE event) can still be cleaned off the old node
+        # (cluster.go cleanupOldBindings:630-646).
+        self.bindings: Dict[str, Tuple[str, str]] = {}
         self.daemonset_pods: Dict[str, Pod] = {}       # daemonset key -> sample pod
         self.pod_acks: Dict[str, float] = {}
         self.pod_scheduling_decisions: Dict[str, float] = {}
@@ -107,6 +112,7 @@ class Cluster:
 
     def update_node(self, node: Node) -> None:
         pid = node.spec.provider_id or f"node://{node.name}"
+        first_seen = node.name not in self.node_name_to_provider_id
         self.node_name_to_provider_id[node.name] = pid
         placeholder = f"node://{node.name}"
         if pid != placeholder and placeholder in self.nodes:
@@ -118,6 +124,19 @@ class Cluster:
             self.nodes[pid] = sn
         else:
             sn.node = node
+        if first_seen:
+            self._populate_resource_requests(sn, node.name)
+
+    def _populate_resource_requests(self, sn: StateNode, node_name: str) -> None:
+        """Hydrate usage from pods that bound before the node was tracked
+        (cluster.go populateResourceRequests:574-593)."""
+        from ..scheduling.volumeusage import get_volumes
+        for pod in self.store.list(Pod,
+                                   field_selector=f"spec.nodeName={node_name}"):
+            if is_terminal(pod):
+                continue
+            sn.update_pod(pod, get_volumes(self.store, pod))
+            self.bindings[_pod_key(pod)] = (node_name, pod.uid)
 
     def delete_node(self, name: str) -> None:
         pid = self.node_name_to_provider_id.pop(name, None)
@@ -138,31 +157,60 @@ class Cluster:
             self.delete_pod(pod)
             return
         self._update_anti_affinity_index(pod)
-        old_node = self.bindings.get(key)
+        if is_terminal(pod):
+            # a Failed/Succeeded pod no longer consumes node resources
+            # (cluster.go UpdatePod:312 -> updateNodeUsageFromPodCompletion)
+            binding = self.bindings.pop(key, None)
+            if binding:
+                self._unbind(binding[1], binding[0])
+            return
+        old = self.bindings.get(key)
         if pod.spec.node_name:
-            if old_node and old_node != pod.spec.node_name:
-                self._unbind(pod.uid, old_node)
-            self.bindings[key] = pod.spec.node_name
+            if old and (old[0] != pod.spec.node_name or old[1] != pod.uid):
+                # pod name re-used (missed DELETE) on a different node — or on
+                # the SAME node under a new uid: clean the old binding with
+                # the uid we tracked, not the new pod's uid
+                self._unbind(old[1], old[0])
+            self.bindings[key] = (pod.spec.node_name, pod.uid)
             sn = self._node_by_name(pod.spec.node_name)
             if sn is not None:
                 from ..scheduling.volumeusage import get_volumes
                 sn.update_pod(pod, get_volumes(self.store, pod))
             self.mark_pod_schedulable(pod)
-        elif old_node:
-            self._unbind(pod.uid, old_node)
+        elif old:
+            self._unbind(old[1], old[0])
             del self.bindings[key]
         if pod.is_daemonset_pod:
-            self.daemonset_pods[self._daemonset_key(pod)] = pod
+            dkey = self._daemonset_key(pod)
+            cached = self.daemonset_pods.get(dkey)
+            # keep the newest pod as the daemonset exemplar (daemonset.go)
+            if cached is None or pod.metadata.creation_timestamp >= \
+                    cached.metadata.creation_timestamp:
+                self.daemonset_pods[dkey] = pod
 
     def delete_pod(self, pod: Pod) -> None:
         key = _pod_key(pod)
-        node_name = self.bindings.pop(key, None)
-        if node_name:
-            self._unbind(pod.uid, node_name)
+        binding = self.bindings.pop(key, None)
+        if binding:
+            self._unbind(binding[1], binding[0])
         self._anti_affinity_pods.pop(key, None)
         self.pod_acks.pop(key, None)
         self.pod_scheduling_decisions.pop(key, None)
         self.pod_to_nominated_node.pop(key, None)
+        if pod.is_daemonset_pod:
+            dkey = self._daemonset_key(pod)
+            cached = self.daemonset_pods.get(dkey)
+            if cached is not None and cached.uid == pod.uid:
+                # the exemplar died: fall back to any surviving sibling, else
+                # drop the cache entry (daemonset deleted)
+                siblings = [p for p in self.store.list(Pod)
+                            if p.is_daemonset_pod and p.uid != pod.uid
+                            and self._daemonset_key(p) == dkey]
+                if siblings:
+                    self.daemonset_pods[dkey] = max(
+                        siblings, key=lambda p: p.metadata.creation_timestamp)
+                else:
+                    del self.daemonset_pods[dkey]
         self.mark_unconsolidated()
 
     def _unbind(self, pod_uid: str, node_name: str) -> None:

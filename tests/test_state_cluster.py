@@ -14,7 +14,7 @@ from karpenter_tpu.state.informers import wire_informers
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import FakeClock
 
-from factories import make_pod
+from factories import affinity_term, make_pod
 
 
 @pytest.fixture
@@ -213,3 +213,556 @@ class TestManager:
         store.update(n)
         store.update(n)
         assert mgr.drain() == 1  # deduped to one work item
+
+
+# ---------------------------------------------------------------------------
+# Widened port of /root/reference/pkg/controllers/state/suite_test.go
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.api.objects import HostPort, OwnerReference, PVCRef, Taint
+from karpenter_tpu.api.storage import (CSINode, CSINodeDriver,
+                                       PersistentVolumeClaim, PVCSpec,
+                                       StorageClass)
+from karpenter_tpu.provisioning.provisioner import StateClusterView
+from karpenter_tpu.scheduling.hostports import get_host_ports
+from karpenter_tpu.scheduling.taints import NO_EXECUTE, NO_SCHEDULE
+from karpenter_tpu.scheduling.volumeusage import Volumes, node_volume_limits
+from karpenter_tpu.state.statenode import StateNode
+
+
+def bind(store, pod, node_name):
+    pod.spec.node_name = node_name
+    store.update(pod)
+
+
+class TestPodAck:
+    """suite_test.go:102-118."""
+
+    def test_scheduling_decision_marked_once(self, store, cluster, clock):
+        pod = make_pod()
+        store.create(pod)
+        key = f"{pod.namespace}/{pod.name}"
+        assert key not in cluster.pod_scheduling_decisions
+        cluster.mark_pod_scheduling_decisions({}, {key: "n1"})
+        t0 = cluster.pod_scheduling_decisions[key]
+        clock.step(5)
+        cluster.mark_pod_scheduling_decisions({}, {key: "n2"})
+        assert cluster.pod_scheduling_decisions[key] == t0  # first write wins
+
+    def test_ack_only_once(self, store, cluster, clock):
+        pod = make_pod()
+        store.create(pod)
+        cluster.ack_pods([pod])
+        t0 = cluster.pod_acks[f"{pod.namespace}/{pod.name}"]
+        clock.step(3)
+        cluster.ack_pods([pod])
+        assert cluster.pod_acks[f"{pod.namespace}/{pod.name}"] == t0
+
+
+class TestNodeResourceLevel:
+    """suite_test.go:365-843 (Node Resource Level)."""
+
+    def test_does_not_count_unbound_pods(self, store, cluster):
+        store.create(make_pod(cpu="1500m"))
+        store.create(make_node("n1", cpu="4"))
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total() == {}
+        assert sn.available()["cpu"] == 4000
+
+    def test_counts_new_pods_bound_to_node(self, store, cluster):
+        store.create(make_node("n1", cpu="4"))
+        p1, p2 = make_pod(cpu="1500m"), make_pod(cpu="1")
+        store.create(p1)
+        store.create(p2)
+        bind(store, p1, "n1")
+        bind(store, p2, "n1")
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total()["cpu"] == 2500
+        assert sn.available()["cpu"] == 1500
+
+    def test_counts_existing_pods_bound_before_node_tracked(self, store, cluster):
+        """Hydration: pods bound before the node appears must be counted
+        (populateResourceRequests, suite_test.go:439-471)."""
+        p1 = make_pod(cpu="1500m")
+        p1.spec.node_name = "n1"
+        store.create(p1)
+        store.create(make_node("n1", cpu="4"))
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total()["cpu"] == 1500
+        assert sn.available()["cpu"] == 2500
+
+    def test_subtracts_requests_when_pod_deleted(self, store, cluster):
+        store.create(make_node("n1", cpu="4"))
+        pod = make_pod(cpu="1500m")
+        store.create(pod)
+        bind(store, pod, "n1")
+        store.delete(pod)
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total() == {}
+        assert sn.available()["cpu"] == 4000
+
+    def test_terminal_pods_not_counted(self, store, cluster):
+        """suite_test.go:519-557: Failed/Succeeded pods consume nothing."""
+        store.create(make_node("n1", cpu="4"))
+        p1, p2 = make_pod(cpu="1500m"), make_pod(cpu="2")
+        p1.status.phase = "Failed"
+        p2.status.phase = "Succeeded"
+        store.create(p1)
+        store.create(p2)
+        bind(store, p1, "n1")
+        bind(store, p2, "n1")
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total() == {}
+
+    def test_pod_turning_terminal_releases_usage(self, store, cluster):
+        store.create(make_node("n1", cpu="4"))
+        pod = make_pod(cpu="1500m")
+        store.create(pod)
+        bind(store, pod, "n1")
+        assert cluster.nodes["test://n1"].pod_request_total()["cpu"] == 1500
+        pod.status.phase = "Succeeded"
+        store.update(pod)
+        assert cluster.nodes["test://n1"].pod_request_total() == {}
+
+    def test_stops_tracking_deleted_nodes(self, store, cluster):
+        node = make_node("n1", cpu="4")
+        store.create(node)
+        pod = make_pod(cpu="1500m")
+        store.create(pod)
+        bind(store, pod, "n1")
+        store.delete(node)
+        assert cluster.nodes == {}
+        assert cluster.state_nodes() == []
+
+    def test_missed_delete_event_reused_pod_name(self, store, cluster):
+        """suite_test.go:598-673: a pod deleted+recreated under the same name
+        on another node (DELETE event missed) must free the old node."""
+        store.create(make_node("n1", cpu="4"))
+        store.create(make_node("n2", cpu="8"))
+        p1 = make_pod(cpu="1500m", name="stateful-set-pod")
+        store.create(p1)
+        bind(store, p1, "n1")
+        assert cluster.nodes["test://n1"].available()["cpu"] == 2500
+        # simulate: p1 deleted and re-created bound to n2, we only see the
+        # new pod's event (delivered directly, not through the store)
+        p2 = make_pod(cpu="5", name="stateful-set-pod")
+        p2.spec.node_name = "n2"
+        cluster.update_pod(p2)
+        assert cluster.nodes["test://n1"].available()["cpu"] == 4000
+        assert cluster.nodes["test://n1"].pod_request_total() == {}
+        assert cluster.nodes["test://n2"].pod_request_total()["cpu"] == 5000
+        assert cluster.nodes["test://n2"].available()["cpu"] == 3000
+
+    def test_usage_count_through_add_delete_churn(self, store, cluster):
+        """suite_test.go:674-740."""
+        store.create(make_node("n1", cpu="200000m"))
+        pods = [make_pod(cpu=f"{(i % 20) * 100 + 100}m") for i in range(100)]
+        total = 0
+        for p in pods:
+            store.create(p)
+            bind(store, p, "n1")
+            total += (int(p.name.split("-")[-1]) * 0 +
+                      p.requests()["cpu"])
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total()["cpu"] == total
+        for p in pods[::2]:
+            store.delete(p)
+            total -= p.requests()["cpu"]
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total()["cpu"] == total
+        for p in pods[1::2]:
+            store.delete(p)
+        assert cluster.nodes["test://n1"].pod_request_total() == {}
+
+    def test_daemonset_requests_tracked_separately(self, store, cluster):
+        """suite_test.go:741-817."""
+        store.create(make_node("n1", cpu="4"))
+        ds_pod = make_pod(cpu="500m")
+        ds_pod.is_daemonset_pod = True
+        ds_pod.metadata.owner_refs.append(
+            OwnerReference(kind="DaemonSet", name="fluentd"))
+        reg = make_pod(cpu="1")
+        store.create(ds_pod)
+        store.create(reg)
+        bind(store, ds_pod, "n1")
+        bind(store, reg, "n1")
+        sn = cluster.nodes["test://n1"]
+        assert sn.daemonset_requests()["cpu"] == 500
+        assert sn.pod_request_total()["cpu"] == 1500
+        store.delete(ds_pod)
+        sn = cluster.nodes["test://n1"]
+        assert sn.daemonset_requests() == {}
+
+    def test_mark_node_for_deletion_on_node_delete_timestamp(self, store, cluster, clock):
+        node = make_node("n1")
+        node.metadata.finalizers.append("karpenter.sh/termination")
+        store.create(node)
+        store.delete(node)  # finalizer holds it: deletionTimestamp stamped
+        assert cluster.nodes["test://n1"].deleting()
+
+    def test_mark_node_for_deletion_on_nodeclaim_delete_timestamp(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.metadata.finalizers.append("karpenter.sh/termination")
+        nc.status.provider_id = "test://n1"
+        store.create(nc)
+        store.create(make_node("n1"))
+        store.delete(nc)
+        assert cluster.nodes["test://n1"].deleting()
+
+    def test_provider_id_registration_migrates_state(self, store, cluster):
+        """suite_test.go:928-945: a node gaining a providerID later must not
+        duplicate or lose its state."""
+        node = make_node("n1")
+        node.spec.provider_id = ""
+        store.create(node)
+        assert "node://n1" in cluster.nodes
+        pod = make_pod(cpu="1")
+        store.create(pod)
+        bind(store, pod, "n1")
+        assert cluster.nodes["node://n1"].pod_request_total()["cpu"] == 1000
+        node.spec.provider_id = "real://n1"
+        store.update(node)
+        assert "node://n1" not in cluster.nodes
+        assert len(cluster.nodes) == 1
+        assert cluster.nodes["real://n1"].pod_request_total()["cpu"] == 1000
+
+
+class TestVolumeUsageState:
+    """suite_test.go:120-234 (Volume Usage/Limits)."""
+
+    def _make_csi_world(self, store, n_pods=10):
+        store.create(StorageClass(metadata=ObjectMeta(name="my-sc", namespace=""),
+                                  provisioner="csi.test.com"))
+        for i in range(n_pods):
+            pvc = PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"pvc-{i}"),
+                spec=PVCSpec(storage_class_name="my-sc"))
+            store.create(pvc)
+            pod = make_pod()
+            pod.spec.volumes.append(PVCRef(claim_name=f"pvc-{i}"))
+            pod.spec.node_name = "n1"
+            store.create(pod)
+        store.create(CSINode(metadata=ObjectMeta(name="n1", namespace=""),
+                             drivers=[CSINodeDriver(name="csi.test.com",
+                                                    allocatable_count=10)]))
+
+    def test_hydrates_volume_usage_on_node_update(self, store, cluster):
+        self._make_csi_world(store)
+        store.create(make_node("n1"))  # node arrives after the pods
+        sn = cluster.nodes["test://n1"]
+        limits = node_volume_limits(store, "n1")
+        assert sn.volume_usage().exceeds_limits(
+            Volumes({"csi.test.com": {"default/one-more"}}), limits) is not None
+
+    def test_maintains_volume_usage_across_nodeclaim_updates(self, store, cluster):
+        self._make_csi_world(store)
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.status.provider_id = "test://n1"
+        store.create(nc)
+        store.create(make_node("n1"))
+        store.update(nc)  # nodeclaim reconcile must not wipe usage
+        sn = cluster.nodes["test://n1"]
+        limits = node_volume_limits(store, "n1")
+        assert sn.volume_usage().exceeds_limits(
+            Volumes({"csi.test.com": {"default/one-more"}}), limits) is not None
+
+    def test_already_tracked_volume_is_not_a_breach(self, store, cluster):
+        self._make_csi_world(store)
+        store.create(make_node("n1"))
+        sn = cluster.nodes["test://n1"]
+        limits = node_volume_limits(store, "n1")
+        assert sn.volume_usage().exceeds_limits(
+            Volumes({"csi.test.com": {"default/pvc-5"}}), limits) is None
+
+
+class TestHostPortUsageState:
+    """suite_test.go:235-336 (HostPort Usage)."""
+
+    def _bind_port_pods(self, store, n=10):
+        pods = []
+        for i in range(n):
+            pod = make_pod(host_ports=[HostPort(port=i)])
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            pods.append(pod)
+        return pods
+
+    def test_hydrates_host_port_usage_on_node_update(self, store, cluster):
+        self._bind_port_pods(store)
+        store.create(make_node("n1"))
+        sn = cluster.nodes["test://n1"]
+        probe = make_pod(host_ports=[HostPort(port=5)])
+        assert sn.host_port_usage().conflicts(probe, get_host_ports(probe))
+
+    def test_maintains_host_port_usage_across_nodeclaim_updates(self, store, cluster):
+        self._bind_port_pods(store)
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.status.provider_id = "test://n1"
+        store.create(nc)
+        store.create(make_node("n1"))
+        store.update(nc)
+        sn = cluster.nodes["test://n1"]
+        probe = make_pod(host_ports=[HostPort(port=5)])
+        assert sn.host_port_usage().conflicts(probe, get_host_ports(probe))
+
+    def test_own_tracked_port_is_not_a_conflict(self, store, cluster):
+        pods = self._bind_port_pods(store)
+        store.create(make_node("n1"))
+        sn = cluster.nodes["test://n1"]
+        assert sn.host_port_usage().conflicts(
+            pods[5], get_host_ports(pods[5])) == []
+
+    def test_disjoint_ips_no_conflict(self, store, cluster):
+        store.create(make_node("n1"))
+        p1 = make_pod(host_ports=[HostPort(port=80, host_ip="10.0.0.1")])
+        store.create(p1)
+        bind(store, p1, "n1")
+        sn = cluster.nodes["test://n1"]
+        probe = make_pod(host_ports=[HostPort(port=80, host_ip="10.0.0.2")])
+        assert sn.host_port_usage().conflicts(probe, get_host_ports(probe)) == []
+        wildcard = make_pod(host_ports=[HostPort(port=80)])
+        assert sn.host_port_usage().conflicts(wildcard, get_host_ports(wildcard))
+
+
+class TestNodeDeletionNoLeak:
+    """suite_test.go:337-364: NodeClaim and Node sharing a name must not
+    leak a state node."""
+
+    def test_same_name_nodeclaim_and_node(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="shared", namespace=""))
+        nc.status.provider_id = "test://shared"
+        node = make_node("shared", provider_id="test://shared")
+        store.create(nc)
+        store.create(node)
+        assert len(cluster.nodes) == 1
+        store.delete(nc)
+        assert len(cluster.nodes) == 1  # node still alive
+        store.delete(node)
+        assert len(cluster.nodes) == 0
+
+
+class TestAntiAffinityTracking:
+    """suite_test.go:946-1129 (Pod Anti-Affinity)."""
+
+    def _anti_pod(self, **kw):
+        return make_pod(pod_anti_affinity=[affinity_term(
+            api_labels.LABEL_TOPOLOGY_ZONE)], **kw)
+
+    def test_tracks_required_anti_affinity(self, store, cluster):
+        pod = self._anti_pod()
+        store.create(pod)
+        assert [p.name for p in cluster.anti_affinity_pods()] == [pod.name]
+
+    def test_does_not_track_preferred_anti_affinity(self, store, cluster):
+        pod = make_pod(preferred_pod_anti_affinity=[
+            (1, affinity_term(api_labels.LABEL_TOPOLOGY_ZONE))])
+        store.create(pod)
+        assert cluster.anti_affinity_pods() == []
+
+    def test_stops_tracking_on_delete(self, store, cluster):
+        pod = self._anti_pod()
+        store.create(pod)
+        store.delete(pod)
+        assert cluster.anti_affinity_pods() == []
+
+    def test_out_of_order_node_deletion(self, store, cluster):
+        """suite_test.go:1083-1129: node deleted before the pod — the
+        anti-affinity join must yield nothing rather than a dangling node."""
+        node = make_node("n1")
+        store.create(node)
+        pod = self._anti_pod()
+        store.create(pod)
+        bind(store, pod, "n1")
+        store.delete(node)
+        view = StateClusterView(store, cluster)
+        assert list(view.for_pods_with_anti_affinity()) == []
+
+
+class TestClusterStateSync:
+    """suite_test.go:1130-1341 (Cluster State Sync)."""
+
+    def test_synced_when_all_nodes_tracked(self, store, cluster):
+        for i in range(3):
+            store.create(make_node(f"n{i}"))
+        assert cluster.synced()
+
+    def test_synced_when_node_has_no_provider_id(self, store, cluster):
+        node = make_node("n1")
+        node.spec.provider_id = ""
+        store.create(node)
+        assert cluster.synced()
+
+    def test_synced_when_nodeclaims_tracked(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.status.provider_id = "test://n1"
+        store.create(nc)
+        assert cluster.synced()
+
+    def test_unsynced_when_nodeclaim_added_manually(self, store, cluster):
+        """A nodeclaim in the store the informers never delivered."""
+        nc = NodeClaim(metadata=ObjectMeta(name="ghost", namespace=""))
+        store._objs.setdefault(NodeClaim, {})[("", "ghost")] = nc
+        assert not cluster.synced()
+
+    def test_unsynced_when_node_added_manually(self, store, cluster):
+        node = make_node("ghost")
+        store._objs.setdefault(Node, {})[("", "ghost")] = node
+        assert not cluster.synced()
+
+    def test_synced_again_after_unresolved_nodeclaim_deleted(self, store, cluster):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        store.create(nc)  # no providerID: tracked under a placeholder
+        assert cluster.synced()
+        store.delete(nc)
+        assert cluster.synced()
+        assert cluster.nodes == {}
+
+
+class TestDaemonSetCache:
+    """suite_test.go:1342-1465 (DaemonSet Controller)."""
+
+    def _ds_pod(self, ds="fluentd", **kw):
+        pod = make_pod(**kw)
+        pod.is_daemonset_pod = True
+        pod.metadata.owner_refs.append(OwnerReference(kind="DaemonSet", name=ds))
+        return pod
+
+    def test_non_daemonset_pod_not_cached(self, store, cluster):
+        store.create(make_pod())
+        assert cluster.daemonset_pod_list() == []
+
+    def test_daemonset_pod_cached(self, store, cluster):
+        store.create(self._ds_pod())
+        assert len(cluster.daemonset_pod_list()) == 1
+
+    def test_newest_pod_wins(self, store, cluster, clock):
+        old = self._ds_pod(cpu="100m")
+        store.create(old)
+        clock.step(10)
+        new = self._ds_pod(cpu="200m")
+        store.create(new)
+        [cached] = cluster.daemonset_pod_list()
+        assert cached.uid == new.uid
+        # an out-of-order event for the older pod must not displace it
+        cluster.update_pod(old)
+        [cached] = cluster.daemonset_pod_list()
+        assert cached.uid == new.uid
+
+    def test_cache_entry_dropped_when_daemonset_gone(self, store, cluster, clock):
+        p1 = self._ds_pod()
+        store.create(p1)
+        clock.step(1)
+        p2 = self._ds_pod()
+        store.create(p2)
+        store.delete(p2)  # exemplar dies, sibling survives
+        [cached] = cluster.daemonset_pod_list()
+        assert cached.uid == p1.uid
+        store.delete(p1)  # daemonset fully gone
+        assert cluster.daemonset_pod_list() == []
+
+    def test_two_daemonsets_cached_independently(self, store, cluster):
+        store.create(self._ds_pod(ds="fluentd"))
+        store.create(self._ds_pod(ds="node-exporter"))
+        assert len(cluster.daemonset_pod_list()) == 2
+
+
+class TestConsolidatedState:
+    """suite_test.go:1466-1498 (Consolidated State)."""
+
+    def test_mark_unconsolidated_bumps_token(self, cluster, clock):
+        t = cluster.consolidation_state()
+        clock.step(1)
+        cluster.mark_unconsolidated()
+        assert cluster.consolidation_state() != t
+
+    def test_five_minute_forced_bump(self, cluster, clock):
+        t = cluster.consolidation_state()
+        clock.step(60)
+        assert cluster.consolidation_state() == t
+        clock.step(180)
+        assert cluster.consolidation_state() == t
+        clock.step(120)
+        assert cluster.consolidation_state() != t
+
+    def test_nodepool_update_bumps_token(self, store, cluster, clock):
+        from factories import make_nodepool
+        np = make_nodepool()
+        store.create(np)
+        clock.step(1)
+        t = cluster.consolidation_state()
+        clock.step(1)
+        store.update(np)
+        assert cluster.consolidation_state() != t
+
+
+class TestStateNodeTaints:
+    """suite_test.go:1554-1700 (Taints, managed vs unmanaged)."""
+
+    EPHEMERAL = [
+        Taint(key="node.kubernetes.io/not-ready", effect=NO_SCHEDULE),
+        Taint(key="node.kubernetes.io/unreachable", effect=NO_SCHEDULE),
+        Taint(key="node.cloudprovider.kubernetes.io/uninitialized",
+              effect=NO_SCHEDULE, value="true"),
+    ]
+    STARTUP = [
+        Taint(key="taint-key", value="taint-value", effect=NO_SCHEDULE),
+        Taint(key="taint-key2", value="taint-value2", effect=NO_EXECUTE),
+    ]
+
+    def _managed(self, store, cluster, taints, startup_taints=(), initialized=False):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc1", namespace=""))
+        nc.status.provider_id = "test://n1"
+        nc.spec.startup_taints = list(startup_taints)
+        store.create(nc)
+        node = make_node("n1", initialized=initialized)
+        node.spec.taints = list(taints)
+        store.create(node)
+        return cluster.nodes["test://n1"]
+
+    def test_managed_uninitialized_hides_ephemeral(self, store, cluster):
+        sn = self._managed(store, cluster, self.EPHEMERAL)
+        assert sn.taints() == []
+
+    def test_managed_initialized_shows_ephemeral(self, store, cluster):
+        sn = self._managed(store, cluster, self.EPHEMERAL, initialized=True)
+        assert len(sn.taints()) == 3
+
+    def test_managed_uninitialized_hides_startup_taints(self, store, cluster):
+        sn = self._managed(store, cluster, self.STARTUP,
+                           startup_taints=self.STARTUP)
+        assert sn.taints() == []
+
+    def test_managed_initialized_shows_startup_taints(self, store, cluster):
+        sn = self._managed(store, cluster, self.STARTUP,
+                           startup_taints=self.STARTUP, initialized=True)
+        assert len(sn.taints()) == 2
+
+    def test_unmanaged_uninitialized_shows_ephemeral(self, store, cluster):
+        node = make_node("n1", initialized=False)
+        node.spec.taints = list(self.EPHEMERAL)
+        store.create(node)
+        sn = cluster.nodes["test://n1"]
+        assert not sn.managed()
+        assert len(sn.taints()) == 3
+
+    def test_unmanaged_initialized_shows_ephemeral(self, store, cluster):
+        node = make_node("n1", initialized=True)
+        node.spec.taints = list(self.EPHEMERAL)
+        store.create(node)
+        assert len(cluster.nodes["test://n1"].taints()) == 3
+
+
+class TestSameNodeUidReuse:
+    def test_missed_delete_same_node_does_not_double_count(self, store, cluster):
+        """A pod deleted+recreated under the same name on the SAME node
+        (missed DELETE) must not leak the old uid's usage."""
+        store.create(make_node("n1", cpu="4"))
+        p1 = make_pod(cpu="1500m", name="stateful-set-pod")
+        store.create(p1)
+        bind(store, p1, "n1")
+        p2 = make_pod(cpu="1", name="stateful-set-pod")
+        p2.spec.node_name = "n1"
+        cluster.update_pod(p2)  # direct event; DELETE for p1 never seen
+        sn = cluster.nodes["test://n1"]
+        assert sn.pod_request_total()["cpu"] == 1000
+        assert set(sn.pod_requests) == {p2.uid}
