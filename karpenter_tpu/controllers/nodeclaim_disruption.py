@@ -15,7 +15,7 @@ from typing import Optional
 
 from ..api import labels as api_labels
 from ..api.nodeclaim import (COND_CONSOLIDATABLE, COND_DRIFTED, COND_INITIALIZED,
-                             NodeClaim)
+                             COND_LAUNCHED, NodeClaim)
 from ..api.nodepool import NodePool
 from ..kube.store import Store
 from ..scheduling.requirements import label_requirements, node_selector_requirements
@@ -40,9 +40,17 @@ class NodeClaimDisruptionMarker(Controller):
     def reconcile(self, nc: NodeClaim) -> Optional[Result]:
         if nc.metadata.deletion_timestamp is not None:
             return None
-        if not nc.initialized():
-            return None
-        requeue = self._consolidatable(nc)
+        requeue = None
+        if nc.initialized():
+            requeue = self._consolidatable(nc)
+        # Drift only needs Launched, not Initialized; an unlaunched claim
+        # sheds any stale Drifted condition (drift.go:46-57)
+        if not nc.conditions.is_true(COND_LAUNCHED):
+            if nc.conditions.get(COND_DRIFTED) is not None:
+                nc.conditions.clear(COND_DRIFTED)
+                self.store.update(nc)
+            return Result(requeue_after=min(requeue or DRIFT_RECHECK_SECONDS,
+                                            DRIFT_RECHECK_SECONDS))
         self._drifted(nc)
         # drift inputs are external (catalog, cloud provider): re-check on a
         # timer even with no claim events (drift.go:68,76 — 5 min cache TTL)
@@ -132,6 +140,8 @@ class NodeClaimDisruptionMarker(Controller):
         pool_reqs = node_selector_requirements(
             pool.spec.template.spec.requirements)
         claim_reqs = label_requirements(nc.metadata.labels)
-        if pool_reqs.intersects(claim_reqs):
+        # Compatible (not Intersects): a pool requirement on a key the claim
+        # has no label for is drift too (drift.go:144-154)
+        if claim_reqs.compatible(pool_reqs):
             return "RequirementsDrifted"
         return ""

@@ -671,7 +671,10 @@ def nodepool_from_k8s(d: dict) -> NodePool:
 # tests and the kwok harness can seed them through the same adapter.
 
 def pvc_to_k8s(pvc) -> dict:
-    spec: dict = {}
+    # accessModes/resources aren't modeled (the solver doesn't read them)
+    # but a real apiserver requires both — emit serviceable defaults
+    spec: dict = {"accessModes": ["ReadWriteOnce"],
+                  "resources": {"requests": {"storage": "1Gi"}}}
     if pvc.spec.storage_class_name is not None:
         spec["storageClassName"] = pvc.spec.storage_class_name
     if pvc.spec.volume_name:
@@ -690,11 +693,13 @@ def pvc_from_k8s(d: dict):
 
 
 def pv_to_k8s(pv) -> dict:
-    spec: dict = {}
+    spec: dict = {"capacity": {"storage": "1Gi"},
+                  "accessModes": ["ReadWriteOnce"]}
     if pv.spec.storage_class_name:
         spec["storageClassName"] = pv.spec.storage_class_name
     if pv.spec.csi is not None:
-        spec["csi"] = {"driver": pv.spec.csi.driver}
+        spec["csi"] = {"driver": pv.spec.csi.driver,
+                       "volumeHandle": pv.metadata.name}
     if pv.spec.node_affinity_terms:
         spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
             _nsterm_to_k8s(t) for t in pv.spec.node_affinity_terms]}}
@@ -768,7 +773,7 @@ def volumeattachment_to_k8s(va) -> dict:
             "spec": {"nodeName": va.spec.node_name,
                      "source": {"persistentVolumeName":
                                 va.spec.persistent_volume_name},
-                     "attacher": ""}}
+                     "attacher": "csi.unknown"}}
 
 
 def volumeattachment_from_k8s(d: dict):

@@ -203,7 +203,8 @@ class Cluster:
             if cached is not None and cached.uid == pod.uid:
                 # the exemplar died: fall back to any surviving sibling, else
                 # drop the cache entry (daemonset deleted)
-                siblings = [p for p in self.store.list(Pod)
+                siblings = [p for p in self.store.list(Pod,
+                                                       namespace=pod.namespace)
                             if p.is_daemonset_pod and p.uid != pod.uid
                             and self._daemonset_key(p) == dkey]
                 if siblings:

@@ -254,3 +254,247 @@ class TestConsolidatableMarker:
         env.store.update(nc)
         nc = remark(env, nc)
         assert not nc.conditions.is_true(COND_CONSOLIDATABLE)
+
+
+# ---------------------------------------------------------------------------
+# Widened port of drift_test.go: cloud-provider drift ordering, launch
+# gating, the requirement-operator table, and the static-field table.
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.api.nodeclaim import COND_LAUNCHED
+from karpenter_tpu.api.objects import Taint
+
+
+class TestCloudProviderDrift:
+    def test_cloud_provider_drift_detected(self, env):
+        nc = provision_one(env, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "drifted"
+
+    def test_static_drift_wins_over_cloud_provider_drift(self, env):
+        """drift_test.go:126-142."""
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        pool.spec.template.metadata_labels["team"] = "x"
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.get(COND_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_requirement_drift_wins_over_cloud_provider_drift(self, env):
+        """drift_test.go:143-159."""
+        pool = make_nodepool(name="default")
+        nc = provision_one(env, pool=pool, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(api_labels.LABEL_INSTANCE_TYPE,
+                                    "DoesNotExist", ())]
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.get(COND_DRIFTED).reason == "RequirementsDrifted"
+
+    def test_cleared_when_no_longer_drifted(self, env):
+        """drift_test.go:192-203."""
+        nc = provision_one(env, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        env.provider.is_drifted = lambda _nc: ""
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED)
+
+
+class TestLaunchGating:
+    """drift_test.go:160-183: drift is only evaluated on launched claims,
+    and an unlaunched claim sheds a stale Drifted condition."""
+
+    def test_launched_unknown_removes_drifted(self, env):
+        nc = provision_one(env, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        nc.conditions.set_unknown(COND_LAUNCHED)
+        env.store.update(nc)
+        nc = remark(env, nc)
+        assert nc.conditions.get(COND_DRIFTED) is None
+
+    def test_launched_false_removes_drifted(self, env):
+        nc = provision_one(env, cpu="500m")
+        env.provider.is_drifted = lambda _nc: "drifted"
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        nc.conditions.set_false(COND_LAUNCHED, reason="LaunchFailed")
+        env.store.update(nc)
+        nc = remark(env, nc)
+        assert nc.conditions.get(COND_DRIFTED) is None
+
+
+class TestRequirementDriftTable:
+    """drift_test.go:203-354 — the operator table. Each case: provision with
+    compatible pool requirements + claim labels, then swap the pool
+    requirements and check drift. Hash annotations are re-pinned so static
+    drift never fires and only RequirementsDrifted is observed."""
+
+    AMD = api_labels.ARCHITECTURE_AMD64
+    ARM = api_labels.ARCHITECTURE_ARM64
+    CT = api_labels.CAPACITY_TYPE_LABEL_KEY
+
+    def _run(self, env, old_reqs, new_reqs, labels):
+        pool = make_nodepool(name="default", requirements=old_reqs)
+        nc = provision_one(env, pool=pool, cpu="500m")
+        nc.metadata.labels.update(labels)
+        env.store.update(nc)
+        nc = remark(env, nc)
+        assert not nc.conditions.is_true(COND_DRIFTED), \
+            "pre-change state must not be drifted"
+        pool.spec.template.spec.requirements = list(new_reqs)
+        env.store.update(pool)
+        # re-pin the hash so only requirement drift can fire
+        nc.metadata.annotations[api_labels.NODEPOOL_HASH_ANNOTATION_KEY] = \
+            pool.static_hash()
+        env.store.update(nc)
+        nc = remark(env, nc)
+        return nc.conditions.is_true(COND_DRIFTED)
+
+    def test_updated_requirement_drifts(self, env):
+        assert self._run(
+            env,
+            [NodeSelectorRequirement(self.CT, "In", ("on-demand",)),
+             NodeSelectorRequirement(api_labels.LABEL_ARCH, "In", (self.AMD,))],
+            [NodeSelectorRequirement(self.CT, "In", ("spot",))],
+            {self.CT: "on-demand", api_labels.LABEL_ARCH: self.AMD})
+
+    def test_added_requirement_on_missing_label_drifts(self, env):
+        assert self._run(
+            env,
+            [NodeSelectorRequirement(self.CT, "In", ("on-demand",))],
+            [NodeSelectorRequirement(self.CT, "In", ("on-demand",)),
+             NodeSelectorRequirement("example.com/team", "In", ("a",))],
+            {self.CT: "on-demand"})
+
+    def test_reduced_requirement_drifts(self, env):
+        assert self._run(
+            env,
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.AMD, self.ARM))],
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.ARM,))],
+            {api_labels.LABEL_ARCH: self.AMD})
+
+    def test_expanded_requirement_no_drift(self, env):
+        assert not self._run(
+            env,
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.AMD,))],
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.AMD, self.ARM))],
+            {api_labels.LABEL_ARCH: self.AMD})
+
+    def test_exists_requirement_no_drift(self, env):
+        assert not self._run(
+            env,
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.AMD,))],
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "Exists", ())],
+            {api_labels.LABEL_ARCH: self.AMD})
+
+    def test_does_not_exist_requirement_drifts(self, env):
+        assert self._run(
+            env,
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH, "In",
+                                     (self.AMD,))],
+            [NodeSelectorRequirement(api_labels.LABEL_ARCH,
+                                     "DoesNotExist", ())],
+            {api_labels.LABEL_ARCH: self.AMD})
+
+    def test_gt_satisfied_no_drift(self, env):
+        assert not self._run(
+            env,
+            [],
+            [NodeSelectorRequirement("example.com/slots", "Gt", ("5",))],
+            {"example.com/slots": "10"})
+
+    def test_lt_satisfied_no_drift(self, env):
+        assert not self._run(
+            env,
+            [],
+            [NodeSelectorRequirement("example.com/slots", "Lt", ("5",))],
+            {"example.com/slots": "1"})
+
+
+class TestStaticDriftFieldTable:
+    """drift_test.go:456-480 — every static template field participates in
+    the hash."""
+
+    def _provision(self, env):
+        pool = make_nodepool(name="default")
+        spec = pool.spec.template.spec
+        pool.spec.template.metadata_labels["keyLabel"] = "valueLabel"
+        pool.spec.template.metadata_annotations["keyAnn"] = "valueAnn"
+        spec.expire_after = 300.0
+        spec.termination_grace_period = 300.0
+        nc = provision_one(env, pool=pool, cpu="500m")
+        assert not nc.conditions.is_true(COND_DRIFTED)
+        return pool, nc
+
+    def _assert_drifts(self, env, pool, nc, mutate):
+        mutate(pool)
+        env.store.update(pool)
+        nc = remark(env, nc)
+        assert nc.conditions.is_true(COND_DRIFTED)
+        assert nc.conditions.get(COND_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_annotations(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: p.spec.template.metadata_annotations.update(
+                {"keyAnnTest": "v"}))
+
+    def test_labels(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: p.spec.template.metadata_labels.update(
+                {"keyLabelTest": "v"}))
+
+    def test_taints(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: p.spec.template.spec.taints.append(
+                Taint(key="keytest2taint", effect="NoExecute")))
+
+    def test_startup_taints(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: p.spec.template.spec.startup_taints.append(
+                Taint(key="keytest2taint", effect="NoExecute")))
+
+    def test_expire_after(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: setattr(p.spec.template.spec, "expire_after", 6000.0))
+
+    def test_termination_grace_period(self, env):
+        pool, nc = self._provision(env)
+        self._assert_drifts(
+            env, pool, nc,
+            lambda p: setattr(p.spec.template.spec,
+                              "termination_grace_period", 6000.0))
+
+    def test_requirements_change_is_not_static_drift(self, env):
+        """Requirements are hashed OUT of the static hash (they have their
+        own drift mechanism): a requirement change alone must not produce
+        NodePoolDrifted."""
+        pool, nc = self._provision(env)
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(api_labels.LABEL_ARCH, "Exists", ())]
+        env.store.update(pool)
+        nc = remark(env, nc)
+        if nc.conditions.is_true(COND_DRIFTED):
+            assert nc.conditions.get(COND_DRIFTED).reason != "NodePoolDrifted"

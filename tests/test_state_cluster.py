@@ -361,8 +361,7 @@ class TestNodeResourceLevel:
         for p in pods:
             store.create(p)
             bind(store, p, "n1")
-            total += (int(p.name.split("-")[-1]) * 0 +
-                      p.requests()["cpu"])
+            total += p.requests()["cpu"]
         sn = cluster.nodes["test://n1"]
         assert sn.pod_request_total()["cpu"] == total
         for p in pods[::2]:
