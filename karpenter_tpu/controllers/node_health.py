@@ -13,6 +13,7 @@ from typing import Optional
 from ..api.objects import Node
 from ..kube.store import Store
 from ..state.cluster import Cluster
+from ..utils import node as node_utils
 from ..utils.clock import Clock
 from .manager import Controller, Result
 
@@ -37,17 +38,10 @@ class NodeHealth(Controller):
         if not policies:
             return None
         matched = None
-        for cond in node.status.conditions:
-            ctype = cond.get("type") if isinstance(cond, dict) else cond.type
-            cstatus = cond.get("status") if isinstance(cond, dict) else cond.status
-            ctime = (cond.get("last_transition_time", 0.0)
-                     if isinstance(cond, dict)
-                     else getattr(cond, "last_transition_time", 0.0))
-            for p in policies:
-                if p.condition_type == ctype and p.condition_status == cstatus:
-                    matched = (p, ctime)
-                    break
-            if matched:
+        for p in policies:
+            cond = node_utils.get_condition(node, p.condition_type)
+            if cond is not None and cond[0] == p.condition_status:
+                matched = (p, cond[1])
                 break
         if matched is None:
             return None

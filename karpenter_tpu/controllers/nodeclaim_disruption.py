@@ -49,9 +49,8 @@ class NodeClaimDisruptionMarker(Controller):
             if nc.conditions.get(COND_DRIFTED) is not None:
                 nc.conditions.clear(COND_DRIFTED)
                 self.store.update(nc)
-            return Result(requeue_after=min(requeue or DRIFT_RECHECK_SECONDS,
-                                            DRIFT_RECHECK_SECONDS))
-        self._drifted(nc)
+        else:
+            self._drifted(nc)
         # drift inputs are external (catalog, cloud provider): re-check on a
         # timer even with no claim events (drift.go:68,76 — 5 min cache TTL)
         return Result(requeue_after=min(requeue or DRIFT_RECHECK_SECONDS,

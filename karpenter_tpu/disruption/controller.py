@@ -161,6 +161,7 @@ class DisruptionController(SingletonController):
     def reconcile(self) -> Optional[Result]:
         if not self.cluster.synced():
             return Result(requeue_after=1.0)
+        self._cleanup_stale_taints()
         if self.pending is not None:
             return self._reconcile_pending()
         for method in self.methods:
@@ -172,6 +173,27 @@ class DisruptionController(SingletonController):
             if executed:
                 return Result(requeue_after=POLL_INTERVAL_SECONDS)
         return Result(requeue_after=POLL_INTERVAL_SECONDS)
+
+    def _cleanup_stale_taints(self) -> None:
+        """controller.go:124-135: a crash mid-disruption can leave nodes
+        tainted disrupted:NoSchedule with no queue entry driving them —
+        idempotently untaint every node not in the orchestration queue."""
+        for sn in self.cluster.state_nodes(deep_copy=False):
+            if self.queue.has_any(sn.provider_id) or sn.node is None:
+                continue
+            # a deleting/terminating node is the NodeTermination controller's
+            # to manage — untainting it would let pods bind back onto a
+            # draining node (statenode.go:461-479 skips these)
+            if sn.deleting() or sn.nodeclaim is None:
+                continue
+            node = self.store.get(Node, sn.name())
+            if node is None or node.metadata.deletion_timestamp is not None:
+                continue
+            kept = [t for t in node.spec.taints
+                    if not t.matches(DISRUPTED_NO_SCHEDULE_TAINT)]
+            if len(kept) != len(node.spec.taints):
+                node.spec.taints = kept
+                self.store.update(node)
 
     def _reconcile_pending(self) -> Optional[Result]:
         cmd, computed_at = self.pending

@@ -12,11 +12,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..api.nodeclaim import COND_INSTANCE_TERMINATING
 from ..api.nodepool import NodePool
 from ..api.objects import Pod
 from ..api.policy import PodDisruptionBudget
 from ..provisioning.provisioner import Provisioner
 from ..state.cluster import Cluster
+from ..utils import node as node_utils
 from ..utils import pod as pod_utils
 from ..utils.pdb import Limits
 from .types import Candidate, CandidateError, new_candidate
@@ -71,18 +73,32 @@ def get_candidates(cluster: Cluster, provisioner: Provisioner,
     return out
 
 
+def _node_not_ready(sn) -> bool:
+    cond = node_utils.get_condition(sn.node, "Ready")
+    # no Ready condition recorded: assume healthy (the in-process kubelet
+    # sim doesn't stamp Ready; a real apiserver always does)
+    return cond is not None and cond[0] != "True"
+
+
 def build_disruption_budget_mapping(cluster: Cluster, reason: str) -> Dict[str, int]:
-    """helpers.go:197-245: allowed = budget - already-disrupting, per pool."""
+    """helpers.go:197-245: allowed = budget - already-disrupting, per pool.
+    Only managed+initialized nodes count toward the total (uninitialized
+    replacements must not inflate percentage budgets); claims with the
+    InstanceTerminating condition are already gone; NotReady or
+    marked-for-deletion nodes consume budget."""
     now = cluster.clock.now()
     allowed: Dict[str, int] = {}
     nodes_per_pool: Dict[str, int] = {}
     disrupting_per_pool: Dict[str, int] = {}
     for sn in cluster.state_nodes(deep_copy=False):
         pool = sn.nodepool_name()
-        if not pool:
+        if not pool or not sn.managed() or not sn.initialized():
+            continue
+        if sn.nodeclaim is not None and \
+                sn.nodeclaim.conditions.is_true(COND_INSTANCE_TERMINATING):
             continue
         nodes_per_pool[pool] = nodes_per_pool.get(pool, 0) + 1
-        if sn.deleting():
+        if sn.deleting() or _node_not_ready(sn):
             disrupting_per_pool[pool] = disrupting_per_pool.get(pool, 0) + 1
     for np in cluster.store.list(NodePool):
         total = np.allowed_disruptions(now, nodes_per_pool.get(np.name, 0), reason)

@@ -700,6 +700,10 @@ def pv_to_k8s(pv) -> dict:
     if pv.spec.csi is not None:
         spec["csi"] = {"driver": pv.spec.csi.driver,
                        "volumeHandle": pv.metadata.name}
+    else:
+        # a PV must carry SOME volume source or the apiserver 422s; non-CSI
+        # PVs (zonal-affinity-only fixtures) ride as hostPath placeholders
+        spec["hostPath"] = {"path": f"/tmp/{pv.metadata.name}"}
     if pv.spec.node_affinity_terms:
         spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
             _nsterm_to_k8s(t) for t in pv.spec.node_affinity_terms]}}

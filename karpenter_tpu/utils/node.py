@@ -1,0 +1,20 @@
+"""Node helpers (/root/reference/pkg/utils/node/node.go)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def get_condition(node, ctype: str) -> Optional[Tuple[str, float]]:
+    """(status, lastTransitionTime) of a node condition; conditions may be
+    dicts (codec/test-seeded) or objects (node.go GetCondition)."""
+    for cond in node.status.conditions:
+        is_dict = isinstance(cond, dict)
+        t = cond.get("type") if is_dict else cond.type
+        if t != ctype:
+            continue
+        status = cond.get("status") if is_dict else cond.status
+        when = (cond.get("last_transition_time", 0.0) if is_dict
+                else getattr(cond, "last_transition_time", 0.0))
+        return status, when
+    return None

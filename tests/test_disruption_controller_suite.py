@@ -1,0 +1,479 @@
+"""Scenario port of /root/reference/pkg/controllers/disruption/suite_test.go
+(2,139 LoC): candidate-filtering table, disruption-budget mapping exclusions,
+disruption taints (stale cleanup + failure rollback), pod eviction cost, and
+decision metrics."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_INITIALIZED,
+                                         COND_INSTANCE_TERMINATING, NodeClaim)
+from karpenter_tpu.api.nodepool import Budget, NodePool
+from karpenter_tpu.api.objects import (LabelSelector, Node, ObjectMeta,
+                                       OwnerReference, Pod)
+from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.node_termination import NodeTermination
+from karpenter_tpu.disruption.controller import (DisruptionController,
+                                                 OrchestrationQueue,
+                                                 QueuedCommand)
+from karpenter_tpu.disruption.helpers import (build_disruption_budget_mapping,
+                                              get_candidates)
+from karpenter_tpu.disruption.types import Command
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.metrics.registry import DISRUPTION_DECISIONS
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.disruption import (POD_DELETION_COST_ANNOTATION,
+                                            eviction_cost)
+
+from factories import make_nodepool, make_pod
+
+OD = {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND}
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    queue = OrchestrationQueue(store, cluster, clock)
+    disruption = DisruptionController(store, cluster, provisioner, queue, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock),
+                 NodeClaimDisruptionMarker(store, cluster, provider, clock),
+                 NodeTermination(store, cluster, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.provisioner, e.queue, e.disruption = provisioner, queue, disruption
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def provision_node(env, pool_name="default", cpu="2500m", name=None, tgp=None):
+    if env.store.get(NodePool, pool_name) is None:
+        env.store.create(make_nodepool(name=pool_name))
+    pod = make_pod(cpu=cpu, name=name, node_selector=dict(OD))
+    env.store.create(pod)
+    settle(env, rounds=3)
+    nc = env.store.list(NodeClaim)[-1]
+    if tgp is not None:
+        nc.spec.termination_grace_period = tgp
+        env.store.update(nc)
+    return nc, env.store.get(Node, nc.status.node_name), pod
+
+
+def candidates(env, disruption_class="graceful", disrupting=()):
+    return get_candidates(env.cluster, env.provisioner, lambda c: True,
+                          disrupting_provider_ids=disrupting,
+                          disruption_class=disruption_class)
+
+
+class TestCandidateFiltering:
+    """suite_test.go:834-1774."""
+
+    def test_do_not_disrupt_pod_blocks_without_tgp(self, env):
+        nc, node, pod = provision_node(env)
+        pod.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.update(pod)
+        assert candidates(env) == []
+
+    def test_do_not_disrupt_pod_with_tgp_allows_eventual(self, env):
+        """suite_test.go:958-986."""
+        nc, node, pod = provision_node(env, tgp=300.0)
+        pod.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.update(pod)
+        assert len(candidates(env, disruption_class="eventual")) == 1
+
+    def test_do_not_disrupt_pod_with_tgp_blocks_graceful(self, env):
+        """suite_test.go:1019-1047."""
+        nc, node, pod = provision_node(env, tgp=300.0)
+        pod.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.update(pod)
+        assert candidates(env, disruption_class="graceful") == []
+
+    def test_pdb_blocked_pod_with_tgp_allows_eventual(self, env):
+        """suite_test.go:987-1018."""
+        nc, node, pod = provision_node(env, tgp=300.0)
+        pod.metadata.labels["app"] = "blocked"
+        env.store.update(pod)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "blocked"}),
+                         max_unavailable="0")))
+        assert candidates(env, disruption_class="graceful") == []
+        assert len(candidates(env, disruption_class="eventual")) == 1
+
+    def test_do_not_disrupt_mirror_pod_does_not_block(self, env):
+        """suite_test.go:881-918: node-owned (mirror) pods aren't evictable,
+        so their annotations don't gate disruption."""
+        nc, node, pod = provision_node(env)
+        mirror = make_pod(cpu="100m", name="mirror")
+        mirror.metadata.owner_refs.append(OwnerReference(kind="Node",
+                                                         name=node.name))
+        mirror.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        mirror.spec.node_name = node.name
+        env.store.create(mirror)
+        settle(env)
+        assert len(candidates(env)) == 1
+
+    def test_do_not_disrupt_daemonset_pod_blocks(self, env):
+        """suite_test.go:919-957."""
+        nc, node, pod = provision_node(env)
+        ds = make_pod(cpu="100m", name="ds")
+        ds.is_daemonset_pod = True
+        ds.metadata.owner_refs.append(OwnerReference(kind="DaemonSet",
+                                                     name="fluentd"))
+        ds.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        ds.spec.node_name = node.name
+        env.store.create(ds)
+        settle(env)
+        assert candidates(env) == []
+
+    def test_do_not_disrupt_terminating_pod_does_not_block(self, env):
+        """suite_test.go:1147-1176."""
+        nc, node, pod = provision_node(env)
+        doomed = make_pod(cpu="100m", name="doomed")
+        doomed.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        doomed.metadata.finalizers.append("test/hold")
+        doomed.spec.node_name = node.name
+        env.store.create(doomed)
+        env.store.delete(doomed)  # terminating, still present
+        settle(env)
+        assert len(candidates(env)) == 1
+
+    def test_do_not_disrupt_terminal_pod_does_not_block(self, env):
+        """suite_test.go:1177-1214."""
+        nc, node, pod = provision_node(env)
+        done = make_pod(cpu="100m", name="done")
+        done.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        done.status.phase = "Succeeded"
+        done.spec.node_name = node.name
+        env.store.create(done)
+        settle(env)
+        assert len(candidates(env)) == 1
+
+    def test_do_not_disrupt_on_node_blocks(self, env):
+        """suite_test.go:1215-1237."""
+        nc, node, pod = provision_node(env)
+        node.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.store.update(node)
+        assert candidates(env) == []
+
+    def test_fully_blocking_pdb_blocks(self, env):
+        """suite_test.go:1238-1273."""
+        nc, node, pod = provision_node(env)
+        pod.metadata.labels["app"] = "blocked"
+        env.store.update(pod)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "blocked"}),
+                         max_unavailable="0")))
+        assert candidates(env) == []
+
+    def test_blocking_pdb_on_mirror_pod_does_not_block(self, env):
+        """suite_test.go:1321-1366."""
+        nc, node, pod = provision_node(env)
+        mirror = make_pod(cpu="100m", name="mirror", labels={"app": "blocked"})
+        mirror.metadata.owner_refs.append(OwnerReference(kind="Node",
+                                                         name=node.name))
+        mirror.spec.node_name = node.name
+        env.store.create(mirror)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "blocked"}),
+                         max_unavailable="0")))
+        settle(env)
+        assert len(candidates(env)) == 1
+
+    def test_blocking_pdb_on_terminal_pod_does_not_block(self, env):
+        """suite_test.go:1432-1475."""
+        nc, node, pod = provision_node(env)
+        done = make_pod(cpu="100m", name="done", labels={"app": "blocked"})
+        done.status.phase = "Failed"
+        done.spec.node_name = node.name
+        env.store.create(done)
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "blocked"}),
+                         max_unavailable="0")))
+        settle(env)
+        assert len(candidates(env)) == 1
+
+    def test_node_only_representation_not_considered(self, env):
+        """suite_test.go:1514-1532: no NodeClaim -> not disruptable."""
+        from karpenter_tpu.api.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils import resources as res
+        alloc = res.parse_list({"cpu": "4", "memory": "8Gi", "pods": "110"})
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(Node(
+            metadata=ObjectMeta(name="orphan", namespace="", labels={
+                api_labels.LABEL_HOSTNAME: "orphan",
+                api_labels.NODEPOOL_LABEL_KEY: "default",
+                api_labels.NODE_INITIALIZED_LABEL_KEY: "true"}),
+            spec=NodeSpec(provider_id="test://orphan"),
+            status=NodeStatus(capacity=dict(alloc), allocatable=alloc)))
+        assert candidates(env) == []
+
+    def test_nodeclaim_only_representation_not_considered(self, env):
+        """suite_test.go:1533-1551: claim with no Node is not initialized."""
+        env.store.create(make_nodepool(name="default"))
+        nc = NodeClaim(metadata=ObjectMeta(name="lone", namespace="", labels={
+            api_labels.NODEPOOL_LABEL_KEY: "default"}))
+        nc.status.provider_id = "test://lone"
+        env.store.create(nc)
+        assert candidates(env) == []
+
+    def test_nominated_node_not_considered(self, env):
+        """suite_test.go:1552-1572."""
+        nc, node, pod = provision_node(env)
+        env.cluster.nominate_node_for_pod(node.name, make_pod(name="pend"))
+        assert candidates(env) == []
+
+    def test_deleting_node_not_considered(self, env):
+        """suite_test.go:1573-1594."""
+        nc, node, pod = provision_node(env)
+        env.cluster.mark_for_deletion(nc.status.provider_id)
+        assert candidates(env) == []
+
+    def test_uninitialized_not_considered(self, env):
+        """suite_test.go:1616-1635."""
+        nc, node, pod = provision_node(env)
+        del node.metadata.labels[api_labels.NODE_INITIALIZED_LABEL_KEY]
+        env.store.update(node)
+        assert candidates(env) == []
+
+    def test_no_nodepool_label_not_considered(self, env):
+        """suite_test.go:1636-1654."""
+        nc, node, pod = provision_node(env)
+        del node.metadata.labels[api_labels.NODEPOOL_LABEL_KEY]
+        env.store.update(node)
+        assert candidates(env) == []
+
+    def test_nonexistent_nodepool_not_considered(self, env):
+        """suite_test.go:1655-1679."""
+        nc, node, pod = provision_node(env)
+        env.store.delete(env.store.get(NodePool, "default"))
+        assert candidates(env) == []
+
+    def test_missing_optional_labels_still_considered(self, env):
+        """suite_test.go:1680-1751: capacity-type / zone / instance-type
+        labels and even an unresolvable instance type don't gate candidacy."""
+        nc, node, pod = provision_node(env)
+        for key in (api_labels.CAPACITY_TYPE_LABEL_KEY,
+                    api_labels.LABEL_TOPOLOGY_ZONE):
+            node.metadata.labels.pop(key, None)
+        node.metadata.labels[api_labels.LABEL_INSTANCE_TYPE] = "no-such-type"
+        env.store.update(node)
+        got = candidates(env)
+        assert len(got) == 1
+        assert got[0].instance_type is None
+
+    def test_in_queue_candidate_excluded(self, env):
+        """suite_test.go:1752-1774."""
+        nc, node, pod = provision_node(env)
+        assert len(candidates(env)) == 1
+        assert candidates(env, disrupting=(nc.status.provider_id,)) == []
+
+
+class TestBudgetMapping:
+    """suite_test.go:601-778."""
+
+    def _fleet(self, env, n=4):
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(pool)
+        for i in range(n):
+            env.store.create(make_pod(cpu="2500m", name=f"w-{i}",
+                                      node_selector=dict(OD)))
+            settle(env, rounds=3)
+        return pool
+
+    def test_full_budget_counts_all_nodes(self, env):
+        self._fleet(env)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 4
+
+    def test_uninitialized_nodes_not_counted(self, env):
+        """suite_test.go:648-678."""
+        self._fleet(env)
+        node = env.store.list(Node)[0]
+        del node.metadata.labels[api_labels.NODE_INITIALIZED_LABEL_KEY]
+        env.store.update(node)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 3
+
+    def test_instance_terminating_not_counted(self, env):
+        """suite_test.go:679-710."""
+        self._fleet(env)
+        nc = env.store.list(NodeClaim)[0]
+        nc.conditions.set_true(COND_INSTANCE_TERMINATING, reason="Deleting")
+        env.store.update(nc)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 3
+
+    def test_never_negative(self, env):
+        """suite_test.go:711-731: more disrupting nodes than budget."""
+        pool = self._fleet(env)
+        pool.spec.disruption.budgets = [Budget(nodes="1")]
+        env.store.update(pool)
+        for nc in env.store.list(NodeClaim)[:3]:
+            env.cluster.mark_for_deletion(nc.status.provider_id)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 0
+
+    def test_marked_for_deletion_consumes_budget(self, env):
+        """suite_test.go:732-755."""
+        self._fleet(env)
+        nc = env.store.list(NodeClaim)[0]
+        env.cluster.mark_for_deletion(nc.status.provider_id)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 3
+
+    def test_not_ready_node_consumes_budget(self, env):
+        """suite_test.go:756-778."""
+        self._fleet(env)
+        node = env.store.list(Node)[0]
+        node.status.conditions.append(
+            {"type": "Ready", "status": "False"})
+        env.store.update(node)
+        assert build_disruption_budget_mapping(
+            env.cluster, "underutilized")["default"] == 3
+
+
+class TestDisruptionTaints:
+    """suite_test.go:465-600."""
+
+    def test_stale_taint_removed_when_not_in_queue(self, env):
+        """suite_test.go:526-545: taints left by a crashed disruption action
+        are cleaned on the next loop."""
+        nc, node, pod = provision_node(env)
+        node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        env.store.update(node)
+        env.disruption.reconcile()
+        node = env.store.get(Node, node.name)
+        assert not any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT)
+                       for t in node.spec.taints)
+
+    def test_taint_kept_while_command_in_queue(self, env):
+        nc, node, pod = provision_node(env)
+        node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        env.store.update(node)
+        cand = candidates(env, disrupting=())  # node not yet marked
+        assert len(cand) == 1
+        qc = QueuedCommand(command=Command(candidates=cand, reason="drifted"),
+                           enqueued_at=env.clock.now(),
+                           replacement_names=["ghost-replacement"])
+        env.queue.add(qc)
+        env.disruption.reconcile()
+        node = env.store.get(Node, node.name)
+        assert any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT)
+                   for t in node.spec.taints)
+
+    def test_rollback_untaints_failed_disruption(self, env):
+        """suite_test.go:546-600: replacement dies -> candidates untainted
+        and unmarked."""
+        nc, node, pod = provision_node(env)
+        node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        env.store.update(node)
+        cand = candidates(env)
+        qc = QueuedCommand(command=Command(candidates=cand, reason="drifted"),
+                           enqueued_at=env.clock.now(),
+                           replacement_names=["never-created"])
+        env.queue.add(qc)
+        env.cluster.mark_for_deletion(nc.status.provider_id)
+        env.queue.reconcile()  # replacement missing -> rollback
+        node = env.store.get(Node, node.name)
+        assert not any(t.matches(DISRUPTED_NO_SCHEDULE_TAINT)
+                       for t in node.spec.taints)
+        assert not env.cluster.nodes[nc.status.provider_id].mark_for_deletion
+
+
+class TestPodEvictionCost:
+    """suite_test.go:779-833."""
+
+    def test_standard_cost(self):
+        assert eviction_cost(make_pod()) == 1.0
+
+    def test_positive_deletion_cost_raises(self):
+        p = make_pod()
+        p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = "100"
+        assert eviction_cost(p) > 1.0
+
+    def test_negative_deletion_cost_lowers(self):
+        p = make_pod()
+        p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = "-100"
+        assert eviction_cost(p) < 1.0
+
+    def test_higher_costs_order(self):
+        costs = []
+        for raw in ("-100", "0", "100", "10000"):
+            p = make_pod()
+            p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = raw
+            costs.append(eviction_cost(p))
+        assert costs == sorted(costs)
+        assert len(set(costs)) == len(costs)
+
+    def test_priority_raises_cost(self):
+        lo_, hi = make_pod(), make_pod()
+        lo_.spec.priority = 0
+        hi.spec.priority = 1_000_000
+        assert eviction_cost(hi) > eviction_cost(lo_)
+
+
+class TestDecisionMetrics:
+    """suite_test.go:1775-1965 (decision counters)."""
+
+    def test_delete_decision_counter_increments(self, env):
+        before = DISRUPTION_DECISIONS.value(
+            {"decision": "delete", "reason": "Empty",
+             "consolidation_type": "empty"})
+        pool = make_nodepool(name="default")
+        env.store.create(pool)
+        pod = make_pod(cpu="2500m", node_selector=dict(OD))
+        env.store.create(pod)
+        settle(env, rounds=3)
+        env.store.delete(pod)
+        settle(env)
+        env.clock.step(21)
+        settle(env, rounds=2)
+        for _ in range(6):
+            env.disruption.reconcile()
+            env.queue.reconcile()
+            settle(env, rounds=2)
+            env.clock.step(8)
+        assert env.store.list(Node) == []
+        after = DISRUPTION_DECISIONS.value(
+            {"decision": "delete", "reason": "Empty",
+             "consolidation_type": "empty"})
+        assert after == before + 1

@@ -1,0 +1,252 @@
+"""Scenario port of /root/reference/pkg/controllers/disruption/
+emptiness_test.go (773 LoC): consolidatable-condition gating, multi-node
+deletes, daemonset/terminating-pod emptiness semantics, pending-pod
+awareness, the consolidateAfter TTL, and the eligible-nodes metric."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import COND_CONSOLIDATABLE, NodeClaim
+from karpenter_tpu.api.objects import Node, ObjectMeta, OwnerReference, Pod
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_disruption import NodeClaimDisruptionMarker
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.node_termination import NodeTermination
+from karpenter_tpu.disruption.controller import (DisruptionController,
+                                                 OrchestrationQueue)
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.metrics.registry import DISRUPTION_ELIGIBLE_NODES
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod
+
+OD = {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND}
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    queue = OrchestrationQueue(store, cluster, clock)
+    disruption = DisruptionController(store, cluster, provisioner, queue, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock),
+                 NodeClaimDisruptionMarker(store, cluster, provider, clock),
+                 NodeTermination(store, cluster, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.provisioner, e.queue, e.disruption = provisioner, queue, disruption
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def disrupt(env, rounds=8):
+    for _ in range(rounds):
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        env.clock.step(8)
+
+
+def strand_empty(env, n=1, pool_name="default", consolidate_after=None,
+                 cpu="2500m"):
+    """Provision n single-pod nodes, then delete the pods so the nodes sit
+    empty; returns after the consolidatable TTL (if any) has elapsed."""
+    pool = make_nodepool(name=pool_name)
+    if consolidate_after is not None:
+        pool.spec.disruption.consolidate_after = consolidate_after
+    env.store.create(pool)
+    pods = []
+    for i in range(n):
+        p = make_pod(cpu=cpu, name=f"empt-{i}", node_selector=dict(OD))
+        env.store.create(p)
+        pods.append(p)
+        settle(env, rounds=3)
+    for p in pods:
+        env.store.delete(p)
+    settle(env)
+    env.clock.step((consolidate_after or 0.0) + 21)
+    settle(env, rounds=2)
+    return pool
+
+
+class TestConsolidatableGating:
+    """emptiness_test.go:392-472."""
+
+    def test_deletes_empty_consolidatable_node(self, env):
+        strand_empty(env)
+        disrupt(env)
+        assert env.store.list(Node) == []
+        assert env.store.list(NodeClaim) == []
+
+    def test_ignores_node_without_consolidatable_condition(self, env):
+        strand_empty(env)
+        nc = env.store.list(NodeClaim)[0]
+        nc.conditions.clear(COND_CONSOLIDATABLE)
+        env.store.update(nc)
+        # run only the disruption pass (the marker would re-set the condition)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        assert len(env.store.list(Node)) == 1
+
+    def test_ignores_consolidatable_false(self, env):
+        strand_empty(env)
+        nc = env.store.list(NodeClaim)[0]
+        nc.conditions.set_false(COND_CONSOLIDATABLE, reason="NotYet")
+        env.store.update(nc)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        assert len(env.store.list(Node)) == 1
+
+    def test_waits_for_consolidate_after_ttl(self, env):
+        """emptiness_test.go:733+: the node TTL (consolidateAfter) must
+        elapse before emptiness fires."""
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.consolidate_after = 120.0
+        env.store.create(pool)
+        pod = make_pod(cpu="2500m", node_selector=dict(OD))
+        env.store.create(pod)
+        settle(env, rounds=3)
+        env.store.delete(pod)
+        settle(env)
+        env.clock.step(30)  # < TTL
+        settle(env, rounds=2)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        assert len(env.store.list(Node)) == 1
+        env.clock.step(120)  # TTL elapses
+        settle(env, rounds=2)
+        disrupt(env)
+        assert env.store.list(Node) == []
+
+
+class TestEmptinessSemantics:
+    """emptiness_test.go:473-732."""
+
+    def test_deletes_multiple_empty_nodes(self, env):
+        strand_empty(env, n=3)
+        disrupt(env, rounds=12)  # default 10% budget trims each pass
+        assert env.store.list(Node) == []
+        assert env.store.list(NodeClaim) == []
+
+    def test_daemonset_only_node_is_empty(self, env):
+        strand_empty(env)
+        node = env.store.list(Node)[0]
+        ds_pod = make_pod(cpu="100m")
+        ds_pod.is_daemonset_pod = True
+        ds_pod.metadata.owner_refs.append(
+            OwnerReference(kind="DaemonSet", name="fluentd"))
+        ds_pod.spec.node_name = node.name
+        env.store.create(ds_pod)
+        settle(env)
+        disrupt(env)
+        assert env.store.list(Node) == []
+
+    def test_terminating_deployment_pods_are_empty(self, env):
+        """emptiness_test.go:611-675: ReplicaSet-owned pods already being
+        evicted don't hold the node."""
+        strand_empty(env)
+        node = env.store.list(Node)[0]
+        for i in range(3):
+            p = make_pod(cpu="100m", name=f"rs-pod-{i}")
+            p.metadata.owner_refs.append(
+                OwnerReference(kind="ReplicaSet", name="rs-1"))
+            p.metadata.finalizers.append("test/hold")  # keep it terminating
+            p.spec.node_name = node.name
+            env.store.create(p)
+            env.store.delete(p)  # stamps deletionTimestamp, pod remains
+        settle(env)
+        disrupt(env, rounds=4)
+        # the emptiness decision fires: the claim is deleting (full drain
+        # can't finish here because the test finalizer pins the pods)
+        [nc] = env.store.list(NodeClaim)
+        assert nc.metadata.deletion_timestamp is not None
+
+    def test_terminating_statefulset_pod_is_not_empty(self, env):
+        """emptiness_test.go:676-732: sticky identity — the replacement pod
+        can't exist until the old one dies, so the node is NOT empty."""
+        strand_empty(env)
+        node = env.store.list(Node)[0]
+        p = make_pod(cpu="100m", name="ss-pod-0")
+        p.metadata.owner_refs.append(
+            OwnerReference(kind="StatefulSet", name="ss-1"))
+        p.metadata.finalizers.append("test/hold")
+        p.spec.node_name = node.name
+        env.store.create(p)
+        env.store.delete(p)
+        settle(env)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        env.clock.step(20)
+        env.queue.reconcile()
+        settle(env, rounds=2)
+        assert len(env.store.list(Node)) == 1
+        [nc] = env.store.list(NodeClaim)
+        assert nc.metadata.deletion_timestamp is None  # emptiness never fired
+
+    def test_considers_pending_pods(self, env):
+        """emptiness_test.go:497-554: a huge pending pod that needs the
+        node's capacity keeps the (nearly empty) node alive."""
+        pool = make_nodepool(name="default")
+        env.store.create(pool)
+        big = make_pod(cpu="30", memory="16Gi", name="big-seed",
+                       node_selector=dict(OD))
+        env.store.create(big)
+        settle(env, rounds=3)
+        assert len(env.store.list(Node)) == 1
+        node = env.store.list(Node)[0]
+        # swap the big seed for a small pod: node is now mostly idle
+        env.store.delete(big)
+        small = make_pod(cpu="1", name="small")
+        small.spec.node_name = node.name
+        env.store.create(small)
+        settle(env)
+        env.clock.step(21)
+        settle(env, rounds=2)
+        # a pending pod that only fits on this node (everything else would
+        # need a new claim, which the simulation must not prefer silently)
+        huge = make_pod(cpu="28", memory="8Gi", name="huge",
+                        node_selector=dict(OD))
+        env.store.create(huge)
+        # single disruption pass BEFORE the provisioner binds the pod
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        # the node survives: the simulation counts the pending pod
+        assert len(env.store.list(Node)) >= 1
+        assert env.store.get(Node, node.name) is not None
+
+
+class TestEligibleNodesMetric:
+    """emptiness_test.go:86-114."""
+
+    def test_eligible_nodes_gauge(self, env):
+        strand_empty(env, n=2)
+        env.disruption.reconcile()
+        assert DISRUPTION_ELIGIBLE_NODES.value({"reason": "empty"}) >= 0
+        # after the fleet drains there is nothing eligible
+        disrupt(env)
+        env.disruption.reconcile()
+        assert DISRUPTION_ELIGIBLE_NODES.value({"reason": "empty"}) == 0
