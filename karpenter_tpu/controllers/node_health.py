@@ -73,12 +73,9 @@ class NodeHealth(Controller):
         policies = self.cloud_provider.repair_policies()
         unhealthy = 0
         for n in nodes:
-            for cond in n.status.conditions:
-                ctype = cond.get("type") if isinstance(cond, dict) else cond.type
-                cstatus = (cond.get("status") if isinstance(cond, dict)
-                           else cond.status)
-                if any(p.condition_type == ctype
-                       and p.condition_status == cstatus for p in policies):
+            for p in policies:
+                cond = node_utils.get_condition(n, p.condition_type)
+                if cond is not None and cond[0] == p.condition_status:
                     unhealthy += 1
                     break
         threshold = math.ceil(UNHEALTHY_CLUSTER_THRESHOLD * len(nodes))

@@ -358,10 +358,15 @@ def pod_to_k8s(p: Pod) -> dict:
         containers.append(c)
     spec["containers"] = containers
     if p.init_container_requests:
-        spec["initContainers"] = [
-            {"name": f"i{i}", "image": "pause",
-             "resources": {"requests": resources_to_k8s(req)}}
-            for i, req in enumerate(p.init_container_requests)]
+        inits = []
+        for i, entry in enumerate(p.init_container_requests):
+            req, always = entry if isinstance(entry, tuple) else (entry, False)
+            c = {"name": f"i{i}", "image": "pause",
+                 "resources": {"requests": resources_to_k8s(req)}}
+            if always:  # native sidecar
+                c["restartPolicy"] = "Always"
+            inits.append(c)
+        spec["initContainers"] = inits
     if p.spec.volumes:
         spec["volumes"] = [
             ({"name": f"v{i}", "ephemeral": {
@@ -429,6 +434,8 @@ def pod_from_k8s(d: dict) -> Pod:
             resources_from_k8s((c.get("resources") or {}).get("requests"))
             for c in containers],
         init_container_requests=[
+            (resources_from_k8s((c.get("resources") or {}).get("requests")),
+             True) if c.get("restartPolicy") == "Always" else
             resources_from_k8s((c.get("resources") or {}).get("requests"))
             for c in spec.get("initContainers") or []],
         is_daemonset_pod=any(o.get("kind") == "DaemonSet" for o in
@@ -454,6 +461,17 @@ def node_to_k8s(n: Node) -> dict:
                 "capacity": resources_to_k8s(n.status.capacity),
                 "allocatable": resources_to_k8s(n.status.allocatable),
                 **({"phase": n.status.phase} if n.status.phase else {}),
+                **({"conditions": [
+                    {"type": (c.get("type") if isinstance(c, dict)
+                              else c.type),
+                     "status": (c.get("status") if isinstance(c, dict)
+                                else c.status),
+                     "lastTransitionTime": ts_to_k8s(
+                         c.get("last_transition_time", 0.0)
+                         if isinstance(c, dict)
+                         else getattr(c, "last_transition_time", 0.0))}
+                    for c in n.status.conditions]}
+                   if n.status.conditions else {}),
             }}
 
 
@@ -465,9 +483,16 @@ def node_from_k8s(d: dict) -> Node:
         spec=NodeSpec(provider_id=spec.get("providerID", ""),
                       taints=[_taint_from_k8s(t)
                               for t in spec.get("taints") or []]),
-        status=NodeStatus(capacity=resources_from_k8s(status.get("capacity")),
-                          allocatable=resources_from_k8s(
-                              status.get("allocatable"))))
+        status=NodeStatus(
+            capacity=resources_from_k8s(status.get("capacity")),
+            allocatable=resources_from_k8s(status.get("allocatable")),
+            # kubelet conditions feed NotReady budget accounting and the
+            # node-repair policies (helpers._node_not_ready, node_health)
+            conditions=[
+                {"type": c.get("type", ""), "status": c.get("status", ""),
+                 "last_transition_time": ts_from_k8s(
+                     c.get("lastTransitionTime"))}
+                for c in status.get("conditions") or []]))
 
 
 # -- NodeClaim ---------------------------------------------------------------

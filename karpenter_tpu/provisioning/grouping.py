@@ -43,6 +43,14 @@ from ..api import labels as api_labels
 from ..api.objects import DO_NOT_SCHEDULE, Pod
 from ..scheduling.requirements import (Requirements, has_preferred_node_affinity,
                                        pod_requirements)
+from ..utils import resources as res
+
+
+def _init_sig(entry):
+    """Canonical signature for an init-container entry: (sorted items,
+    sidecar flag) — both plain dicts and (requests, always) tuples."""
+    req, always = res.init_entry(entry)
+    return tuple(sorted(req.items())), always
 
 # topology kinds
 TOPO_NONE = "none"
@@ -242,6 +250,7 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
 
     ident = lambda o: o
     items_key = lambda d: tuple(sorted(d.items()))
+    init_key = _init_sig
     reasons: Dict[int, str] = {}  # id(bucket) -> why it's host-path
 
     if prebuckets is not None:
@@ -256,8 +265,7 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
                    tuple(sorted(probe.labels.items())),
                    tuple(tuple(sorted(r.items()))
                          for r in probe.container_requests),
-                   tuple(tuple(sorted(r.items()))
-                         for r in probe.init_container_requests),
+                   tuple(_init_sig(r) for r in probe.init_container_requests),
                    not probe.spec.host_ports,
                    () if not probe.spec.volumes
                    else tuple(probe.spec.volumes))
@@ -310,7 +318,7 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
             lt,
             rt,
             () if not pod.init_container_requests
-            else tuple(tok(r, items_key) for r in pod.init_container_requests),
+            else tuple(tok(r, init_key) for r in pod.init_container_requests),
             not spec.host_ports,
             # volume content keys the bucket: ephemeral groups with distinct
             # storage classes must not merge (different CSI drivers/caps)

@@ -255,7 +255,11 @@ class Provisioner(SingletonController):
         from .volumetopology import inject_volume_topology_requirements
         pods = [inject_volume_topology_requirements(self.store, p)
                 if p.spec.volumes else p for p in pods]
-        nodepools = order_by_weight(self.store.list(NodePool))
+        # a deleting NodePool must not receive new capacity
+        # (provisioning/suite_test.go:216-226)
+        nodepools = order_by_weight(
+            [np for np in self.store.list(NodePool)
+             if np.metadata.deletion_timestamp is None])
         instance_types = {np.name: self.cloud_provider.get_instance_types(np)
                           for np in nodepools}
         nodepools = [np for np in nodepools if instance_types.get(np.name)]

@@ -821,8 +821,12 @@ class TensorScheduler:
                 base_reqs.add(Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN,
                                           [zone_name]))
             # all pods of a group are identical: node requests = per-pod
-            # requests scaled by fill (no per-pod re-merge)
-            requests: dict = {}
+            # requests scaled by fill (no per-pod re-merge), plus the
+            # template's daemonset overhead — the claim's recorded resources
+            # must match what the node will actually host
+            # (scheduler.go:356-382; the packer already budgeted for it)
+            requests: dict = dict(
+                _daemon_overhead(templates[cohort.m], self.daemonset_pods))
             for g, fill in cohort.pods_by_group.items():
                 for rname, v in groups[g].requests.items():
                     requests[rname] = requests.get(rname, 0) + v * fill

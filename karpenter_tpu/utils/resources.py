@@ -80,11 +80,35 @@ def exceeds(usage: Mapping[str, int], limits: Mapping[str, int]) -> "list[str]":
     return [k for k, lim in limits.items() if usage.get(k, 0) > lim]
 
 
+def init_entry(entry) -> "tuple[ResourceList, bool]":
+    """Normalize a pod.init_container_requests entry to
+    (requests, restart_always)."""
+    if isinstance(entry, tuple):
+        return entry
+    return entry, False
+
+
 def pod_requests(pod) -> ResourceList:
-    """Total requests for a pod: sum of container requests, element-wise max with
-    init containers, plus one 'pods' slot. Reference resources.RequestsForPods."""
-    total = add(*(c for c in pod.container_requests)) if pod.container_requests else {}
-    init = max_resources(pod.init_container_requests) if pod.init_container_requests else {}
-    out = max_resources([total, init])
+    """Total requests for a pod (reference resources.podRequests:95-125):
+    sum of containers plus native sidecars (init containers with
+    restartPolicy=Always), element-wise maxed against each regular init
+    container combined with the sidecars declared BEFORE it (sidecars are
+    already running while later init containers execute — order matters),
+    plus one 'pods' slot.
+
+    Entries in pod.init_container_requests are either a plain ResourceList
+    (regular init container) or a (ResourceList, restart_always) tuple."""
+    requests = add(*(c for c in pod.container_requests)) if pod.container_requests else {}
+    restartable: ResourceList = {}
+    max_init: ResourceList = {}
+    for entry in pod.init_container_requests:
+        req, always = init_entry(entry)
+        if always:
+            requests = add(requests, req)
+            restartable = add(restartable, req)
+            max_init = max_resources([max_init, restartable])
+        else:
+            max_init = max_resources([max_init, add(req, restartable)])
+    out = max_resources([requests, max_init])
     out[PODS] = out.get(PODS, 0) + 1000  # one pod slot, in milliunits
     return out

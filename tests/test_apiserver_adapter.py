@@ -207,3 +207,18 @@ class TestLiveApiserver:
             except urllib.error.HTTPError as e:
                 if e.code != 409:
                     raise
+
+
+def test_node_conditions_round_trip():
+    """Kubelet conditions must survive the codec: NotReady budget accounting
+    and repair policies read them."""
+    from karpenter_tpu.api.objects import Node, NodeStatus, ObjectMeta
+    from karpenter_tpu.kube.k8s_codec import node_from_k8s, node_to_k8s
+    n = Node(metadata=ObjectMeta(name="n1", namespace=""),
+             status=NodeStatus(conditions=[
+                 {"type": "Ready", "status": "False",
+                  "last_transition_time": 12345.0}]))
+    out = node_from_k8s(node_to_k8s(n))
+    [cond] = out.status.conditions
+    assert cond["type"] == "Ready" and cond["status"] == "False"
+    assert cond["last_transition_time"] == 12345.0

@@ -1,0 +1,321 @@
+"""Scenario port of /root/reference/pkg/controllers/provisioning/
+suite_test.go (2,253 LoC): batcher windows, deleting-NodePool gating,
+init/sidecar-container resource math, nodeclaim request shapes (owner refs,
+hash stability), daemonset schedulability edges, and partial scheduling
+under limits."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (Node, NodeSelectorRequirement, Pod,
+                                       Taint, Toleration)
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import (BATCH_IDLE_SECONDS,
+                                                    BATCH_MAX_SECONDS, Batcher,
+                                                    Binder, PodTrigger,
+                                                    Provisioner)
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod
+
+OD = {api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND}
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner),
+                 NodeClaimLifecycle(store, cluster, provider, clock))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.provisioner = provisioner
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+class TestBatcher:
+    """suite_test.go:115-206."""
+
+    def test_fires_after_idle_window(self):
+        clock = FakeClock()
+        b = Batcher(clock)
+        b.trigger()
+        assert not b.ready()
+        clock.step(BATCH_IDLE_SECONDS + 0.01)
+        assert b.ready()
+
+    def test_new_pod_extends_idle_window(self):
+        clock = FakeClock()
+        b = Batcher(clock)
+        b.trigger()
+        clock.step(BATCH_IDLE_SECONDS * 0.8)
+        b.trigger()  # new arrival: idle window restarts
+        clock.step(BATCH_IDLE_SECONDS * 0.8)
+        assert not b.ready()
+        clock.step(BATCH_IDLE_SECONDS * 0.3)
+        assert b.ready()
+
+    def test_max_window_caps_extension(self):
+        clock = FakeClock()
+        b = Batcher(clock)
+        b.trigger()
+        # keep poking just inside the idle window forever
+        elapsed = 0.0
+        while elapsed < BATCH_MAX_SECONDS:
+            clock.step(BATCH_IDLE_SECONDS * 0.9)
+            elapsed += BATCH_IDLE_SECONDS * 0.9
+            b.trigger()
+        assert b.ready()  # max duration wins
+
+    def test_reset_clears_window(self):
+        clock = FakeClock()
+        b = Batcher(clock)
+        b.trigger()
+        clock.step(BATCH_IDLE_SECONDS + 1)
+        b.reset()
+        assert not b.ready()
+
+
+class TestDeletingNodePool:
+    """suite_test.go:216-226."""
+
+    def test_deleting_nodepool_receives_no_capacity(self, env):
+        pool = make_nodepool(name="default")
+        pool.metadata.finalizers.append("karpenter.sh/termination")
+        env.store.create(pool)
+        env.store.delete(pool)  # finalizer holds it: deleting, still listed
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        assert env.store.list(NodeClaim) == []
+        assert env.store.list(Node) == []
+
+    def test_live_pool_still_used_when_other_deletes(self, env):
+        doomed = make_nodepool(name="doomed")
+        doomed.metadata.finalizers.append("karpenter.sh/termination")
+        env.store.create(doomed)
+        env.store.delete(doomed)
+        env.store.create(make_nodepool(name="live"))
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        claims = env.store.list(NodeClaim)
+        assert len(claims) == 1
+        assert claims[0].nodepool_name == "live"
+
+
+class TestSidecarContainerMath:
+    """suite_test.go:424-578: native sidecars (init containers with
+    restartPolicy=Always) run for the pod's whole life, so they ADD to the
+    main containers but also accompany every later init container."""
+
+    def _pod(self, containers, inits):
+        p = make_pod()
+        p.container_requests = [res.parse_list({"cpu": c}) for c in containers]
+        p.init_container_requests = [
+            (res.parse_list({"cpu": c}), True) if sidecar
+            else res.parse_list({"cpu": c})
+            for c, sidecar in inits]
+        return p
+
+    def test_init_before_sidecar(self):
+        """init runs alone (1500m), THEN the sidecar starts: steady state
+        1000m + 500m = 1500m, peak = 1500m."""
+        p = self._pod(["1"], [("1500m", False), ("500m", True)])
+        assert p.requests()["cpu"] == 1500
+
+    def test_sidecar_before_init_smaller_init(self):
+        """sidecar (500m) is already running when the init (700m) runs:
+        peak = 1200m, steady state = 1500m -> 1500m wins."""
+        p = self._pod(["1"], [("500m", True), ("700m", False)])
+        assert p.requests()["cpu"] == 1500
+
+    def test_sidecar_before_init_bigger_init(self):
+        """init (1500m) runs alongside the earlier sidecar (500m):
+        peak = 2000m beats steady state 1500m."""
+        p = self._pod(["1"], [("500m", True), ("1500m", False)])
+        assert p.requests()["cpu"] == 2000
+
+    def test_plain_init_max_semantics(self):
+        p = self._pod(["250m", "250m"], [("1", False), ("2", False)])
+        assert p.requests()["cpu"] == 2000
+
+    def test_scheduling_accounts_for_sidecar_peak(self, env):
+        """A pod whose init+sidecar peak exceeds the sum of its containers
+        must get a node sized for the peak."""
+        env.store.create(make_nodepool(name="default"))
+        p = self._pod(["1"], [("500m", True), ("2500m", False)])
+        p.spec.node_selector = dict(OD)
+        env.store.create(p)
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        # peak = 3000m + pod overhead; a 2-cpu shape can't hold it
+        assert nc.spec.resources_requests["cpu"] >= 3000
+
+
+class TestNodeClaimRequestShape:
+    """suite_test.go:353-383, 1335-1612."""
+
+    def test_owner_reference_points_at_nodepool(self, env):
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        [ref] = [r for r in nc.metadata.owner_refs if r.kind == "NodePool"]
+        assert ref.name == "default"
+        assert ref.block_owner_deletion
+
+    def test_hash_annotation_stamped_from_scheduling_time_pool(self, env):
+        """suite_test.go:353-383: the claim's nodepool-hash annotation must
+        match the pool revision that scheduled it."""
+        pool = make_nodepool(name="default")
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        assert nc.metadata.annotations[
+            api_labels.NODEPOOL_HASH_ANNOTATION_KEY] == pool.static_hash()
+
+    def test_pool_requirements_propagate_to_claim(self, env):
+        pool = make_nodepool(name="default", requirements=[
+            NodeSelectorRequirement(api_labels.LABEL_ARCH, "In", ("amd64",))])
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        by_key = {r.key: r for r in nc.spec.requirements}
+        assert tuple(by_key[api_labels.LABEL_ARCH].values) == ("amd64",)
+        assert api_labels.LABEL_INSTANCE_TYPE in by_key
+
+    def test_resource_requests_include_daemon_overhead(self, env):
+        env.store.create(make_nodepool(name="default"))
+        ds = make_pod(cpu="250m")
+        ds.is_daemonset_pod = True
+        env.store.create(ds)
+        env.store.create(make_pod(cpu="500m", name="workload"))
+        settle(env)
+        claims = env.store.list(NodeClaim)
+        assert claims
+        # requests cover workload + daemonset overhead + pod slots
+        assert claims[0].spec.resources_requests["cpu"] >= 750
+
+
+class TestDaemonSetSchedulability:
+    """suite_test.go:912-1187: which daemonsets count toward overhead."""
+
+    def _provision(self, env, ds, pool=None, workload_tolerations=()):
+        env.store.create(pool or make_nodepool(name="default"))
+        ds.is_daemonset_pod = True
+        env.store.create(ds)
+        env.store.create(make_pod(cpu="500m", name="workload",
+                                  tolerations=list(workload_tolerations)))
+        settle(env)
+        claims = env.store.list(NodeClaim)
+        assert claims
+        return claims[0]
+
+    def test_daemonset_without_matching_toleration_ignored(self, env):
+        """suite_test.go:912-943: pool taints the nodes; a daemonset that
+        doesn't tolerate them can't run there, so no overhead."""
+        pool = make_nodepool(name="default",
+                             taints=[Taint(key="team", value="a",
+                                           effect="NoSchedule")])
+        ds = make_pod(cpu="2")
+        nc = self._provision(env, ds, pool=pool, workload_tolerations=[
+            Toleration(key="team", operator="Equal", value="a",
+                       effect="NoSchedule")])
+        assert nc.spec.resources_requests["cpu"] < 2000
+
+    def test_tolerating_daemonset_counted(self, env):
+        pool = make_nodepool(name="default",
+                             taints=[Taint(key="team", value="a",
+                                           effect="NoSchedule")])
+        ds = make_pod(cpu="2", tolerations=[
+            Toleration(key="team", operator="Equal", value="a",
+                       effect="NoSchedule")])
+        nc = self._provision(env, ds, pool=pool, workload_tolerations=[
+            Toleration(key="team", operator="Equal", value="a",
+                       effect="NoSchedule")])
+        assert nc.spec.resources_requests["cpu"] >= 2500
+
+    def test_daemonset_with_incompatible_node_selector_ignored(self, env):
+        ds = make_pod(cpu="2", node_selector={"example.com/fleet": "other"})
+        nc = self._provision(env, ds)
+        assert nc.spec.resources_requests["cpu"] < 2000
+
+    def test_daemonset_with_incompatible_preference_still_counted(self, env):
+        """suite_test.go:1121-1148: preferences relax, so the daemonset still
+        lands and must be counted."""
+        ds = make_pod(cpu="2", preferred_affinity=[
+            (1, [NodeSelectorRequirement("example.com/fleet", "In",
+                                         ("other",))])])
+        nc = self._provision(env, ds)
+        assert nc.spec.resources_requests["cpu"] >= 2500
+
+    def test_daemonset_notin_on_unspecified_key_counted(self, env):
+        """suite_test.go:966-988: NotIn on a key the node doesn't define is
+        satisfied."""
+        ds = make_pod(cpu="2", required_affinity=[
+            [NodeSelectorRequirement("example.com/fleet", "NotIn",
+                                     ("other",))]])
+        nc = self._provision(env, ds)
+        assert nc.spec.resources_requests["cpu"] >= 2500
+
+
+class TestLimitsPartialScheduling:
+    """suite_test.go:579-721."""
+
+    def test_partial_schedule_when_limits_hit(self, env):
+        pool = make_nodepool(name="default", limits={"cpu": "3"})
+        env.store.create(pool)
+        for i in range(4):
+            env.store.create(make_pod(cpu="1500m", name=f"p-{i}",
+                                      node_selector=dict(OD)))
+        settle(env, rounds=8)
+        scheduled = [p for p in env.store.list(Pod) if p.spec.node_name]
+        unscheduled = [p for p in env.store.list(Pod) if not p.spec.node_name]
+        assert scheduled, "some pods must schedule inside the limit"
+        assert unscheduled, "the limit must strand the rest"
+
+    def test_no_schedule_when_limits_already_exceeded(self, env):
+        pool = make_nodepool(name="default", limits={"cpu": "1"})
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="1500m", node_selector=dict(OD)))
+        settle(env)
+        assert env.store.list(NodeClaim) == []
+
+    def test_scheduling_resumes_when_limit_lifted(self, env):
+        pool = make_nodepool(name="default", limits={"cpu": "1"})
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="1500m", node_selector=dict(OD)))
+        settle(env)
+        assert env.store.list(NodeClaim) == []
+        pool.spec.limits = {}
+        env.store.update(pool)
+        env.provisioner.trigger()
+        settle(env)
+        assert len(env.store.list(NodeClaim)) == 1
