@@ -107,3 +107,169 @@ class FileLease:
         if rec is None or rec.get("renew_deadline", 0) <= self.clock.now():
             return None
         return rec.get("holder")
+
+
+class KubeLease:
+    """Leader election over a coordination.k8s.io/v1 Lease — the reference's
+    actual mechanism (operator.go:137-141: controller-runtime LeaderElection
+    with leases in kube-system). The apiserver's resourceVersion CAS is the
+    serialization point, so this works across hosts (the FileLease's fcntl
+    lock ends at the machine boundary).
+
+    Takes any object with the KubeApiStore's `_request(method, url)` +
+    `base_url` surface; tests inject an in-memory CAS double.
+    """
+
+    GROUP = "apis/coordination.k8s.io/v1"
+
+    def __init__(self, api_store, identity: str,
+                 name: str = "karpenter-tpu-leader-election",
+                 namespace: str = "kube-system",
+                 lease_duration: float = 15.0,
+                 clock: Optional[Clock] = None):
+        self.api = api_store
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.clock = clock or Clock()
+
+    # -- REST plumbing -------------------------------------------------------
+
+    def _url(self, name: str = "") -> str:
+        parts = [self.api.base_url, self.GROUP, "namespaces", self.namespace,
+                 "leases"]
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+    def _get(self) -> Optional[dict]:
+        import urllib.error
+        try:
+            return self.api._request("GET", self._url(self.name))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    @staticmethod
+    def _micro(ts: float) -> str:
+        from datetime import datetime, timezone
+        return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ")
+
+    @staticmethod
+    def _from_micro(s: Optional[str]) -> float:
+        if not s:
+            return 0.0
+        from datetime import datetime, timezone
+        for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+            try:
+                return datetime.strptime(s, fmt).replace(
+                    tzinfo=timezone.utc).timestamp()
+            except ValueError:
+                continue
+        return 0.0
+
+    def _expired(self, spec: dict, now: float) -> bool:
+        renew = self._from_micro(spec.get("renewTime"))
+        duration = spec.get("leaseDurationSeconds") or self.lease_duration
+        return renew + duration <= now
+
+    # -- API (FileLease-compatible) ------------------------------------------
+
+    def try_acquire(self) -> bool:
+        import urllib.error
+        now = self.clock.now()
+        live = self._get()
+        if live is None:
+            body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {"name": self.name,
+                                 "namespace": self.namespace},
+                    "spec": {"holderIdentity": self.identity,
+                             "leaseDurationSeconds": int(self.lease_duration),
+                             "acquireTime": self._micro(now),
+                             "renewTime": self._micro(now),
+                             "leaseTransitions": 0}}
+            try:
+                self.api._request("POST", self._url(), body)
+                return True
+            except urllib.error.HTTPError as e:
+                if e.code == 409:  # raced another candidate
+                    return False
+                raise
+        spec = live.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        if holder == self.identity:
+            return self._renew(live)
+        if not self._expired(spec, now):
+            return False
+        # expired: steal, CAS-guarded by resourceVersion
+        spec.update({"holderIdentity": self.identity,
+                     "acquireTime": self._micro(now),
+                     "renewTime": self._micro(now),
+                     "leaseDurationSeconds": int(self.lease_duration),
+                     "leaseTransitions": (spec.get("leaseTransitions") or 0)
+                     + 1})
+        live["spec"] = spec
+        try:
+            self.api._request("PUT", self._url(self.name), live)
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    def renew(self) -> bool:
+        live = self._get()
+        if live is None:
+            return False
+        return self._renew(live)
+
+    def _renew(self, live: dict) -> bool:
+        """Extend an already-fetched lease; CAS via resourceVersion."""
+        import urllib.error
+        spec = live.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return False
+        spec["renewTime"] = self._micro(self.clock.now())
+        live["spec"] = spec
+        try:
+            self.api._request("PUT", self._url(self.name), live)
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    def release(self) -> None:
+        """Graceful handoff, CAS-guarded: an unconditional DELETE could
+        remove a lease another replica legitimately stole between our GET
+        and the delete (client-go instead CAS-writes a 1s duration). A 409
+        means the lease changed hands — leave it alone."""
+        import urllib.error
+        live = self._get()
+        if live is None:
+            return
+        spec = live.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return
+        spec.update({"holderIdentity": "",
+                     "leaseDurationSeconds": 1,
+                     "renewTime": self._micro(self.clock.now()
+                                              - self.lease_duration)})
+        live["spec"] = spec
+        try:
+            self.api._request("PUT", self._url(self.name), live)
+        except urllib.error.HTTPError as e:
+            if e.code not in (404, 409):
+                raise
+
+    def holder(self) -> Optional[str]:
+        live = self._get()
+        if live is None:
+            return None
+        spec = live.get("spec") or {}
+        if self._expired(spec, self.clock.now()):
+            return None
+        return spec.get("holderIdentity")

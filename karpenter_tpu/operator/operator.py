@@ -190,6 +190,16 @@ class Operator:
         # as "already mine", so a collision would be split-brain
         identity = (f"{socket.gethostname()}-{os.getpid()}-"
                     f"{uuid.uuid4().hex[:8]}")
+        if self.options.store_backend == "kube":
+            # multi-replica HA: the coordination API's resourceVersion CAS
+            # is the serialization point (operator.go:137-141) — the fcntl
+            # FileLease only serializes within one host
+            from ..kube.apiserver import KubeApiStore
+            from .leaderelection import KubeLease
+            if isinstance(self.store, KubeApiStore):
+                return KubeLease(self.store, identity,
+                                 lease_duration=self.options.lease_duration,
+                                 clock=self.clock)
         return FileLease(path, identity,
                          lease_duration=self.options.lease_duration,
                          clock=self.clock)

@@ -196,7 +196,7 @@ def pod_to_dict(p: Pod) -> dict:
         "priority": p.spec.priority,
         "node_name": p.spec.node_name,
         "requests": [dict(r) for r in p.container_requests],
-        "init_requests": [[dict(e[0]), True] if isinstance(e, tuple)
+        "init_requests": [[dict(e[0]), e[1]] if isinstance(e, tuple)
                           else dict(e) for e in p.init_container_requests],
         "daemonset": p.is_daemonset_pod,
     }
@@ -286,7 +286,7 @@ def pod_from_dict(d: dict) -> Pod:
             node_name=d.get("node_name", "")),
         container_requests=[dict(r) for r in d["requests"]],
         init_container_requests=[
-            (dict(e[0]), True) if isinstance(e, list) and len(e) == 2
+            (dict(e[0]), e[1]) if isinstance(e, list) and len(e) == 2
             and isinstance(e[1], bool) else dict(e)
             for e in d["init_requests"]],
         is_daemonset_pod=d["daemonset"])
@@ -306,7 +306,7 @@ def _pod_template_key(p: Pod):
             tuple(spec.node_selector.items()),
             tuple(p.metadata.labels.items()),
             tuple(tuple(r.items()) for r in p.container_requests),
-            tuple((tuple(e[0].items()), True) if isinstance(e, tuple)
+            tuple((tuple(e[0].items()), e[1]) if isinstance(e, tuple)
                   else tuple(e.items()) for e in p.init_container_requests),
             tuple((hp.port, hp.protocol, hp.host_ip)
                   for hp in spec.host_ports),
@@ -362,7 +362,7 @@ def encode_pod_rows(pods):
              if len(reqs) == 1 else
              tuple(tok(r, lambda r=r: tuple(r.items())) for r in reqs)),
             () if not p.init_container_requests
-            else tuple(tok(r, lambda r=r: (tuple(r[0].items()), True)
+            else tuple(tok(r, lambda r=r: (tuple(r[0].items()), r[1])
                            if isinstance(r, tuple) else tuple(r.items()))
                        for r in p.init_container_requests),
             () if not spec.host_ports else tuple(map(id, spec.host_ports)),

@@ -142,8 +142,14 @@ class SolverServicer(grpc.GenericRpcHandler):
     def service(self, handler_call_details):
         fn = _METHODS.get(handler_call_details.method)
         if fn is not None:
+            def handler(request, context, fn=fn):
+                _request_started()
+                try:
+                    return fn(request, context)
+                finally:
+                    _request_finished()
             return grpc.unary_unary_rpc_method_handler(
-                fn,
+                handler,
                 request_deserializer=None,   # raw bytes
                 response_serializer=None)
         return None
@@ -159,13 +165,68 @@ GRPC_OPTIONS = [
 ]
 
 
+_last_request_at = 0.0
+_active_requests = 0
+_request_lock = threading.Lock()
+
+
+def _request_started() -> None:
+    global _last_request_at, _active_requests
+    import time
+    with _request_lock:
+        _active_requests += 1
+        _last_request_at = time.monotonic()
+
+
+def _request_finished() -> None:
+    global _last_request_at, _active_requests
+    import time
+    with _request_lock:
+        _active_requests -= 1
+        _last_request_at = time.monotonic()
+
+
+def _idle_gc_loop(stop: threading.Event) -> None:
+    """Cyclic GC is disabled in the solver process: a 50k-pod solve allocates
+    ~10^5 short-lived objects and the collector's unpredictable pauses cost
+    up to 400 ms MID-SOLVE (measured: 990 ms vs 545 ms steady-state).
+    Refcounting reclaims the per-solve garbage; cycles are swept here, only
+    while NO request is in flight and the server has been idle, so the
+    pause never lands inside a request."""
+    import gc
+    import time
+    while not stop.wait(1.0):
+        with _request_lock:
+            idle = (_active_requests == 0 and _last_request_at
+                    and time.monotonic() - _last_request_at > 0.5)
+        if idle:
+            gc.collect()
+
+
 def serve(port: int = 0, max_workers: int = 4):
     """Start the sidecar; returns (server, bound_port)."""
+    import gc
+    gc.collect()
+    gc.freeze()     # baseline objects never participate in collection
+    gc.disable()    # idle-time sweeps only (see _idle_gc_loop)
+    stop = threading.Event()
+    t = threading.Thread(target=_idle_gc_loop, args=(stop,), daemon=True,
+                         name="sidecar-idle-gc")
+    t.start()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((SolverServicer(),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
+    _orig_stop = server.stop
+
+    def stop_server(grace):
+        stop.set()
+        import gc
+        gc.enable()
+        return _orig_stop(grace)
+
+    server.stop = stop_server
     return server, bound
 
 

@@ -132,3 +132,125 @@ class TestOperatorLeadership:
         t2.join(timeout=5)
         assert leader.store.list(Node), "leader must provision"
         assert not standby.store.list(Node), "standby must not reconcile"
+
+
+class FakeLeaseApi:
+    """In-memory coordination-API double with resourceVersion CAS — the
+    serialization semantics KubeLease depends on."""
+
+    base_url = "https://fake"
+
+    def __init__(self):
+        self.lease = None
+        self._rv = 0
+
+    def _request(self, method, url, body=None):
+        import urllib.error
+
+        def err(code):
+            return urllib.error.HTTPError(url, code, "", {}, None)
+
+        if method == "GET":
+            if self.lease is None:
+                raise err(404)
+            import copy
+            return copy.deepcopy(self.lease)
+        if method == "POST":
+            if self.lease is not None:
+                raise err(409)
+            self._rv += 1
+            body.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            self.lease = body
+            return body
+        if method == "PUT":
+            if self.lease is None:
+                raise err(404)
+            if body.get("metadata", {}).get("resourceVersion") != \
+                    self.lease["metadata"]["resourceVersion"]:
+                raise err(409)
+            self._rv += 1
+            body["metadata"]["resourceVersion"] = str(self._rv)
+            self.lease = body
+            return body
+        if method == "DELETE":
+            self.lease = None
+            return None
+        raise AssertionError(method)
+
+
+class TestKubeLease:
+    def _pair(self):
+        from karpenter_tpu.operator.leaderelection import KubeLease
+        from karpenter_tpu.utils.clock import FakeClock
+        api = FakeLeaseApi()
+        clock = FakeClock()
+        a = KubeLease(api, "replica-a", lease_duration=15.0, clock=clock)
+        b = KubeLease(api, "replica-b", lease_duration=15.0, clock=clock)
+        return api, clock, a, b
+
+    def test_first_candidate_acquires(self):
+        _, _, a, b = self._pair()
+        assert a.try_acquire()
+        assert a.holder() == "replica-a"
+        assert not b.try_acquire()  # lease held and fresh
+
+    def test_renewal_extends(self):
+        _, clock, a, b = self._pair()
+        assert a.try_acquire()
+        clock.step(10)
+        assert a.renew()
+        clock.step(10)  # 20s since acquire but only 10 since renew
+        assert not b.try_acquire()
+
+    def test_expired_lease_stolen_with_transition_count(self):
+        api, clock, a, b = self._pair()
+        assert a.try_acquire()
+        clock.step(16)  # past lease_duration with no renew
+        assert b.try_acquire()
+        assert b.holder() == "replica-b"
+        assert api.lease["spec"]["leaseTransitions"] == 1
+        # the deposed leader's renew must fail
+        assert not a.renew()
+
+    def test_concurrent_steal_loses_cas(self):
+        api, clock, a, b = self._pair()
+        assert a.try_acquire()
+        clock.step(16)
+        # b reads the expired lease, then a renews-revives it first
+        live = api._request("GET", "u")
+        assert a.try_acquire()  # holder==a: renew path revives it
+        # now b's PUT carries a stale resourceVersion
+        live["spec"]["holderIdentity"] = "replica-b"
+        import urllib.error
+        try:
+            api._request("PUT", "u", live)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 409
+        assert raised
+
+    def test_release_lets_next_acquire_immediately(self):
+        _, _, a, b = self._pair()
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()
+        assert b.holder() == "replica-b"
+
+    def test_release_by_non_holder_is_noop(self):
+        _, _, a, b = self._pair()
+        assert a.try_acquire()
+        b.release()
+        assert a.holder() == "replica-a"
+
+    def test_operator_picks_kube_lease_for_kube_backend(self):
+        from karpenter_tpu.kube.apiserver import KubeApiStore
+        from karpenter_tpu.operator.leaderelection import KubeLease
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        store = KubeApiStore("https://fake:6443")
+        op = Operator.__new__(Operator)
+        op.options = Options(leader_elect=True, store_backend="kube")
+        op.store = store
+        from karpenter_tpu.utils.clock import FakeClock
+        op.clock = FakeClock()
+        assert isinstance(op._lease(), KubeLease)
