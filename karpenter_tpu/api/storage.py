@@ -21,6 +21,11 @@ class PersistentVolumeSpec:
     # PV node affinity restricting where the volume attaches (zonal PVs)
     node_affinity_terms: List[NodeSelectorTerm] = field(default_factory=list)
     storage_class_name: str = ""
+    # volume source kind: local/hostPath volumes die with their node, so
+    # their hostname affinity is ignored when (re)scheduling
+    # (volumetopology.go:139-144)
+    local: bool = False
+    host_path: bool = False
 
 
 @dataclass

@@ -124,12 +124,27 @@ def simulate_scheduling(cluster: Cluster, provisioner: Provisioner,
                    if not sn.deleting() and sn.provider_id not in candidate_ids]
     pods = provisioner.get_pending_pods()
     # pods already being rescheduled from deleting nodes ride along
+    deleting_pod_uids = set()
     for sn in cluster.deleting_nodes():
         for p in pods_on_node(cluster, sn):
             if pod_utils.is_reschedulable(p):
                 pods.append(p)
+                deleting_pod_uids.add(p.uid)
     reschedulable = [p for c in candidates for p in c.reschedulable_pods]
     results = provisioner.schedule_with(pods + reschedulable, state_nodes)
+    # a scheduling decision must not rest on managed nodes still mid-
+    # initialization: pods placed there become errors so the command is
+    # rejected — EXCEPT pods from deleting nodes, whose replacement node is
+    # assumed to come up (helpers.go:93-111)
+    for en in results.existing_nodes:
+        sn = en.state_node if hasattr(en, "state_node") else None
+        if sn is None or not sn.managed() or sn.initialized():
+            continue
+        for p in en.pods:
+            if p.uid not in deleting_pod_uids:
+                results.pod_errors[p.uid] = (
+                    f"would schedule against uninitialized node "
+                    f"{sn.name()}")
     # pods that only became pending for the simulation must all land
     # (AllNonPendingPodsScheduled)
     sim_uids = {p.uid for p in reschedulable}

@@ -725,10 +725,24 @@ def pv_to_k8s(pv) -> dict:
     if pv.spec.csi is not None:
         spec["csi"] = {"driver": pv.spec.csi.driver,
                        "volumeHandle": pv.metadata.name}
+    elif pv.spec.local:
+        spec["local"] = {"path": f"/mnt/{pv.metadata.name}"}
+        if not pv.spec.node_affinity_terms:
+            # the apiserver REQUIRES nodeAffinity on local PVs; a hostname
+            # pin is the canonical shape (and the scheduler drops hostname
+            # affinity for local PVs anyway, so decode behavior is unchanged)
+            spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "kubernetes.io/hostname", "operator": "In",
+                     "values": [f"{pv.metadata.name}-host"]}]}]}}
+    elif pv.spec.host_path:
+        spec["hostPath"] = {"path": f"/tmp/{pv.metadata.name}"}
     else:
         # a PV must carry SOME volume source or the apiserver 422s; non-CSI
-        # PVs (zonal-affinity-only fixtures) ride as hostPath placeholders
-        spec["hostPath"] = {"path": f"/tmp/{pv.metadata.name}"}
+        # non-local fixtures ride as NFS placeholders (hostPath would imply
+        # ignore-hostname-affinity semantics on decode)
+        spec["nfs"] = {"server": "placeholder.invalid",
+                       "path": f"/{pv.metadata.name}"}
     if pv.spec.node_affinity_terms:
         spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
             _nsterm_to_k8s(t) for t in pv.spec.node_affinity_terms]}}
@@ -748,7 +762,9 @@ def pv_from_k8s(d: dict):
         spec=PersistentVolumeSpec(
             csi=CSIVolumeSource(driver=csi.get("driver", "")) if csi else None,
             node_affinity_terms=[_nsterm_from_k8s(t) for t in terms],
-            storage_class_name=spec.get("storageClassName", "")))
+            storage_class_name=spec.get("storageClassName", ""),
+            local="local" in spec,
+            host_path="hostPath" in spec))
 
 
 def storageclass_to_k8s(sc) -> dict:

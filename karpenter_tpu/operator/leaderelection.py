@@ -133,6 +133,12 @@ class KubeLease:
         self.namespace = namespace
         self.lease_duration = lease_duration
         self.clock = clock or Clock()
+        # locally observed record state: (holder, renewTime, rv) -> when WE
+        # first saw it. Expiry is judged against this local observation, not
+        # the remote renewTime, so another replica's clock skew can't make a
+        # healthy leader's lease look expired (client-go does the same)
+        self._observed_record = None
+        self._observed_at = 0.0
 
     # -- REST plumbing -------------------------------------------------------
 
@@ -171,10 +177,20 @@ class KubeLease:
                 continue
         return 0.0
 
-    def _expired(self, spec: dict, now: float) -> bool:
-        renew = self._from_micro(spec.get("renewTime"))
+    def _expired(self, live: dict, now: float) -> bool:
+        spec = live.get("spec") or {}
+        if not spec.get("holderIdentity"):
+            return True  # released: free immediately
+        record = (spec.get("holderIdentity"), spec.get("renewTime"),
+                  (live.get("metadata") or {}).get("resourceVersion"))
+        if record != self._observed_record:
+            # the record changed since we last looked: the holder is alive
+            # by OUR clock as of now — restart the local expiry window
+            self._observed_record = record
+            self._observed_at = now
+            return False
         duration = spec.get("leaseDurationSeconds") or self.lease_duration
-        return renew + duration <= now
+        return now - self._observed_at >= duration
 
     # -- API (FileLease-compatible) ------------------------------------------
 
@@ -202,7 +218,7 @@ class KubeLease:
         holder = spec.get("holderIdentity")
         if holder == self.identity:
             return self._renew(live)
-        if not self._expired(spec, now):
+        if not self._expired(live, now):
             return False
         # expired: steal, CAS-guarded by resourceVersion
         spec.update({"holderIdentity": self.identity,
@@ -269,7 +285,6 @@ class KubeLease:
         live = self._get()
         if live is None:
             return None
-        spec = live.get("spec") or {}
-        if self._expired(spec, self.clock.now()):
+        if self._expired(live, self.clock.now()):
             return None
-        return spec.get("holderIdentity")
+        return (live.get("spec") or {}).get("holderIdentity")

@@ -271,10 +271,12 @@ class Operator:
         try:
             while stop is None or not stop():
                 if lease is not None:
+                    lease_ref = getattr(lease, "path", None) or \
+                        getattr(lease, "name", "")
                     if leading and (self._lease_lost.is_set()
                                     or self._renew_deadline_passed(lease)):
                         self.log.error("lost leadership lease; standing by",
-                                       lease=lease.path)
+                                       lease=lease_ref)
                         self._stop_renewal()
                         leading = False
                     # after a stand-down, do not re-acquire while the old
@@ -284,14 +286,22 @@ class Operator:
                     # mechanism. If the thread never exits, the lease expires
                     # naturally and a healthy standby takes over.
                     prev = getattr(self, "_renew_thread", None)
-                    if not leading and \
-                            (prev is None or not prev.is_alive()) and \
-                            lease.try_acquire():
-                        self.log.info("acquired leadership",
-                                      lease=lease.path,
-                                      identity=lease.identity)
-                        leading = True
-                        self._start_renewal(lease)
+                    if not leading and (prev is None or not prev.is_alive()):
+                        try:
+                            acquired = lease.try_acquire()
+                        except Exception as exc:
+                            # a transient apiserver/network error must not
+                            # kill a standby — keep polling (client-go
+                            # retries acquire indefinitely)
+                            self.log.error("lease acquire attempt failed",
+                                           lease=lease_ref, error=str(exc))
+                            acquired = False
+                        if acquired:
+                            self.log.info("acquired leadership",
+                                          lease=lease_ref,
+                                          identity=lease.identity)
+                            leading = True
+                            self._start_renewal(lease)
                 # apiserver backend: watch streams queue events on their own
                 # threads; deliver them HERE so the deterministic single-
                 # dispatch model holds (kube/apiserver.py). Standbys pump

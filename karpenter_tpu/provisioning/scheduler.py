@@ -474,6 +474,11 @@ class Scheduler:
     def solve(self, pods: List[Pod]) -> Results:
         """scheduler.go:207-265 — loop while the queue makes progress; on
         failure relax one preference rung and re-enqueue."""
+        from ..utils.gcpause import no_gc
+        with no_gc():
+            return self._solve(pods)
+
+    def _solve(self, pods: List[Pod]) -> Results:
         errors: Dict[str, str] = {}
         for p in pods:
             self.cached_pod_requests[p.uid] = p.requests()

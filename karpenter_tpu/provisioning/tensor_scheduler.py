@@ -208,6 +208,11 @@ class TensorScheduler:
     # -- public -------------------------------------------------------------
 
     def solve(self, pods: List[Pod], prebuckets=None) -> Results:
+        from ..utils.gcpause import no_gc
+        with no_gc():
+            return self._solve(pods, prebuckets)
+
+    def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
         groups, leftover, reason = partition_pods(pods, prebuckets=prebuckets)
         self.partition = (sum(g.count for g in groups), len(leftover))
         if not groups:

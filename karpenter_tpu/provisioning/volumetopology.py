@@ -28,9 +28,18 @@ def _volume_requirements(store, pod: Pod) -> List[NodeSelectorRequirement]:
             continue
         if pvc is not None and pvc.spec.volume_name:
             pv = store.get(PersistentVolume, pvc.spec.volume_name)
-            if pv is not None:
-                for term in pv.spec.node_affinity_terms:
-                    reqs.extend(term.match_expressions)
+            if pv is not None and pv.spec.node_affinity_terms:
+                # terms are ORed — only the first is used
+                # (volumetopology.go:136-138)
+                exprs = list(pv.spec.node_affinity_terms[0].match_expressions)
+                if pv.spec.local or pv.spec.host_path:
+                    # a local/hostPath volume dies with its node: keeping its
+                    # hostname pin would make the pod unschedulable anywhere
+                    # else (volumetopology.go:139-144)
+                    from ..api import labels as api_labels
+                    exprs = [r for r in exprs
+                             if r.key != api_labels.LABEL_HOSTNAME]
+                reqs.extend(exprs)
         elif sc_name:
             sc = store.get(StorageClass, sc_name)
             if sc is not None:

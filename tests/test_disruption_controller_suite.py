@@ -477,3 +477,67 @@ class TestDecisionMetrics:
             {"decision": "delete", "reason": "Empty",
              "consolidation_type": "empty"})
         assert after == before + 1
+
+
+class TestSimulateScheduling:
+    """suite_test.go:168-464."""
+
+    def test_deleting_node_pods_ride_the_simulation(self, env):
+        """suite_test.go:180-244: reschedulable pods on deleting nodes are
+        added to the pending set so their capacity need is modeled."""
+        from karpenter_tpu.disruption.helpers import simulate_scheduling
+        nc_a, node_a, pod_a = provision_node(env, name="pod-a")
+        nc_b, node_b, pod_b = provision_node(env, name="pod-b")
+        # node B is deleting (some other controller's action)
+        env.cluster.mark_for_deletion(nc_b.status.provider_id)
+        cands = candidates(env)
+        assert len(cands) == 1  # only A is a candidate
+        results, errors = simulate_scheduling(env.cluster, env.provisioner,
+                                              cands)
+        assert errors == {}
+        # both A's pod and B's pod were simulated somewhere
+        placed = {p.uid for ex in results.existing_nodes for p in ex.pods}
+        placed |= {p.uid for nc in results.new_nodeclaims for p in nc.pods}
+        assert pod_a.uid in placed and pod_b.uid in placed
+
+    def test_uninitialized_node_dependency_rejected(self, env):
+        """helpers.go:93-111: a command whose simulation parks pods on a
+        NOT-initialized managed node must surface errors for those pods."""
+        from karpenter_tpu.disruption.helpers import simulate_scheduling
+        nc_a, node_a, pod_a = provision_node(env, name="squeeze")
+        # a second, uninitialized node with room
+        nc_b, node_b, pod_b = provision_node(env, name="other")
+        env.store.delete(pod_b)
+        del node_b.metadata.labels[api_labels.NODE_INITIALIZED_LABEL_KEY]
+        env.store.update(node_b)
+        settle(env)
+        cands = [c for c in candidates(env) if c.name == node_a.name]
+        assert len(cands) == 1
+        results, _ = simulate_scheduling(env.cluster, env.provisioner, cands)
+        landed_on_b = [p for ex in results.existing_nodes
+                       if ex.state_node.name() == node_b.name
+                       for p in ex.pods]
+        for p in landed_on_b:
+            assert p.uid in results.pod_errors
+
+    def test_deleting_node_pods_allowed_on_uninitialized_nodes(self, env):
+        """suite_test.go:245-366 (successive replaces): pods that came off a
+        DELETING node may land on an uninitialized node without erroring —
+        its replacement is assumed to come up."""
+        from karpenter_tpu.disruption.helpers import simulate_scheduling
+        nc_a, node_a, pod_a = provision_node(env, name="first")
+        nc_b, node_b, pod_b = provision_node(env, name="second")
+        env.store.delete(pod_b)
+        settle(env)
+        # B is mid-replacement: deleting, and an uninitialized node C exists
+        env.cluster.mark_for_deletion(nc_b.status.provider_id)
+        bpod = make_pod(cpu="100m", name="displaced")
+        bpod.spec.node_name = node_b.name
+        env.store.create(bpod)
+        settle(env)
+        cands = [c for c in candidates(env) if c.name == node_a.name]
+        assert len(cands) == 1
+        results, errors = simulate_scheduling(env.cluster, env.provisioner,
+                                              cands)
+        # the displaced pod must not produce a candidate-blocking error
+        assert bpod.uid not in errors

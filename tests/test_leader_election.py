@@ -205,12 +205,26 @@ class TestKubeLease:
     def test_expired_lease_stolen_with_transition_count(self):
         api, clock, a, b = self._pair()
         assert a.try_acquire()
-        clock.step(16)  # past lease_duration with no renew
+        # b must OBSERVE the record unchanged for a full lease_duration by
+        # its own clock before stealing — the remote renewTime is never
+        # trusted directly (clock skew would allow stealing from a healthy
+        # leader otherwise)
+        assert not b.try_acquire()  # first observation starts b's window
+        clock.step(16)              # record unchanged for > lease_duration
         assert b.try_acquire()
         assert b.holder() == "replica-b"
         assert api.lease["spec"]["leaseTransitions"] == 1
         # the deposed leader's renew must fail
         assert not a.renew()
+
+    def test_renewing_leader_is_never_stolen_from(self, ):
+        _, clock, a, b = self._pair()
+        assert a.try_acquire()
+        for _ in range(6):
+            assert not b.try_acquire()  # each renew restarts b's window
+            clock.step(10)
+            assert a.renew()
+        assert a.holder() == "replica-a"
 
     def test_concurrent_steal_loses_cas(self):
         api, clock, a, b = self._pair()

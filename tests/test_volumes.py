@@ -410,3 +410,67 @@ class TestTensorVolumePath:
         on_node = sum(len(en.pods) for en in r.existing_nodes)
         assert on_node == 2  # limit 2: tensor takes both; host opens fresh
         assert r.new_nodeclaims
+
+
+class TestLocalVolumeHostnameAffinity:
+    """volumetopology.go:136-144 + provisioning/suite_test.go:1821-1905:
+    local/hostPath PVs pin to a hostname that dies with the node, so the
+    hostname requirement is dropped at scheduling time (the zone part is
+    kept) — otherwise the pod could never be provisioned a new node."""
+
+    def _bound_local_pv(self, env, name="pv-local", local=True,
+                        host_path=False, zone=None):
+        env.store.create(make_nodepool(name="default"))
+        exprs = [NodeSelectorRequirement(
+            api_labels.LABEL_HOSTNAME, "In", ("dead-node-1",))]
+        if zone:
+            exprs.append(NodeSelectorRequirement(
+                api_labels.LABEL_TOPOLOGY_ZONE, "In", (zone,)))
+        env.store.create(PersistentVolume(
+            metadata=ObjectMeta(name=name, namespace=""),
+            spec=PersistentVolumeSpec(
+                local=local, host_path=host_path,
+                node_affinity_terms=[NodeSelectorTerm(
+                    match_expressions=tuple(exprs))])))
+        env.store.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="pvc-local", namespace="default"),
+            spec=PVCSpec(volume_name=name)))
+
+    def test_local_pv_hostname_affinity_ignored(self, env):
+        zone = KWOK_ZONES[1]
+        self._bound_local_pv(env, zone=zone)
+        pod = make_volume_pod("pvc-local")
+        env.store.create(pod)
+        settle(env)
+        # schedulable despite the dead-node hostname pin; zone still honored
+        assert pod.spec.node_name, "pod must schedule"
+        node = env.store.get(Node, pod.spec.node_name)
+        assert node.labels[api_labels.LABEL_TOPOLOGY_ZONE] == zone
+
+    def test_host_path_pv_hostname_affinity_ignored(self, env):
+        self._bound_local_pv(env, local=False, host_path=True)
+        pod = make_volume_pod("pvc-local")
+        env.store.create(pod)
+        settle(env)
+        assert pod.spec.node_name
+
+    def test_non_local_pv_keeps_hostname_affinity(self, env):
+        """A network volume's hostname pin (if any) is real: the pod must
+        NOT schedule to some other node."""
+        self._bound_local_pv(env, local=False, host_path=False)
+        pod = make_volume_pod("pvc-local")
+        env.store.create(pod)
+        settle(env)
+        assert not pod.spec.node_name  # dead-node-1 doesn't exist
+
+    def test_local_pv_codec_round_trip(self, env):
+        from karpenter_tpu.kube.k8s_codec import pv_from_k8s, pv_to_k8s
+        pv = PersistentVolume(
+            metadata=ObjectMeta(name="pv-x", namespace=""),
+            spec=PersistentVolumeSpec(local=True))
+        out = pv_from_k8s(pv_to_k8s(pv))
+        assert out.spec.local and not out.spec.host_path
+        nfs = PersistentVolume(metadata=ObjectMeta(name="pv-y", namespace=""),
+                               spec=PersistentVolumeSpec())
+        out = pv_from_k8s(pv_to_k8s(nfs))
+        assert not out.spec.local and not out.spec.host_path
