@@ -239,3 +239,69 @@ class KwokCloudProvider(CloudProvider):
 
     def is_drifted(self, nodeclaim) -> str:
         return ""
+
+
+from ..controllers.manager import Controller as _Controller
+
+
+class KwokKubelet(_Controller):
+    """Kubelet/node-lifecycle simulation for the kwok fleet, standing in for
+    the out-of-band machinery the reference's kwok environment provides (the
+    kwok controller-manager fakes node heartbeats; the workload's node agent
+    removes its own startup taints once ready). After `ready_delay` seconds
+    of a node being REGISTERED, this controller clears the known ephemeral
+    taints and the owning claim's startup taints and stamps Ready=True — the
+    inputs NodeClaimLifecycle._initialize waits for.
+
+    A manager Controller (kinds=Node); keep it OUT of envs that assert on
+    pre-initialization taint states."""
+
+    name = "kwok.kubelet"
+
+    def __init__(self, store, clock, ready_delay: float = 2.0):
+        from ..api.objects import Node as NodeKind
+        self.kinds = (NodeKind,)
+        self.store = store
+        self.clock = clock
+        self.ready_delay = ready_delay
+        self._registered_at: dict = {}
+
+    def reconcile(self, node):
+        from ..api import labels as api_labels
+        from ..api.nodeclaim import NodeClaim
+        from ..controllers.manager import Result
+        from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+        from ..utils import node as node_utils
+        pid = node.spec.provider_id
+        if not pid or not pid.startswith("kwok://"):
+            return None
+        if node.metadata.deletion_timestamp is not None:
+            self._registered_at.pop(node.name, None)
+            return None
+        if node.metadata.labels.get(
+                api_labels.NODE_REGISTERED_LABEL_KEY) != "true":
+            return None
+        first = self._registered_at.setdefault(node.name, self.clock.now())
+        elapsed = self.clock.now() - first
+        if elapsed < self.ready_delay:
+            return Result(requeue_after=self.ready_delay - elapsed)
+        startup = []
+        for nc in self.store.list(NodeClaim):
+            if nc.status.provider_id == pid:
+                startup = list(nc.spec.startup_taints)
+                break
+        kept = [t for t in node.spec.taints
+                if not any(t.matches(e) for e in KNOWN_EPHEMERAL_TAINTS)
+                and not any(t.matches(s) for s in startup)]
+        ready = node_utils.get_condition(node, "Ready")
+        changed = len(kept) != len(node.spec.taints)
+        if ready is None:
+            # stamp Ready once; a node someone marked NotReady stays broken
+            # (node-repair scenarios depend on the failure persisting)
+            node_utils.set_condition(node, "Ready", "True",
+                                     now=self.clock.now())
+            changed = True
+        if changed:
+            node.spec.taints = kept
+            self.store.update(node)
+        return None

@@ -118,15 +118,47 @@ class NodeClaimLifecycle(Controller):
         return None
 
     def _register(self, nc: NodeClaim) -> None:
+        from ..api.objects import OwnerReference
         node = self._node_for(nc)
         if node is None:
             return
-        # sync: claim labels/annotations win (registration.go:74-101)
+        # invariant (registration.go:55-61): a Karpenter-managed node must
+        # come up with the unregistered NoExecute taint — workloads would
+        # otherwise race onto it before its labels/taints are synced. A node
+        # missing both the taint and the registered label fails registration.
+        has_unregistered = any(t.key == api_labels.UNREGISTERED_TAINT_KEY
+                               for t in node.spec.taints)
+        if not has_unregistered and \
+                api_labels.NODE_REGISTERED_LABEL_KEY not in node.metadata.labels:
+            prev = nc.conditions.get(COND_REGISTERED)
+            # update only on transition — an unconditional write would fire
+            # a watch event that re-reconciles this claim in a hot loop
+            if prev is None or prev.status != "False" or \
+                    prev.reason != "UnregisteredTaintNotFound":
+                nc.conditions.set_false(
+                    COND_REGISTERED, reason="UnregisteredTaintNotFound",
+                    message=(f"invariant violated, "
+                             f"{api_labels.UNREGISTERED_TAINT_KEY} taint must "
+                             "be present on Karpenter-managed nodes"),
+                    now=self.clock.now())
+                self.store.update(nc)
+            return
+        # sync: claim labels/annotations/taints win (registration.go:74-101);
+        # startup taints sync only HERE — their later removal by the workload
+        # must not be undone by a re-sync
         node.metadata.labels.update(nc.metadata.labels)
         node.metadata.labels[api_labels.NODE_REGISTERED_LABEL_KEY] = "true"
         node.metadata.annotations.update(nc.metadata.annotations)
-        node.spec.taints = [t for t in node.spec.taints
-                            if t.key != api_labels.UNREGISTERED_TAINT_KEY]
+        from ..scheduling.taints import merge as merge_taints
+        node.spec.taints = [
+            t for t in merge_taints(node.spec.taints,
+                                    list(nc.spec.taints)
+                                    + list(nc.spec.startup_taints))
+            if t.key != api_labels.UNREGISTERED_TAINT_KEY]
+        if not any(r.kind == "NodeClaim" for r in node.metadata.owner_refs):
+            node.metadata.owner_refs.append(OwnerReference(
+                kind="NodeClaim", name=nc.name, uid=nc.uid,
+                block_owner_deletion=True))
         if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
             node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
         self.store.update(node)
@@ -139,8 +171,14 @@ class NodeClaimLifecycle(Controller):
     # -- initialization -----------------------------------------------------
 
     def _initialize(self, nc: NodeClaim) -> None:
+        from ..utils import node as node_utils
         node = self._node_for(nc)
         if node is None:
+            return
+        # a NotReady kubelet blocks initialization (initialization.go:75-80);
+        # absent Ready condition = simulated node, treated healthy
+        ready = node_utils.get_condition(node, "Ready")
+        if ready is not None and ready[0] != "True":
             return
         startup = list(nc.spec.startup_taints)
         for t in node.spec.taints:

@@ -115,6 +115,18 @@ class Operator:
         if gates.node_repair:
             controllers.append(NodeHealth(self.store, self.cluster,
                                           self.cloud_provider, self.clock))
+        if self.options.kwok_kubelet and (
+                isinstance(self.cloud_provider, KwokCloudProvider)
+                or isinstance(getattr(self.cloud_provider, "_delegate", None),
+                              KwokCloudProvider)):
+            # the simulated fleet needs a kubelet analog to clear startup/
+            # ephemeral taints and stamp Ready (out-of-band machinery in the
+            # reference's kwok environment); --kwok-kubelet=false for
+            # scenarios asserting on pre-initialization taint states
+            from ..cloudprovider.kwok import KwokKubelet
+            controllers.append(KwokKubelet(
+                self.store, self.clock,
+                ready_delay=self.options.kwok_ready_delay))
         self.manager.register(*controllers)
 
         # restart = resync (cluster.go:96-150): replay the durable snapshot

@@ -65,6 +65,11 @@ class Options:
     leader_elect: bool = False
     lease_file: str = ""             # default: <state_file>.lease
     lease_duration: float = 15.0
+    # kwok simulation: the kubelet analog that clears startup/ephemeral
+    # taints and stamps Ready after kwok_ready_delay. Disable for scenarios
+    # that assert on pre-initialization taint states.
+    kwok_kubelet: bool = True
+    kwok_ready_delay: float = 2.0
 
     @property
     def gates(self) -> FeatureGates:

@@ -1,0 +1,259 @@
+"""Scenario port of /root/reference/pkg/controllers/nodeclaim/lifecycle/
+{initialization,registration,liveness}_test.go: registration invariants and
+node sync, initialization gating (NotReady, unregistered resources, startup
+and ephemeral taints), liveness TTL, and the kwok kubelet simulation."""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED, COND_REGISTERED,
+                                         NodeClaim)
+from karpenter_tpu.api.objects import Node, Taint
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider, KwokKubelet
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.provisioner import Binder, PodTrigger, Provisioner
+from karpenter_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(store=store)
+    mgr = Manager(store, clock)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    lifecycle = NodeClaimLifecycle(store, cluster, provider, clock)
+    mgr.register(provisioner, PodTrigger(provisioner),
+                 Binder(store, cluster, provisioner), lifecycle)
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.provider, e.mgr = \
+        clock, store, cluster, provider, mgr
+    e.lifecycle = lifecycle
+    return e
+
+
+def settle(env, rounds=6):
+    for _ in range(rounds):
+        env.mgr.run_until_quiet()
+        env.clock.step(1.1)
+    env.mgr.run_until_quiet()
+
+
+def manual_claim(env, startup_taints=()):
+    """A launched claim + fabricated node, driven by direct reconcile()
+    calls (no manager) so registration/initialization can be observed
+    mid-flight the way the reference drives its controllers."""
+    from karpenter_tpu.api.nodeclaim import COND_LAUNCHED
+    from karpenter_tpu.api.objects import ObjectMeta
+    env.store.create(make_nodepool(name="default"))
+    nc = NodeClaim(metadata=ObjectMeta(
+        name="manual-nc", namespace="",
+        labels={api_labels.NODEPOOL_LABEL_KEY: "default",
+                api_labels.LABEL_INSTANCE_TYPE: "c-1x-amd64-linux"}))
+    nc.spec.startup_taints = list(startup_taints)
+    env.provider.create(nc)  # fabricates the kwok node
+    nc.conditions.set_true(COND_LAUNCHED, reason="Launched")
+    env.store.create(nc)
+    node = next(n for n in env.store.list(Node)
+                if n.spec.provider_id == nc.status.provider_id)
+    return nc, node
+
+
+def launch_one(env, pool=None, **pod_kw):
+    env.store.create(pool or make_nodepool(name="default"))
+    env.store.create(make_pod(**pod_kw))
+    settle(env)
+    [nc] = env.store.list(NodeClaim)
+    return nc, env.store.get(Node, nc.status.node_name)
+
+
+class TestRegistration:
+    """registration_test.go:77-360."""
+
+    def test_owner_reference_added_to_node(self, env):
+        nc, node = launch_one(env, cpu="500m")
+        [ref] = [r for r in node.metadata.owner_refs if r.kind == "NodeClaim"]
+        assert ref.name == nc.name and ref.uid == nc.uid
+
+    def test_registered_label_synced_and_unregistered_taint_removed(self, env):
+        nc, node = launch_one(env, cpu="500m")
+        assert node.metadata.labels[api_labels.NODE_REGISTERED_LABEL_KEY] == "true"
+        assert not any(t.key == api_labels.UNREGISTERED_TAINT_KEY
+                       for t in node.spec.taints)
+        assert nc.conditions.is_true(COND_REGISTERED)
+
+    def test_labels_and_annotations_synced(self, env):
+        pool = make_nodepool(name="default", labels={"team": "ml"})
+        pool.spec.template.metadata_annotations["example.com/note"] = "hi"
+        nc, node = launch_one(env, pool=pool, cpu="500m")
+        assert node.metadata.labels["team"] == "ml"
+        assert node.metadata.annotations["example.com/note"] == "hi"
+
+    def test_taints_synced_to_node(self, env):
+        pool = make_nodepool(
+            name="default",
+            taints=[Taint(key="example.com/reserved", value="x",
+                          effect="NoSchedule")])
+        # the pod must tolerate the pool taint to trigger provisioning
+        from karpenter_tpu.api.objects import Toleration
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="500m", tolerations=[
+            Toleration(key="example.com/reserved", operator="Equal",
+                       value="x", effect="NoSchedule")]))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        node = env.store.get(Node, nc.status.node_name)
+        assert any(t.key == "example.com/reserved" for t in node.spec.taints)
+
+    def test_missing_unregistered_taint_fails_registration(self, env):
+        """registration_test.go:115-132: a node that came up without the
+        unregistered taint (and isn't labeled registered) violates the
+        managed-node invariant."""
+        nc, node = manual_claim(env)
+        node.spec.taints = [t for t in node.spec.taints
+                            if t.key != api_labels.UNREGISTERED_TAINT_KEY]
+        node.metadata.labels.pop(api_labels.NODE_REGISTERED_LABEL_KEY, None)
+        env.lifecycle.reconcile(nc)
+        cond = nc.conditions.get(COND_REGISTERED)
+        assert cond is not None and cond.status == "False"
+        assert cond.reason == "UnregisteredTaintNotFound"
+
+    def test_startup_taints_not_resynced_after_removal(self, env):
+        """registration_test.go:321-360: once the workload removes a startup
+        taint, re-reconciling the claim must not restore it."""
+        pool = make_nodepool(
+            name="default",
+            startup_taints=[Taint(key="example.com/agent-not-ready",
+                                  effect="NoSchedule")])
+        nc, node = launch_one(env, pool=pool, cpu="500m")
+        node.spec.taints = [t for t in node.spec.taints
+                            if t.key != "example.com/agent-not-ready"]
+        env.store.update(node)
+        settle(env)
+        node = env.store.get(Node, node.name)
+        assert not any(t.key == "example.com/agent-not-ready"
+                       for t in node.spec.taints)
+
+
+class TestInitialization:
+    """initialization_test.go:115-650."""
+
+    def test_not_initialized_before_registered(self, env):
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="500m"))
+        env.mgr.run_until_quiet()  # one pass: launched, not yet settled
+        for nc in env.store.list(NodeClaim):
+            if not nc.conditions.is_true(COND_REGISTERED):
+                assert not nc.conditions.is_true(COND_INITIALIZED)
+
+    def test_initialized_label_added(self, env):
+        nc, node = launch_one(env, cpu="500m")
+        assert node.metadata.labels[
+            api_labels.NODE_INITIALIZED_LABEL_KEY] == "true"
+        assert nc.conditions.is_true(COND_INITIALIZED)
+
+    def test_not_ready_node_blocks_initialization(self, env):
+        nc, node = manual_claim(env)
+        node.status.conditions.append({"type": "Ready", "status": "False"})
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_REGISTERED)
+        assert not nc.conditions.is_true(COND_INITIALIZED)
+        # kubelet comes up: Ready flips and initialization completes
+        node.status.conditions = [{"type": "Ready", "status": "True"}]
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_INITIALIZED)
+
+    def test_unregistered_resources_block_initialization(self, env):
+        """initialization_test.go:253-366: a device-plugin resource the
+        claim promises must appear on the node before initialization."""
+        nc, node = manual_claim(env)
+        nc.status.allocatable = dict(nc.status.allocatable)
+        nc.status.allocatable["example.com/accelerator"] = 1000
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_REGISTERED)
+        assert not nc.conditions.is_true(COND_INITIALIZED)
+        node.status.allocatable["example.com/accelerator"] = 1000
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_INITIALIZED)
+
+    def test_startup_taints_block_until_removed(self, env):
+        pool = make_nodepool(
+            name="default",
+            startup_taints=[Taint(key="example.com/agent-not-ready",
+                                  effect="NoSchedule")])
+        nc, node = launch_one(env, pool=pool, cpu="500m")
+        assert not nc.conditions.is_true(COND_INITIALIZED)
+        node.spec.taints = [t for t in node.spec.taints
+                            if t.key != "example.com/agent-not-ready"]
+        env.store.update(node)
+        settle(env)
+        nc = env.store.get(NodeClaim, nc.name, "")
+        assert nc.conditions.is_true(COND_INITIALIZED)
+
+    def test_ephemeral_taints_block_until_removed(self, env):
+        nc, node = manual_claim(env)
+        node.spec.taints.append(Taint(key="node.kubernetes.io/not-ready",
+                                      effect="NoSchedule"))
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_REGISTERED)
+        assert not nc.conditions.is_true(COND_INITIALIZED)
+        node.spec.taints = [t for t in node.spec.taints
+                            if t.key != "node.kubernetes.io/not-ready"]
+        env.lifecycle.reconcile(nc)
+        assert nc.conditions.is_true(COND_INITIALIZED)
+
+
+class TestLiveness:
+    """liveness_test.go: unregistered claims die at the TTL."""
+
+    def test_unregistered_claim_deleted_after_ttl(self, env):
+        env.lifecycle.registration_ttl = 60.0
+        env.store.create(make_nodepool(name="default"))
+        env.store.create(make_pod(cpu="500m"))
+        env.mgr.run_until_quiet()
+        # sabotage registration: delete the node out from under the claim
+        for node in env.store.list(Node):
+            env.store.delete(node)
+        env.clock.step(61)
+        settle(env, rounds=3)
+        # claim deleted; the provisioner may have started a fresh one, but
+        # the original is gone
+        assert all(nc.status.node_name == "" or
+                   env.store.get(Node, nc.status.node_name) is not None
+                   for nc in env.store.list(NodeClaim))
+
+
+class TestKwokKubelet:
+    """The sim's out-of-band node agent: startup/ephemeral taints clear and
+    Ready stamps after the ready delay."""
+
+    def test_kubelet_sim_clears_startup_taints_and_readies(self, env):
+        kubelet = KwokKubelet(env.store, env.clock, ready_delay=2.0)
+        env.mgr.register(kubelet)
+        pool = make_nodepool(
+            name="default",
+            startup_taints=[Taint(key="example.com/agent-not-ready",
+                                  effect="NoSchedule")])
+        env.store.create(pool)
+        env.store.create(make_pod(cpu="500m"))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        node = env.store.get(Node, nc.status.node_name)
+        assert not any(t.key == "example.com/agent-not-ready"
+                       for t in node.spec.taints)
+        from karpenter_tpu.utils.node import get_condition
+        assert get_condition(node, "Ready")[0] == "True"
+        assert nc.conditions.is_true(COND_INITIALIZED)
