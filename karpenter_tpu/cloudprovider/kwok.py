@@ -276,12 +276,20 @@ class KwokKubelet(_Controller):
         if not pid or not pid.startswith("kwok://"):
             return None
         if node.metadata.deletion_timestamp is not None:
-            self._registered_at.pop(node.name, None)
+            self._registered_at.pop(node.metadata.uid, None)
             return None
         if node.metadata.labels.get(
                 api_labels.NODE_REGISTERED_LABEL_KEY) != "true":
             return None
-        first = self._registered_at.setdefault(node.name, self.clock.now())
+        # keyed by uid so a re-used node NAME never inherits a stale window;
+        # entries for nodes deleted between passes are pruned opportunistically
+        if len(self._registered_at) > 4096:
+            from ..api.objects import Node as NodeKind
+            live = {n.metadata.uid for n in self.store.list(NodeKind)}
+            self._registered_at = {u: t for u, t in self._registered_at.items()
+                                   if u in live}
+        first = self._registered_at.setdefault(node.metadata.uid,
+                                               self.clock.now())
         elapsed = self.clock.now() - first
         if elapsed < self.ready_delay:
             return Result(requeue_after=self.ready_delay - elapsed)

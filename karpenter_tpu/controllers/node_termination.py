@@ -52,14 +52,32 @@ class NodeTermination(Controller):
     kinds = (Node,)
 
     def __init__(self, store: Store, cluster: Cluster,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, cloud_provider=None):
         self.store = store
         self.cluster = cluster
         self.clock = clock or store.clock
+        # for the instance-already-gone shortcut; None skips the check
+        self.cloud_provider = cloud_provider
         # pod key -> eviction backoff state (the eviction queue's rate
         # limiter); next_try gates when a blocked pod may be retried
         self._backoff = ItemBackoff(EVICTION_BASE_DELAY, EVICTION_MAX_DELAY)
         self._next_try: dict = {}
+
+    def _node_ready(self, node: Node) -> bool:
+        from ..utils import node as node_utils
+        cond = node_utils.get_condition(node, "Ready")
+        # absent Ready = simulated/condition-less node: treat as healthy so
+        # the instance-gone shortcut NEVER skips the drain without explicit
+        # NotReady evidence (consistent with nodeclaim_lifecycle._initialize)
+        return cond is None or cond[0] == "True"
+
+    def _release_pods(self, node: Node) -> None:
+        """The node is going away without a drain (instance already gone):
+        reschedulable pods unbind so the provisioner replaces their
+        capacity; everything else is deleted (the reference leans on kube
+        pod-GC + workload controllers here; this runtime has no analog)."""
+        for p in self._pods_on(node):
+            self._force_delete(p)
 
     def reconcile(self, node: Node) -> Optional[Result]:
         if node.metadata.deletion_timestamp is None:
@@ -73,6 +91,24 @@ class NodeTermination(Controller):
                 owning = nc
                 if nc.metadata.deletion_timestamp is None:
                     self.store.delete(nc)
+        # the cloud instance is already gone (manual delete, spot reclaim):
+        # draining waits on evictions that can never make progress on a dead
+        # kubelet — finalize immediately, UNLESS the node still reports
+        # Ready (the kubelet is heartbeating, so the instance plainly
+        # exists; trust the drain) (controller.go:151-176)
+        if self.cloud_provider is not None and not self._node_ready(node):
+            from ..cloudprovider.types import NodeClaimNotFoundError
+            try:
+                pid = node.spec.provider_id
+                if pid:
+                    self.cloud_provider.get(pid)
+            except NodeClaimNotFoundError:
+                log.info("instance already terminated; releasing node",
+                         node=node.name)
+                self._release_pods(node)
+                self.store.remove_finalizer(
+                    node, api_labels.TERMINATION_FINALIZER)
+                return None
         self._taint(node)
         self._annotate_termination_time(node, owning)
         remaining = self._drain(node)
@@ -141,7 +177,7 @@ class NodeTermination(Controller):
                 if expired or now >= p.metadata.deletion_timestamp + grace:
                     self.store.delete(p)
 
-        pods = [p for p in self._pods_on(node) if pod_utils.is_evictable(p)]
+        pods = [p for p in self._pods_on(node) if self._drainable(p)]
 
         # TGP preemptive deletes: pods whose own grace period no longer fits
         # before the node deadline start terminating immediately
@@ -193,7 +229,7 @@ class NodeTermination(Controller):
         # is exactly the window the provisioner uses to model its
         # replacement capacity)
         return len([p for p in self._pods_on(node)
-                    if pod_utils.is_evictable(p)
+                    if self._drainable(p)
                     or (p.metadata.deletion_timestamp is not None
                         and not pod_utils.is_terminal(p)
                         and not pod_utils.is_owned_by_daemonset(p)
@@ -233,6 +269,15 @@ class NodeTermination(Controller):
             self.store.update(pod)
         else:
             self.store.delete(pod)
+
+    def _drainable(self, pod: Pod) -> bool:
+        """Evictable AND does NOT tolerate the disrupted taint — a
+        tolerating pod opted into riding the node down: never evicted,
+        never blocks the drain (terminator.go:86-99). (tolerates() returns
+        untolerated-taint errors: non-empty = does not tolerate.)"""
+        from ..scheduling import taints as scheduling_taints
+        return pod_utils.is_evictable(pod) and bool(
+            scheduling_taints.tolerates([DISRUPTED_NO_SCHEDULE_TAINT], pod))
 
     def _critical(self, pod: Pod) -> bool:
         return (pod.spec.priority or 0) >= CRITICAL_PRIORITY or \

@@ -96,7 +96,8 @@ class Operator:
                                self.clock),
             NodeClaimDisruptionMarker(self.store, self.cluster,
                                       self.cloud_provider, self.clock),
-            NodeTermination(self.store, self.cluster, self.clock),
+            NodeTermination(self.store, self.cluster, self.clock,
+                            cloud_provider=self.cloud_provider),
             Expiration(self.store, self.clock),
             GarbageCollection(self.store, self.cloud_provider, self.clock),
             PodEvents(self.store, self.cluster, self.clock),

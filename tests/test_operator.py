@@ -106,14 +106,8 @@ class TestNodeRepair:
         op.store.create(make_pod(cpu="500m"))
         settle(op)
         node = op.store.list(Node)[0]
-        # conditions are keyed by type (apiserver semantics): replace any
-        # Ready the kwok kubelet-sim already stamped
-        node.status.conditions = [
-            c for c in node.status.conditions
-            if (c.get("type") if isinstance(c, dict) else c.type) != "Ready"]
-        node.status.conditions.append(
-            {"type": "Ready", "status": "False",
-             "last_transition_time": clock.now()})
+        from karpenter_tpu.utils.node import set_condition
+        set_condition(node, "Ready", "False", now=clock.now())
         op.store.update(node)
         clock.step(301)
         settle(op)

@@ -209,3 +209,95 @@ class TestPodRequirements:
         assert pod_requirements(pod).get("zone").values == {"z2"}
         # strict requirements exclude preferences entirely
         assert "zone" not in strict_pod_requirements(pod)
+
+
+# ---------------------------------------------------------------------------
+# Machine-extracted operator tables (requirement_test.go:104-893): 466
+# intersection triples over 28 fixtures, 70 Has() cases, 12 length cases.
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.scheduling.requirement import INF, Requirement
+from requirement_intersection_table import (ENTRIES, FIXTURES, HAS_ENTRIES,
+                                            LEN_ENTRIES)
+
+
+def _mk(name):
+    op, values, mv = FIXTURES[name]
+    return Requirement("key", op, values, min_values=mv)
+
+
+def _shape(r):
+    return (r.complement, frozenset(r.values), r.greater_than, r.less_than,
+            r.min_values)
+
+
+class TestReferenceIntersectionTable:
+    def test_all_466_intersections(self):
+        failures = []
+        for a_name, b_name, want_name in ENTRIES:
+            got = _mk(a_name).intersection(_mk(b_name))
+            want = _mk(want_name)
+            if _shape(got) != _shape(want):
+                failures.append(
+                    f"{a_name} ∩ {b_name}: got {_shape(got)}, "
+                    f"want {want_name}={_shape(want)}")
+        assert not failures, "\n".join(failures[:20]) + \
+            f"\n... {len(failures)} total"
+
+    def test_intersection_is_commutative_on_shape(self):
+        names = list(FIXTURES)
+        for a in names:
+            for b in names:
+                ab = _shape(_mk(a).intersection(_mk(b)))
+                ba = _shape(_mk(b).intersection(_mk(a)))
+                assert ab == ba, (a, b)
+
+    def test_has_table(self):
+        for name, value, want in HAS_ENTRIES:
+            assert _mk(name).has(value) == want, (name, value)
+
+    def test_length_table(self):
+        for name, want in LEN_ENTRIES:
+            want = INF if want == "INF" else int(want)
+            assert _mk(name).length() == want, name
+
+
+class TestReferenceCompatibilityMatrices:
+    """requirements_test.go:57-543 — 225 lenient (well-known labels may be
+    undefined) + 225 strict Compatible() verdicts over single-requirement
+    sets on the zone key."""
+
+    ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+
+    def _reqs(self, name):
+        from karpenter_tpu.scheduling.requirements import Requirements
+        if name == "unconstrained":
+            return Requirements()
+        op, values, _ = FIXTURES[name]
+        return Requirements([Requirement(self.ZONE, op, values)])
+
+    def test_lenient_matrix(self):
+        from requirement_intersection_table import COMPAT_LENIENT
+        from karpenter_tpu.scheduling.requirements import \
+            ALLOW_UNDEFINED_WELL_KNOWN
+        failures = []
+        for a, b, want_ok in COMPAT_LENIENT:
+            got_ok = not self._reqs(a).compatible(
+                self._reqs(b), ALLOW_UNDEFINED_WELL_KNOWN)
+            if got_ok != want_ok:
+                failures.append(f"{a}.Compatible({b}, lenient): got "
+                                f"{got_ok}, want {want_ok}")
+        assert not failures, "\n".join(failures[:15]) + \
+            f"\n... {len(failures)} total"
+
+    def test_strict_matrix(self):
+        from requirement_intersection_table import COMPAT_STRICT
+        failures = []
+        for a, b, want_ok in COMPAT_STRICT:
+            got_ok = not self._reqs(a).compatible(self._reqs(b))
+            if got_ok != want_ok:
+                failures.append(f"{a}.Compatible({b}, strict): got "
+                                f"{got_ok}, want {want_ok}")
+        assert not failures, "\n".join(failures[:15]) + \
+            f"\n... {len(failures)} total"

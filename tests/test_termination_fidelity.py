@@ -262,3 +262,163 @@ class TestStoreUidIndex:
         assert store.get_by_uid(Node, uid) is node  # still finalizing
         store.remove_finalizer(node, "test/finalizer")
         assert store.get_by_uid(Node, uid) is None
+
+
+# ---------------------------------------------------------------------------
+# Widened port of node/termination/suite_test.go:106-877
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.api.objects import OwnerReference, Toleration
+from karpenter_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+
+
+def _terminate(op, node, rounds=6):
+    op.store.delete(node)
+    for _ in range(rounds):
+        settle(op)
+        op.clock.step(2)
+
+
+class TestDrainSemantics:
+    def test_pod_tolerating_disrupted_taint_not_evicted(self, op):
+        """suite_test.go:193-254: a pod that tolerates the disruption taint
+        opted into dying with the node; it is not evicted and does not hold
+        the drain open."""
+        pod, node = _provision_one(op)
+        rider = make_pod(cpu="100m", name="rider", tolerations=[
+            Toleration(key=DISRUPTED_NO_SCHEDULE_TAINT.key,
+                       operator="Exists")])
+        rider.spec.node_name = node.name
+        op.store.create(rider)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None  # drain completed
+        # the workload pod was evicted (unbound), the rider never was: it
+        # went down with the node (its record remains, bound to the gone
+        # node, exactly like a real kubelet-killed pod before GC)
+        live_rider = op.store.get(Pod, "rider", rider.namespace)
+        assert live_rider is None or live_rider.spec.node_name == node.name
+
+    def test_pod_tolerating_only_unschedulable_is_evicted(self, op):
+        """suite_test.go:255-282."""
+        pod, node = _provision_one(op)
+        tol = make_pod(cpu="100m", name="tol-unsched", tolerations=[
+            Toleration(key="node.kubernetes.io/unschedulable",
+                       operator="Exists")])
+        tol.spec.node_name = node.name
+        op.store.create(tol)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
+        live = op.store.get(Pod, "tol-unsched", tol.namespace)
+        assert live is None or live.spec.node_name != node.name  # evicted
+
+    def test_pods_without_owner_ref_do_not_block(self, op):
+        """suite_test.go:283-312."""
+        pod, node = _provision_one(op)
+        bare = make_pod(cpu="100m", name="bare")  # no ownerRef at all
+        bare.spec.node_name = node.name
+        op.store.create(bare)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
+
+    def test_terminal_pods_do_not_block(self, op):
+        """suite_test.go:313-331."""
+        pod, node = _provision_one(op)
+        done = make_pod(cpu="100m", name="done")
+        done.status.phase = "Succeeded"
+        done.spec.node_name = node.name
+        op.store.create(done)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
+
+    def test_static_pods_not_evicted(self, op):
+        """suite_test.go:487-531: node-owned (static) pods are never
+        evicted; the node still terminates."""
+        pod, node = _provision_one(op)
+        static = make_pod(cpu="100m", name="static")
+        static.metadata.owner_refs.append(OwnerReference(kind="Node",
+                                                         name=node.name))
+        static.spec.node_name = node.name
+        op.store.create(static)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
+
+    def test_non_critical_pods_evicted_before_critical(self, op):
+        """suite_test.go:450-486: the drain processes one priority group
+        per pass — regular pods leave before critical ones."""
+        pod, node = _provision_one(op)
+        crit = make_pod(cpu="100m", name="crit")
+        crit.spec.priority_class_name = "system-cluster-critical"
+        crit.spec.node_name = node.name
+        op.store.create(crit)
+        settle(op)
+        from karpenter_tpu.controllers.node_termination import NodeTermination
+        term = NodeTermination(op.store, op.cluster, op.clock)
+        op.store.delete(node)
+        term.reconcile(node)  # FIRST drain pass: regular group only
+        live_reg = op.store.get(Pod, pod.name, pod.namespace)
+        live_crit = op.store.get(Pod, "crit", crit.namespace)
+        assert live_reg is None or live_reg.spec.node_name != node.name
+        assert live_crit is not None and live_crit.spec.node_name == node.name
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
+
+
+class TestInstanceGone:
+    def test_node_without_instance_released_undrained(self, op):
+        """suite_test.go:567-601: the cloud instance is gone (spot reclaim)
+        — waiting on a dead kubelet's evictions is pointless."""
+        pod, node = _provision_one(op)
+        blocked = make_pod(cpu="100m", name="blocked")
+        blocked.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        blocked.spec.node_name = node.name
+        op.store.create(blocked)
+        settle(op)
+        # mark NotReady (dead kubelet) and rip the instance out of kwok
+        from karpenter_tpu.utils.node import set_condition
+        node.status.conditions = []
+        set_condition(node, "Ready", "False", now=op.clock.now())
+        op.store.update(node)
+        pid = node.spec.provider_id
+        # kwok's "cloud" is the store's Node objects: simulate the instance
+        # vanishing by making get() raise for this provider id
+        from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+        real_get = op.cloud_provider.get
+
+        def gone(provider_id):
+            if provider_id == pid:
+                raise NodeClaimNotFoundError(provider_id)
+            return real_get(provider_id)
+
+        op.cloud_provider.get = gone
+        _terminate(op, node, rounds=2)
+        assert op.store.get(Node, node.name) is None
+        # stranded workloads were released: the do-not-disrupt pod is either
+        # unbound (awaiting replacement capacity) or already rescheduled
+        live = op.store.get(Pod, "blocked", blocked.namespace)
+        assert live is None or live.spec.node_name != node.name
+
+    def test_ready_node_still_drains_even_if_instance_lookup_fails(self, op):
+        """suite_test.go:602-634: a Ready node's kubelet is heartbeating —
+        the instance exists; never shortcut the drain."""
+        pod, node = _provision_one(op)
+        from karpenter_tpu.utils.node import set_condition
+        node.status.conditions = []
+        set_condition(node, "Ready", "True", now=op.clock.now())
+        op.store.update(node)
+        from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+
+        def gone(provider_id):
+            raise NodeClaimNotFoundError(provider_id)
+
+        op.cloud_provider.get = gone
+        _terminate(op, node)
+        # normal drain path ran: node gone AND the workload pod was evicted
+        assert op.store.get(Node, node.name) is None
+        live = op.store.get(Pod, pod.name, pod.namespace)
+        assert live is None or live.spec.node_name != node.name
