@@ -265,6 +265,7 @@ class KwokKubelet(_Controller):
         self.clock = clock
         self.ready_delay = ready_delay
         self._registered_at: dict = {}
+        self._last_prune_at = 0.0
 
     def reconcile(self, node):
         from ..api import labels as api_labels
@@ -283,11 +284,16 @@ class KwokKubelet(_Controller):
             return None
         # keyed by uid so a re-used node NAME never inherits a stale window;
         # entries for nodes deleted between passes are pruned opportunistically
-        if len(self._registered_at) > 4096:
+        # (rate-limited: at 4096+ LIVE nodes an every-reconcile prune would
+        # make each pass O(N^2))
+        now = self.clock.now()
+        if len(self._registered_at) > 4096 and \
+                now - self._last_prune_at > 60.0:
             from ..api.objects import Node as NodeKind
             live = {n.metadata.uid for n in self.store.list(NodeKind)}
             self._registered_at = {u: t for u, t in self._registered_at.items()
                                    if u in live}
+            self._last_prune_at = now
         first = self._registered_at.setdefault(node.metadata.uid,
                                                self.clock.now())
         elapsed = self.clock.now() - first

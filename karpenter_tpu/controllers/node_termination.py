@@ -247,7 +247,10 @@ class NodeTermination(Controller):
             return []
         blocked_pvs = set()
         for p in self._pods_on(node):
-            if pod_utils.is_evictable(p) and pod_utils.is_disruptable(p):
+            # same drainable predicate as _drain: a disrupted-taint-
+            # tolerating pod is never evicted, so its attachments will never
+            # detach and must not hold the node (controller.go:216)
+            if self._drainable(p) and pod_utils.is_disruptable(p):
                 continue
             for ref in p.spec.volumes:
                 pvc = self.store.get(PersistentVolumeClaim, ref.claim_name,

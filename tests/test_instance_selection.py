@@ -171,3 +171,112 @@ class TestExistingNodeOrder:
         assert not r.pod_errors
         placed = [en for en in r.existing_nodes if en.pods]
         assert [en.name for en in placed] == ["b-init"]
+
+
+class TestCheapestCompatibleMatrix:
+    """instance_selection_test.go:87-462: under any single constraint —
+    from the pool's requirements or the pod's node selector — the launch
+    list is headed by the cheapest COMPATIBLE type and every option
+    satisfies the constraint."""
+
+    CASES = [
+        (api_labels.LABEL_ARCH, api_labels.ARCHITECTURE_AMD64),
+        (api_labels.LABEL_ARCH, api_labels.ARCHITECTURE_ARM64),
+        (api_labels.LABEL_OS, "linux"),
+        (api_labels.LABEL_OS, "windows"),
+        (api_labels.LABEL_TOPOLOGY_ZONE, "test-zone-b"),
+        (api_labels.CAPACITY_TYPE_LABEL_KEY, api_labels.CAPACITY_TYPE_SPOT),
+        (api_labels.CAPACITY_TYPE_LABEL_KEY,
+         api_labels.CAPACITY_TYPE_ON_DEMAND),
+    ]
+
+    def _assert_cheapest_compatible(self, r, key, value):
+        assert not r.pod_errors
+        [nc] = r.new_nodeclaims
+        opts = nc.instance_type_options
+        assert opts
+        reqs = nc.requirements
+        assert reqs.get(key).has(value)
+        # every option admits the constraint (zone/ct live on offerings)
+        for it in opts:
+            if key in (api_labels.LABEL_TOPOLOGY_ZONE,
+                       api_labels.CAPACITY_TYPE_LABEL_KEY):
+                assert any(
+                    (o.zone == value if key == api_labels.LABEL_TOPOLOGY_ZONE
+                     else o.capacity_type == value)
+                    for o in it.offerings if o.available), it.name
+            else:
+                assert it.requirements.get(key).has(value), it.name
+        # cheapest compatible heads the list
+        def best_price(it):
+            return min((o.price for o in it.offerings
+                        if o.available
+                        and (key != api_labels.LABEL_TOPOLOGY_ZONE
+                             or o.zone == value)
+                        and (key != api_labels.CAPACITY_TYPE_LABEL_KEY
+                             or o.capacity_type == value)), default=float("inf"))
+        prices = [best_price(it) for it in opts]
+        assert prices[0] == min(prices)
+
+    @pytest.mark.parametrize("key,value", CASES)
+    def test_pod_constraint(self, key, value):
+        its = construct_instance_types()[:64]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m", node_selector={key: value})])
+        self._assert_cheapest_compatible(r, key, value)
+
+    @pytest.mark.parametrize("key,value", CASES)
+    def test_pool_constraint(self, key, value):
+        its = construct_instance_types()[:64]
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(key, "In", (value,))])
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m")])
+        self._assert_cheapest_compatible(r, key, value)
+
+    def test_combined_pool_and_pod_constraints(self):
+        """instance_selection_test.go:331-462: pool pins capacity type, the
+        pod pins zone — both must hold simultaneously."""
+        its = construct_instance_types()[:64]
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(api_labels.CAPACITY_TYPE_LABEL_KEY, "In",
+                                    (api_labels.CAPACITY_TYPE_SPOT,))])
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m", node_selector={
+            api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-b"})])
+        assert not r.pod_errors
+        [nc] = r.new_nodeclaims
+        assert nc.requirements.get(
+            api_labels.CAPACITY_TYPE_LABEL_KEY).has("spot")
+        assert nc.requirements.get(
+            api_labels.LABEL_TOPOLOGY_ZONE).has("test-zone-b")
+
+    def test_no_match_pod_arch_fails(self):
+        """instance_selection_test.go:463-482."""
+        its = construct_instance_types()[:32]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="500m",
+                              node_selector={api_labels.LABEL_ARCH: "arm"})])
+        assert r.pod_errors and not r.new_nodeclaims
+
+    def test_no_match_pool_arch_pod_zone_fails(self):
+        """instance_selection_test.go:512-545: pool restricts to a zone the
+        requested arch has no capacity in? Here: pool pins an arch value the
+        catalog lacks entirely."""
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(api_labels.LABEL_ARCH, "In", ("s390x",))])
+        its = construct_instance_types()[:32]
+        s = make_scheduler([pool], its, [])
+        r = s.solve([make_pod(cpu="500m", node_selector={
+            api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-b"})])
+        assert r.pod_errors and not r.new_nodeclaims
+
+    def test_large_pod_selects_instance_with_enough_resources(self):
+        """instance_selection_test.go:546-599."""
+        its = construct_instance_types()[:64]
+        s = make_scheduler([make_nodepool()], its, [])
+        r = s.solve([make_pod(cpu="7", memory="8Gi")])
+        assert not r.pod_errors
+        [nc] = r.new_nodeclaims
+        for it in nc.instance_type_options:
+            assert it.allocatable()["cpu"] >= 7000, it.name

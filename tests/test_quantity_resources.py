@@ -69,3 +69,58 @@ def test_pod_requests_init_containers_max():
         init_container_requests=[res.parse_list({"cpu": "1"})],
     )
     assert p.requests()["cpu"] == 1000
+
+
+class TestSidecarInterleavings:
+    """utils/resources/suite_test.go:344-530: element-wise max over
+    interleaved init/sidecar sequences, including per-resource divergence."""
+
+    GI = 1024 ** 3 * 1000  # memory milliunits per Gi
+
+    def _pod(self, container, inits):
+        from karpenter_tpu.api.objects import Pod
+        p = Pod()
+        p.container_requests = [
+            {"cpu": container[0] * 1000, "memory": container[1] * self.GI}]
+        p.init_container_requests = [
+            ({"cpu": c * 1000, "memory": m * self.GI}, True) if sidecar
+            else {"cpu": c * 1000, "memory": m * self.GI}
+            for c, m, sidecar in inits]
+        return p
+
+    def test_interspersed_sidecars_and_inits(self):
+        """suite_test.go:344-424: containers 3/3Gi, inits
+        2,s1,3,1,s5,1,1,s1,2 -> 10 cpu / 10Gi."""
+        p = self._pod((3, 3), [
+            (2, 2, False), (1, 1, True), (3, 3, False), (1, 1, False),
+            (5, 5, True), (1, 1, False), (1, 1, False), (1, 1, True),
+            (2, 1, False)])
+        r = p.requests()
+        assert r["cpu"] == 10_000
+        assert r["memory"] == 10 * self.GI
+
+    def test_first_init_exceeds_cpu_but_not_memory(self):
+        """suite_test.go:425-463: containers 3/3Gi, init 25/4Gi, sidecars
+        1/1Gi + 5/5Gi -> 25 cpu / 9Gi (per-resource max diverges)."""
+        p = self._pod((3, 3), [
+            (25, 4, False), (1, 1, True), (5, 5, True)])
+        r = p.requests()
+        assert r["cpu"] == 25_000
+        assert r["memory"] == 9 * self.GI
+
+    def test_first_init_exceeds_memory_but_not_cpu(self):
+        """suite_test.go:464-502: containers 3/3Gi, init 4/25Gi, sidecars
+        1/1Gi + 5/5Gi -> 9 cpu / 25Gi."""
+        p = self._pod((3, 3), [
+            (4, 25, False), (1, 1, True), (5, 5, True)])
+        r = p.requests()
+        assert r["cpu"] == 9_000
+        assert r["memory"] == 25 * self.GI
+
+    def test_init_after_sidecar_exceeds_cpu_only(self):
+        """suite_test.go:503-530: containers 2/4Gi, sidecar 4/2Gi, init
+        10/2Gi -> 14 cpu / 6Gi."""
+        p = self._pod((2, 4), [(4, 2, True), (10, 2, False)])
+        r = p.requests()
+        assert r["cpu"] == 14_000
+        assert r["memory"] == 6 * self.GI

@@ -422,3 +422,21 @@ class TestInstanceGone:
         assert op.store.get(Node, node.name) is None
         live = op.store.get(Pod, pod.name, pod.namespace)
         assert live is None or live.spec.node_name != node.name
+
+
+class TestTolerantPodVolumes:
+    def test_tolerating_pod_volume_does_not_block_termination(self, op):
+        """A disrupted-taint-tolerating pod is never evicted, so its
+        VolumeAttachment will never detach — it must not hold the node
+        (controller.go:216 IsDrainable filter)."""
+        pod, node = _provision_one(op)
+        rider = make_pod(cpu="100m", name="rider-vol", tolerations=[
+            Toleration(key=DISRUPTED_NO_SCHEDULE_TAINT.key,
+                       operator="Exists")])
+        rider.spec.node_name = node.name
+        op.store.create(rider)
+        _bind_volume(op, rider, pv_name="pv-rider", claim="pvc-rider",
+                     node=node)
+        settle(op)
+        _terminate(op, node)
+        assert op.store.get(Node, node.name) is None
