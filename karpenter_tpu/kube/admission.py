@@ -94,6 +94,9 @@ def validate_nodepool(np, old=None) -> List[str]:
     if len(budgets) > 50:
         errs.append("spec.disruption.budgets: may not have more than 50 "
                     "items")  # nodepool.go:81 MaxItems
+    from ..api.nodepool import (REASON_DRIFTED, REASON_EMPTY,
+                                REASON_UNDERUTILIZED)
+    allowed_reasons = {REASON_UNDERUTILIZED, REASON_EMPTY, REASON_DRIFTED}
     for i, b in enumerate(budgets):
         if not _BUDGET_NODES_RE.match(str(b.nodes)):
             errs.append(f"spec.disruption.budgets[{i}].nodes: {b.nodes!r} "
@@ -108,9 +111,22 @@ def validate_nodepool(np, old=None) -> List[str]:
             except Exception:
                 errs.append(f"spec.disruption.budgets[{i}].schedule: "
                             f"{b.schedule!r} is not a valid cron schedule")
+        if b.reasons is not None:
+            for reason in b.reasons:
+                if reason not in allowed_reasons:
+                    errs.append(
+                        f"spec.disruption.budgets[{i}].reasons: {reason!r} "
+                        f"is not one of {sorted(allowed_reasons)}")
         if b.duration is not None and b.duration < 0:
             errs.append(f"spec.disruption.budgets[{i}].duration: must be "
                         "non-negative")
+    if tmpl.expire_after is not None and tmpl.expire_after < 0:
+        errs.append("spec.template.spec.expireAfter: must be non-negative "
+                    "(or Never)")
+    if tmpl.termination_grace_period is not None \
+            and tmpl.termination_grace_period < 0:
+        errs.append("spec.template.spec.terminationGracePeriod: must be "
+                    "non-negative")
     if spec.disruption.consolidate_after is not None \
             and spec.disruption.consolidate_after < 0:
         errs.append("spec.disruption.consolidateAfter: must be non-negative "
@@ -130,6 +146,8 @@ def validate_nodeclaim(nc, old=None) -> List[str]:
     if spec.termination_grace_period is not None \
             and spec.termination_grace_period < 0:
         errs.append("spec.terminationGracePeriod: must be non-negative")
+    if spec.expire_after is not None and spec.expire_after < 0:
+        errs.append("spec.expireAfter: must be non-negative (or Never)")
     # nodeclaim.go:143 — spec is immutable once created
     if old is not None and old.spec != spec:
         errs.append("spec: spec is immutable")

@@ -4,6 +4,8 @@ Accept/reject table mirrors /root/reference/pkg/apis/v1/
 nodeclaim_validation.go:62-151 (ValidateRequirement + validateTaints) and
 the webhook behaviors its suite pins."""
 
+import itertools
+
 import pytest
 
 from karpenter_tpu.api import labels as api_labels
@@ -310,3 +312,118 @@ class TestStoreAdmission:
                 _SelectorReq("kubernetes.io/custom", "In", ("x",))]))
         with pytest.raises(InvalidError):
             store.create(bad)
+
+
+from karpenter_tpu.api.nodepool import Budget
+from karpenter_tpu.kube.store import InvalidError, Store
+
+
+class TestDisruptionCelTable:
+    """Accept/reject table from nodepool_validation_cel_test.go:67-275
+    (the disruption block: durations, budgets, crons, reasons), enforced at
+    the store boundary like the apiserver's CEL rules."""
+
+    _seq = itertools.count(1)
+
+    def _pool(self, mutate):
+        pool = make_nodepool(name=f"celpool-{next(self._seq)}")
+        mutate(pool)
+        return pool
+
+    def _accepts(self, store, mutate):
+        try:
+            store.create(self._pool(mutate))
+            return True
+        except InvalidError:
+            return False
+
+    @pytest.fixture
+    def store(self):
+        from karpenter_tpu.utils.clock import FakeClock
+        return Store(FakeClock())
+
+    def test_consolidate_after_rules(self, store):
+        assert not self._accepts(store, lambda p: setattr(
+            p.spec.disruption, "consolidate_after", -1.0))
+        assert self._accepts(store, lambda p: setattr(
+            p.spec.disruption, "consolidate_after", None))  # Never
+        assert self._accepts(store, lambda p: setattr(
+            p.spec.disruption, "consolidate_after", 30.0))
+
+    def test_expire_after_rules(self, store):
+        assert not self._accepts(store, lambda p: setattr(
+            p.spec.template.spec, "expire_after", -1.0))
+        assert self._accepts(store, lambda p: setattr(
+            p.spec.template.spec, "expire_after", None))
+        assert self._accepts(store, lambda p: setattr(
+            p.spec.template.spec, "expire_after", 3600.0))
+
+    def test_budget_cron_rules(self, store):
+        def bad_cron(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="*crontab", duration=3600.0)]
+        assert not self._accepts(store, bad_cron)
+
+        def short_cron(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="* * *", duration=3600.0)]
+        assert not self._accepts(store, short_cron)
+
+        def special_cron(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="@daily", duration=3600.0)]
+        assert self._accepts(store, special_cron)
+
+    def test_budget_duration_rules(self, store):
+        def negative(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="* * * * *", duration=-3600.0)]
+        assert not self._accepts(store, negative)
+
+        def cron_without_duration(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="* * * * *", duration=None)]
+        assert not self._accepts(store, cron_without_duration)
+
+        def duration_without_cron(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule=None, duration=3600.0)]
+        assert not self._accepts(store, duration_without_cron)
+
+        def both(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="* * * * *", duration=6900.0)]
+        assert self._accepts(store, both)
+
+        def neither(p):
+            p.spec.disruption.budgets = [Budget(nodes="10")]
+        assert self._accepts(store, neither)
+
+    def test_budget_nodes_rules(self, store):
+        for bad in ("-10", "-10%", "1000%", "129%"):
+            def mutate(p, bad=bad):
+                p.spec.disruption.budgets = [Budget(nodes=bad)]
+            assert not self._accepts(store, mutate), bad
+        for ok in ("0", "10", "100%", "0%"):
+            def mutate(p, ok=ok):
+                p.spec.disruption.budgets = [Budget(nodes=ok)]
+            assert self._accepts(store, mutate), ok
+
+    def test_one_bad_budget_rejects_the_pool(self, store):
+        def mutate(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", schedule="@daily", duration=3600.0),
+                Budget(nodes="10", schedule="*", duration=3600.0)]
+        assert not self._accepts(store, mutate)
+
+    def test_budget_reason_enum(self, store):
+        def bad(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10", reasons=["CloudProviderInterruption"])]
+        assert not self._accepts(store, bad)
+
+        def ok(p):
+            p.spec.disruption.budgets = [
+                Budget(nodes="10",
+                       reasons=["Underutilized", "Empty", "Drifted"])]
+        assert self._accepts(store, ok)
