@@ -192,7 +192,20 @@ class Provisioner(SingletonController):
 
     def reconcile(self) -> Optional[Result]:
         pods = self.get_pending_pods()
-        if not pods:
+        # pods on deleting nodes must be rescheduled too, even when nothing
+        # is pending — their replacement capacity has to exist before the
+        # drain unbinds them (provisioner.go:316-335: the empty-batch exit
+        # comes AFTER the deleting-node pods are gathered)
+        deleting_pods: List[Pod] = []
+        seen = {p.uid for p in pods}
+        for sn in self.cluster.deleting_nodes():
+            for uid in sn.pod_requests:
+                if uid in seen:
+                    continue
+                p = self._pod_by_uid(uid)
+                if p is not None and pod_utils.is_reschedulable(p):
+                    deleting_pods.append(p)
+        if not pods and not deleting_pods:
             self.batcher.reset()
             return None
         if self.batcher._first is None:
@@ -202,14 +215,6 @@ class Provisioner(SingletonController):
             return Result(requeue_after=self.batcher.time_until_ready())
         self.batcher.reset()
         self.cluster.ack_pods(pods)
-
-        # pods on deleting nodes must be rescheduled too (provisioner.go:316-320)
-        deleting_pods: List[Pod] = []
-        for sn in self.cluster.deleting_nodes():
-            for uid in sn.pod_requests:
-                p = self._pod_by_uid(uid)
-                if p is not None and pod_utils.is_reschedulable(p):
-                    deleting_pods.append(p)
         from ..metrics import registry as metrics
         done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
         started = self.clock.now()

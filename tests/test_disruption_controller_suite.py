@@ -534,7 +534,9 @@ class TestSimulateScheduling:
         bpod = make_pod(cpu="100m", name="displaced")
         bpod.spec.node_name = node_b.name
         env.store.create(bpod)
-        settle(env)
+        # no settle: the provisioner would (correctly) nominate a target for
+        # the displaced pod, which blocks A's candidacy — this scenario
+        # drives the simulation directly
         cands = [c for c in candidates(env) if c.name == node_a.name]
         assert len(cands) == 1
         results, errors = simulate_scheduling(env.cluster, env.provisioner,

@@ -319,3 +319,55 @@ class TestLimitsPartialScheduling:
         env.provisioner.trigger()
         settle(env)
         assert len(env.store.list(NodeClaim)) == 1
+
+
+class TestDeletingNodeCarryover:
+    """suite_test.go:384-423: pods bound to a deleting node are re-planned
+    onto ONE new node (they ride the pending set; the deleting node is not
+    packable)."""
+
+    def test_pods_on_deleting_node_consolidate_onto_one_replacement(self, env):
+        env.store.create(make_nodepool(name="default"))
+        pods = [make_pod(cpu="500m", name=f"carry-{i}") for i in range(3)]
+        for p in pods:
+            env.store.create(p)
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        node = env.store.get(Node, nc.status.node_name)
+        assert all(env.store.get(Pod, p.name, p.namespace).spec.node_name
+                   == node.name for p in pods)
+        # the node starts deleting (finalizer holds it); pods stay bound —
+        # the drain unbinds them later — but provisioning must already plan
+        # replacement capacity for them, together, on ONE new claim
+        env.store.delete(node)
+        env.provisioner.trigger()
+        settle(env, rounds=8)
+        new_claims = [c for c in env.store.list(NodeClaim)
+                      if c.name != nc.name]
+        assert len(new_claims) == 1
+        # sized for all three carried pods (3 x 500m + slots)
+        assert new_claims[0].spec.resources_requests["cpu"] >= 1500
+
+
+class TestNodePoolWeightPriority:
+    """suite_test.go:2175+: the highest-weight pool wins when multiple can
+    satisfy the pod."""
+
+    def test_highest_weight_pool_always_selected(self, env):
+        env.store.create(make_nodepool(name="light", weight=1))
+        env.store.create(make_nodepool(name="heavy", weight=100))
+        for i in range(3):
+            env.store.create(make_pod(cpu="500m", name=f"w-{i}"))
+            settle(env, rounds=3)
+        for nc in env.store.list(NodeClaim):
+            assert nc.nodepool_name == "heavy"
+
+    def test_weight_loser_takes_overflow_when_winner_limited(self, env):
+        heavy = make_nodepool(name="heavy", weight=100, limits={"cpu": "1"})
+        env.store.create(heavy)
+        env.store.create(make_nodepool(name="light", weight=1))
+        env.store.create(make_pod(cpu="1500m", name="big",
+                                  node_selector=dict(OD)))
+        settle(env)
+        [nc] = env.store.list(NodeClaim)
+        assert nc.nodepool_name == "light"  # heavy's limit excluded it
