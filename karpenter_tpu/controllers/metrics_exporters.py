@@ -13,7 +13,7 @@ from typing import Optional
 from ..api import labels as api_labels
 from ..api.objects import Node, Pod
 from ..kube.store import Store
-from ..metrics.registry import REGISTRY
+from ..metrics.registry import REGISTRY, _label_key
 from ..state.cluster import Cluster
 from ..utils.clock import Clock
 from .manager import Controller, Result
@@ -66,6 +66,14 @@ class PodMetrics(Controller):
             counts[k] = counts.get(k, 0) + 1
         for (phase, scheduled), n in counts.items():
             POD_STATE.set(n, {"phase": phase, "scheduled": scheduled})
+        # combos that emptied out are deleted, not left at their last value
+        # (metrics/pod suite: the state metric disappears with the pod).
+        # Stale keys come from the GAUGE's own recorded series, not per-
+        # instance memory — a rebuilt controller must also clear series a
+        # previous instance left on the shared registry object.
+        live = {_label_key({"phase": p, "scheduled": s}) for p, s in counts}
+        for key in [k for k in POD_STATE._values if k not in live]:
+            POD_STATE._values.pop(key, None)
 
 
 class NodeMetrics(Controller):

@@ -1,0 +1,119 @@
+"""Pod/node metrics exporters (reference: pkg/controllers/metrics/pod/
+suite_test.go + node exporter shapes): the state gauge follows phase and
+binding transitions, bound-duration observes once per pod, and combos that
+empty out are deleted rather than frozen at their last value."""
+
+import pytest
+
+from karpenter_tpu.api.objects import Node
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.metrics_exporters import (NODE_ALLOCATABLE,
+                                                         POD_BOUND_DURATION,
+                                                         POD_STATE,
+                                                         NodeMetrics,
+                                                         PodMetrics)
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_pod
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    mgr = Manager(store, clock)
+    pod_metrics = PodMetrics(store, cluster, clock)
+    mgr.register(pod_metrics, NodeMetrics(store, cluster))
+
+    class Env:
+        pass
+
+    e = Env()
+    e.clock, e.store, e.cluster, e.mgr = clock, store, cluster, mgr
+    e.pod_metrics = pod_metrics
+    return e
+
+
+class TestPodStateGauge:
+    def test_counts_by_phase_and_binding(self, env):
+        p1 = make_pod(name="a")
+        p2 = make_pod(name="b")
+        p2.status.phase = "Running"
+        p2.spec.node_name = "n1"
+        env.store.create(p1)
+        env.store.create(p2)
+        env.mgr.run_until_quiet()
+        assert POD_STATE.value({"phase": "Pending",
+                                "scheduled": "false"}) == 1
+        assert POD_STATE.value({"phase": "Running", "scheduled": "true"}) == 1
+
+    def test_state_combo_deleted_when_emptied(self, env):
+        """metrics/pod suite_test.go:368+: the state metric disappears with
+        the pod instead of freezing at its last value."""
+        pod = make_pod(name="only")
+        env.store.create(pod)
+        env.mgr.run_until_quiet()
+        assert POD_STATE.value({"phase": "Pending",
+                                "scheduled": "false"}) == 1
+        env.store.delete(pod)
+        # another pod event refreshes the gauge
+        other = make_pod(name="other")
+        other.status.phase = "Running"
+        other.spec.node_name = "n1"
+        env.store.create(other)
+        env.mgr.run_until_quiet()
+        assert POD_STATE.value({"phase": "Pending",
+                                "scheduled": "false"}) == 0
+
+    def test_phase_transition_moves_the_count(self, env):
+        pod = make_pod(name="mover")
+        env.store.create(pod)
+        env.mgr.run_until_quiet()
+        pod.status.phase = "Running"
+        pod.spec.node_name = "n1"
+        env.store.update(pod)
+        env.mgr.run_until_quiet()
+        assert POD_STATE.value({"phase": "Pending",
+                                "scheduled": "false"}) == 0
+        assert POD_STATE.value({"phase": "Running", "scheduled": "true"}) == 1
+
+
+class TestPodBoundDuration:
+    def test_bound_observed_once(self, env):
+        pod = make_pod(name="bindme")
+        env.store.create(pod)
+        env.mgr.run_until_quiet()
+        before = POD_BOUND_DURATION._counts.get((), 0) \
+            if hasattr(POD_BOUND_DURATION, "_counts") else None
+        env.clock.step(5)
+        pod.spec.node_name = "n1"
+        env.store.update(pod)
+        env.mgr.run_until_quiet()
+        env.store.update(pod)  # a second MODIFIED must not re-observe
+        env.mgr.run_until_quiet()
+        assert pod.uid in env.pod_metrics._bound_seen
+
+
+class TestNodeAllocatableGauge:
+    def test_node_allocatable_exported(self, env):
+        provider = KwokCloudProvider(store=env.store)
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.api import labels as api_labels
+        nc = NodeClaim(metadata=ObjectMeta(
+            name="m-1", namespace="",
+            labels={api_labels.NODEPOOL_LABEL_KEY: "default",
+                    api_labels.LABEL_INSTANCE_TYPE: "c-1x-amd64-linux"}))
+        provider.create(nc)
+        env.mgr.run_until_quiet()
+        [node] = env.store.list(Node)
+        labels = {"node_name": node.name, "nodepool": "default",
+                  "resource_type": "cpu"}
+        got = NODE_ALLOCATABLE.value(labels)
+        assert got == node.status.allocatable["cpu"]
