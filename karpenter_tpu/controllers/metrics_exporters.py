@@ -13,7 +13,7 @@ from typing import Optional
 from ..api import labels as api_labels
 from ..api.objects import Node, Pod
 from ..kube.store import Store
-from ..metrics.registry import REGISTRY, _label_key
+from ..metrics.registry import REGISTRY
 from ..state.cluster import Cluster
 from ..utils.clock import Clock
 from .manager import Controller, Result
@@ -67,13 +67,10 @@ class PodMetrics(Controller):
         for (phase, scheduled), n in counts.items():
             POD_STATE.set(n, {"phase": phase, "scheduled": scheduled})
         # combos that emptied out are deleted, not left at their last value
-        # (metrics/pod suite: the state metric disappears with the pod).
-        # Stale keys come from the GAUGE's own recorded series, not per-
-        # instance memory — a rebuilt controller must also clear series a
-        # previous instance left on the shared registry object.
-        live = {_label_key({"phase": p, "scheduled": s}) for p, s in counts}
-        for key in [k for k in POD_STATE._values if k not in live]:
-            POD_STATE._values.pop(key, None)
+        # (metrics/pod suite: the state metric disappears with the pod);
+        # pruning against the gauge's own series also clears leftovers from
+        # a previous controller instance on the shared registry object
+        POD_STATE.prune([{"phase": p, "scheduled": s} for p, s in counts])
 
 
 class NodeMetrics(Controller):
@@ -85,11 +82,20 @@ class NodeMetrics(Controller):
         self.cluster = cluster
 
     def reconcile(self, obj) -> Optional[Result]:
+        alloc_live: list = []
+        used_live: list = []
         for sn in self.cluster.state_nodes(deep_copy=False):
             labels = {"node_name": sn.name(),
                       "nodepool": sn.nodepool_name()}
             for rname, v in sn.allocatable().items():
-                NODE_ALLOCATABLE.set(v, {**labels, "resource_type": rname})
+                series = {**labels, "resource_type": rname}
+                NODE_ALLOCATABLE.set(v, series)
+                alloc_live.append(series)
             for rname, v in sn.pod_request_total().items():
-                NODE_USED.set(v, {**labels, "resource_type": rname})
+                series = {**labels, "resource_type": rname}
+                NODE_USED.set(v, series)
+                used_live.append(series)
+        # deleted/consolidated nodes' series go away with them
+        NODE_ALLOCATABLE.prune(alloc_live)
+        NODE_USED.prune(used_live)
         return None

@@ -51,6 +51,14 @@ class Gauge(Metric):
     def delete(self, labels: Optional[dict] = None) -> None:
         self._values.pop(_label_key(labels or {}), None)
 
+    def prune(self, live: "list[dict]") -> None:
+        """Drop every series not in `live` — exporters that mirror object
+        state call this so deleted objects' series disappear instead of
+        freezing at their last value (and cardinality stays bounded)."""
+        keep = {_label_key(d) for d in live}
+        for k in [k for k in self._values if k not in keep]:
+            del self._values[k]
+
     def value(self, labels: Optional[dict] = None) -> float:
         return self._values.get(_label_key(labels or {}), 0.0)
 

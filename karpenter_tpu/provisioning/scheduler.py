@@ -86,6 +86,17 @@ class InFlightNodeClaim:
         # reference's per-pod re-filter (nodeclaim.go:108-117) to a
         # fits-only pass on the deployment-stamped hot path.
         self._compat_cache: Optional[tuple] = None
+        # element-wise min allocatable across surviving instance types;
+        # invalidated whenever the survivor set changes
+        self._min_alloc: Optional[dict] = None
+
+    def _compute_min_alloc(self) -> dict:
+        its = self.instance_type_options
+        keys: set = set()
+        for it in its:
+            keys.update(it.allocatable())
+        return {k: min(it.allocatable().get(k, 0) for it in its)
+                for k in keys}
 
     def add(self, pod: Pod, pod_requests: dict,
             pod_reqs: Optional[Requirements] = None,
@@ -126,8 +137,21 @@ class InFlightNodeClaim:
         if cacheable and self._compat_cache is not None \
                 and self._compat_cache[0] == sig:
             ok = self._compat_cache[1]
-            fast = [it for it in self.instance_type_options
-                    if id(it) in ok and res.fits(requests, it.allocatable())]
+            # requests only grow: if they fit the element-wise MINIMUM
+            # allocatable across survivors, no type can drop out — skip the
+            # per-type scan (the hot loop at 50k identical pods). Only
+            # meaningful when every survivor is signature-compatible; the
+            # min is computed lazily there so the disabled regime pays zero
+            fast = None
+            if len(ok) == len(self.instance_type_options):
+                if self._min_alloc is None:
+                    self._min_alloc = self._compute_min_alloc()
+                if res.fits(requests, self._min_alloc):
+                    fast = self.instance_type_options
+            if fast is None:
+                fast = [it for it in self.instance_type_options
+                        if id(it) in ok
+                        and res.fits(requests, it.allocatable())]
             if fast and nodeclaim_requirements.has_min_values():
                 _, err = satisfies_min_values(fast, nodeclaim_requirements)
                 if err is not None:
@@ -154,7 +178,11 @@ class InFlightNodeClaim:
             self._compat_cache = None
 
         self.pods.append(pod)
-        self.instance_type_options = remaining
+        if len(remaining) != len(self.instance_type_options):
+            # filters only REMOVE: equal length means identical contents,
+            # so the cached element-wise min stays valid
+            self.instance_type_options = remaining
+            self._min_alloc = None
         self.requests = requests
         self.requirements = nodeclaim_requirements
         self.topology.record(pod, nodeclaim_requirements, ALLOW_UNDEFINED_WELL_KNOWN)
@@ -174,6 +202,7 @@ class InFlightNodeClaim:
         self.instance_type_options = [
             it for it in self.instance_type_options
             if it.offerings.available().worst_launch_price(reqs) < max_price]
+        self._min_alloc = None
         _, err = satisfies_min_values(self.instance_type_options, reqs)
         if err is not None:
             return None, err

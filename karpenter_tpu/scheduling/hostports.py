@@ -36,15 +36,19 @@ def get_host_ports(pod: Pod) -> "list[_Entry]":
 
 
 class HostPortUsage:
-    __slots__ = ("_entries",)
+    """Bucketed by (port, protocol): a conflict requires both to match, so
+    each candidate port only scans its own bucket — the flat-list scan was
+    the host oracle's hottest loop at 50k host-port pods."""
+
+    __slots__ = ("_by_port",)
 
     def __init__(self):
-        self._entries: List[_Entry] = []
+        self._by_port: "dict[tuple[int, str], List[_Entry]]" = {}
 
     def conflicts(self, pod: Pod, ports: "list[_Entry]") -> "list[str]":
         errs = []
         for p in ports:
-            for existing in self._entries:
+            for existing in self._by_port.get((p.port, p.protocol), ()):
                 # a pod never conflicts with its own tracked ports
                 # (hostportusage.go Conflicts:75-86)
                 if existing.pod_uid != pod.uid and p.conflicts(existing):
@@ -53,12 +57,18 @@ class HostPortUsage:
         return errs
 
     def add(self, pod: Pod, ports: "list[_Entry]") -> None:
-        self._entries.extend(ports)
+        for p in ports:
+            self._by_port.setdefault((p.port, p.protocol), []).append(p)
 
     def delete_pod(self, pod_uid: str) -> None:
-        self._entries = [e for e in self._entries if e.pod_uid != pod_uid]
+        for key in list(self._by_port):
+            kept = [e for e in self._by_port[key] if e.pod_uid != pod_uid]
+            if kept:
+                self._by_port[key] = kept
+            else:
+                del self._by_port[key]
 
     def copy(self) -> "HostPortUsage":
         out = HostPortUsage()
-        out._entries = list(self._entries)
+        out._by_port = {k: list(v) for k, v in self._by_port.items()}
         return out

@@ -89,15 +89,14 @@ class TestPodBoundDuration:
         pod = make_pod(name="bindme")
         env.store.create(pod)
         env.mgr.run_until_quiet()
-        before = POD_BOUND_DURATION._counts.get((), 0) \
-            if hasattr(POD_BOUND_DURATION, "_counts") else None
+        before = POD_BOUND_DURATION.count()
         env.clock.step(5)
         pod.spec.node_name = "n1"
         env.store.update(pod)
         env.mgr.run_until_quiet()
         env.store.update(pod)  # a second MODIFIED must not re-observe
         env.mgr.run_until_quiet()
-        assert pod.uid in env.pod_metrics._bound_seen
+        assert POD_BOUND_DURATION.count() == before + 1
 
 
 class TestNodeAllocatableGauge:
