@@ -370,3 +370,149 @@ class TestManagerTimerDedup:
         # bounded: at most one live + one deferred timer per object, ever
         assert len(mgr._timer_pending) <= 1
         assert len(mgr._timer_deferred) <= 1
+
+
+class TestExpirationSuite:
+    """expiration/suite_test.go:149-199."""
+
+    def _env(self):
+        from karpenter_tpu.controllers.nodeclaim_aux import Expiration
+        from karpenter_tpu.kube.store import Store
+        clock = FakeClock()
+        store = Store(clock)
+        return store, clock, Expiration(store, clock)
+
+    def _claim(self, store, expire_after):
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        nc = NodeClaim(metadata=ObjectMeta(name="exp-1", namespace=""))
+        nc.spec.expire_after = expire_after
+        store.create(nc)
+        return nc
+
+    def test_disabled_expiration_never_removes(self):
+        store, clock, ctrl = self._env()
+        nc = self._claim(store, None)  # Never
+        clock.step(10**6)
+        assert ctrl.reconcile(nc) is None
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        assert store.get(NodeClaim, "exp-1", "") is not None
+
+    def test_non_expired_claim_kept_with_requeue_at_expiry(self):
+        store, clock, ctrl = self._env()
+        nc = self._claim(store, 300.0)
+        clock.step(100)
+        result = ctrl.reconcile(nc)
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        assert store.get(NodeClaim, "exp-1", "") is not None
+        # requeue lands exactly at the remaining lifetime
+        assert result is not None and abs(result.requeue_after - 200.0) < 1.0
+
+    def test_expired_claim_deleted(self):
+        store, clock, ctrl = self._env()
+        nc = self._claim(store, 300.0)
+        clock.step(301)
+        ctrl.reconcile(nc)
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        assert store.get(NodeClaim, "exp-1", "") is None
+
+    def test_already_deleting_claim_not_expired_again(self):
+        """expiration/suite_test.go:181-199."""
+        store, clock, ctrl = self._env()
+        nc = self._claim(store, 300.0)
+        nc.metadata.finalizers.append("karpenter.sh/termination")
+        clock.step(301)
+        ctrl.reconcile(nc)   # starts deletion (finalizer holds the object)
+        assert nc.metadata.deletion_timestamp is not None
+        stamped = nc.metadata.deletion_timestamp
+        clock.step(50)
+        assert ctrl.reconcile(nc) is None  # no re-delete / no restamp
+        assert nc.metadata.deletion_timestamp == stamped
+
+
+class TestGarbageCollectionSuite:
+    """garbagecollection/suite_test.go: both sweep directions."""
+
+    def _env(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.nodeclaim_aux import GarbageCollection
+        from karpenter_tpu.kube.store import Store
+        clock = FakeClock()
+        store = Store(clock)
+        provider = KwokCloudProvider(store=store)
+        return store, clock, provider, GarbageCollection(store, provider, clock)
+
+    def test_claim_with_vanished_instance_deleted(self):
+        from karpenter_tpu.api import labels as api_labels
+        from karpenter_tpu.api.nodeclaim import COND_LAUNCHED, NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        store, clock, provider, gc_ctrl = self._env()
+        nc = NodeClaim(metadata=ObjectMeta(
+            name="gc-1", namespace="",
+            labels={api_labels.LABEL_INSTANCE_TYPE: "c-1x-amd64-linux"}))
+        provider.create(nc)
+        nc.conditions.set_true(COND_LAUNCHED, reason="Launched")
+        store.create(nc)
+        # instance vanishes out from under the claim (manual console delete)
+        del provider.created[nc.status.provider_id]
+        gc_ctrl.reconcile()
+        assert store.get(NodeClaim, "gc-1", "") is None
+
+    def test_untracked_instance_reaped(self):
+        from karpenter_tpu.api import labels as api_labels
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        store, clock, provider, gc_ctrl = self._env()
+        ghost = NodeClaim(metadata=ObjectMeta(
+            name="ghost", namespace="",
+            labels={api_labels.LABEL_INSTANCE_TYPE: "c-1x-amd64-linux"}))
+        provider.create(ghost)  # instance exists, claim never stored
+        assert len(provider.list()) == 1
+        gc_ctrl.reconcile()
+        assert provider.list() == []
+
+    def test_matched_pairs_left_alone(self):
+        from karpenter_tpu.api import labels as api_labels
+        from karpenter_tpu.api.nodeclaim import COND_LAUNCHED, NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        store, clock, provider, gc_ctrl = self._env()
+        nc = NodeClaim(metadata=ObjectMeta(
+            name="ok-1", namespace="",
+            labels={api_labels.LABEL_INSTANCE_TYPE: "c-1x-amd64-linux"}))
+        provider.create(nc)
+        nc.conditions.set_true(COND_LAUNCHED, reason="Launched")
+        store.create(nc)
+        gc_ctrl.reconcile()
+        assert store.get(NodeClaim, "ok-1", "") is not None
+        assert len(provider.list()) == 1
+
+
+class TestPodEventsSuite:
+    """podevents/controller.go:63-98: lastPodEventTime with 5 s dedupe."""
+
+    def test_pod_event_stamps_with_dedupe(self):
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.controllers.nodeclaim_aux import PodEvents
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.state.cluster import Cluster
+        clock = FakeClock()
+        store = Store(clock)
+        cluster = Cluster(store, clock)
+        ctrl = PodEvents(store, cluster, clock)
+        nc = NodeClaim(metadata=ObjectMeta(name="pe-1", namespace=""))
+        nc.status.node_name = "n1"
+        store.create(nc)
+        pod = make_pod()
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        clock.step(10)
+        ctrl.reconcile(pod)
+        t1 = nc.status.last_pod_event_time
+        assert t1 == clock.now()
+        clock.step(2)  # inside the dedupe window
+        ctrl.reconcile(pod)
+        assert nc.status.last_pod_event_time == t1
+        clock.step(4)  # past it
+        ctrl.reconcile(pod)
+        assert nc.status.last_pod_event_time == clock.now()
