@@ -197,3 +197,75 @@ def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensor
         zone_adm=t.zone_adm[:G],
         exist_ok=t.exist_ok[:G],
         exist_cap=t.exist_cap[:G])
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> int:
+    """Join a multi-host solver fleet via JAX's distributed runtime, the
+    analog of the reference's NCCL/MPI bootstrap (SURVEY §5 distributed
+    backend). Idempotent; returns the process count.
+
+    Each host contributes its local chips to the global device set;
+    `make_solver_mesh()` then builds the (groups × catalog) mesh over
+    `jax.devices()` — which, after initialization, spans every host — and
+    GSPMD partitions the feasibility precompute across them. The kernel
+    has no cross-shard contractions, so the only DCN traffic is the result
+    gather (one packed-bitfield fetch per solve; see sharded_precompute).
+
+    Parameters default to the standard JAX env bootstrap
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID or the
+    cloud-TPU metadata server). Call before any other JAX API; single-host
+    runs skip the distributed service entirely."""
+    import os
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    # NOTE: deliberately no TPU_WORKER_HOSTNAMES sniffing — single-host TPU
+    # plugins set it too; multi-host intent must be explicit
+    bootstrap_available = (coordinator_address is not None
+                           or num_processes is not None
+                           or "JAX_COORDINATOR_ADDRESS" in os.environ)
+    if num_processes == 1 or not bootstrap_available:
+        return 1  # explicitly (or evidently) single host: no service needed
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is None or not already():
+        # None values pass through so jax can auto-detect from its own
+        # bootstrap sources (env vars / cloud-TPU metadata)
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    return jax.process_count()
+
+
+def local_result_slice(mesh: Mesh, n_groups: int,
+                       process_index: Optional[int] = None
+                       ) -> "list[Tuple[int, int]]":
+    """The [start, stop) group-row spans this process computed — multi-host
+    callers that shard the DOWNSTREAM packing per host use these to skip
+    fetching rows another host owns (the gather at sharded_precompute
+    otherwise pulls the full result to every host).
+
+    Returns a list of contiguous spans: mesh_utils.create_device_mesh may
+    reorder devices for topology, so one process's groups-axis rows need
+    not be contiguous — collapsing them to a single [min, max) range would
+    overlap other hosts' slices and double-pack their groups."""
+    if process_index is None:
+        process_index = jax.process_index()
+    n_shards = mesh.shape[GROUPS_AXIS]
+    per = math.ceil(n_groups / n_shards)
+    local_rows = sorted(
+        {idx[0] for idx, dev in np.ndenumerate(mesh.devices)
+         if dev.process_index == process_index})
+    spans: "list[Tuple[int, int]]" = []
+    for row in local_rows:
+        start = row * per
+        stop = min((row + 1) * per, n_groups)
+        if start >= stop:
+            continue
+        if spans and spans[-1][1] == start:
+            spans[-1] = (spans[-1][0], stop)  # merge adjacent rows
+        else:
+            spans.append((start, stop))
+    return spans

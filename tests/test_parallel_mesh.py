@@ -103,3 +103,44 @@ def test_disjoint_limit_resources_across_pools():
     # pool-a is first in weight order and has plenty of cpu limit left
     pools = {nc.template.nodepool_name for nc in results.new_nodeclaims}
     assert "pool-a" in pools
+
+
+class TestMultihostHelpers:
+    def test_init_multihost_single_host_noop(self):
+        from karpenter_tpu.parallel.mesh import init_multihost
+        assert init_multihost() == 1  # no coordinator: plain single host
+
+    def test_local_result_slice_covers_all_groups_single_process(self):
+        from karpenter_tpu.parallel.mesh import (local_result_slice,
+                                                 make_solver_mesh)
+        mesh = make_solver_mesh(8)
+        spans = local_result_slice(mesh, 101)
+        # one process owns every shard: one span covering the whole range
+        assert spans == [(0, 101)]
+
+    def test_local_result_slice_partitions_across_processes(self):
+        """A fake 2-process mesh with INTERLEAVED row ownership: each
+        process's spans must be disjoint, non-overlapping, and jointly
+        cover every group exactly once."""
+        from types import SimpleNamespace
+        import numpy as np
+        from karpenter_tpu.parallel.mesh import (CATALOG_AXIS, GROUPS_AXIS,
+                                                 local_result_slice)
+
+        def dev(pidx):
+            return SimpleNamespace(process_index=pidx)
+
+        # rows 0,2 -> process 0; rows 1,3 -> process 1 (topology reorder)
+        devices = np.array([[dev(0), dev(0)], [dev(1), dev(1)],
+                            [dev(0), dev(0)], [dev(1), dev(1)]])
+        mesh = SimpleNamespace(shape={GROUPS_AXIS: 4, CATALOG_AXIS: 2},
+                               devices=devices)
+        s0 = local_result_slice(mesh, 101, process_index=0)
+        s1 = local_result_slice(mesh, 101, process_index=1)
+        rows0 = {g for a, b in s0 for g in range(a, b)}
+        rows1 = {g for a, b in s1 for g in range(a, b)}
+        assert rows0 and rows1
+        assert not (rows0 & rows1)          # disjoint: no double-packing
+        assert rows0 | rows1 == set(range(101))  # complete coverage
+        # interleaving produced more than one span for process 0
+        assert len(s0) == 2
