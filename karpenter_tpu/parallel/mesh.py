@@ -165,7 +165,20 @@ _SHARDED_CACHE_MAX = 16
 
 def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensors:
     """precompute() over a device mesh: pads to the mesh grid, shards inputs,
-    runs the same kernel under GSPMD, gathers + un-pads the result."""
+    runs the same kernel under GSPMD, gathers + un-pads the result.
+
+    Support boundary: single-process meshes (any number of local devices).
+    A mesh spanning multiple processes needs its inputs distributed with
+    jax.make_array_from_process_local_data and its outputs fetched as
+    per-process local shards (local_result_slice gives the row spans) —
+    explicit guard below rather than a cryptic crash inside jit."""
+    if any(d.process_index != jax.process_index()
+           for d in mesh.devices.flat):
+        raise NotImplementedError(
+            "sharded_precompute currently supports single-process meshes; "
+            "for a multi-host fleet, distribute inputs with "
+            "jax.make_array_from_process_local_data and fetch each host's "
+            "rows per local_result_slice()")
     g_mult, t_mult = mesh.shape[GROUPS_AXIS], mesh.shape[CATALOG_AXIS]
     padded, G, T = pad_problem(p, g_mult, t_mult)
     args, statics = binpack.device_args(padded)
@@ -201,7 +214,8 @@ def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensor
 
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> int:
+                   process_id: Optional[int] = None,
+                   auto: bool = False) -> int:
     """Join a multi-host solver fleet via JAX's distributed runtime, the
     analog of the reference's NCCL/MPI bootstrap (SURVEY §5 distributed
     backend). Idempotent; returns the process count.
@@ -222,9 +236,13 @@ def init_multihost(coordinator_address: Optional[str] = None,
     if num_processes is None and env_np is not None:
         num_processes = int(env_np)
     # NOTE: deliberately no TPU_WORKER_HOSTNAMES sniffing — single-host TPU
-    # plugins set it too; multi-host intent must be explicit
-    bootstrap_available = (coordinator_address is not None
+    # plugins set it too; multi-host intent must be explicit. On a cloud-TPU
+    # pod slice where the coordinator comes from the metadata server (no env
+    # vars at all), pass auto=True to hand bootstrap entirely to JAX.
+    bootstrap_available = (auto
+                           or coordinator_address is not None
                            or num_processes is not None
+                           or process_id is not None
                            or "JAX_COORDINATOR_ADDRESS" in os.environ)
     if num_processes == 1 or not bootstrap_available:
         return 1  # explicitly (or evidently) single host: no service needed

@@ -516,3 +516,37 @@ class TestPodEventsSuite:
         clock.step(4)  # past it
         ctrl.reconcile(pod)
         assert nc.status.last_pod_event_time == clock.now()
+
+
+class TestHydration:
+    """nodeclaim/node hydration: objects from older versions get current
+    invariant fields backfilled."""
+
+    def test_nodeclaim_hydrated_with_pool_label_and_finalizer(self):
+        from karpenter_tpu.api.objects import ObjectMeta, OwnerReference
+        from karpenter_tpu.controllers.hydration import NodeClaimHydration
+        from karpenter_tpu.kube.store import Store
+        store = Store(FakeClock())
+        nc = NodeClaim(metadata=ObjectMeta(
+            name="old-nc", namespace="",
+            owner_refs=[OwnerReference(kind="NodePool", name="default")]))
+        nc.metadata.finalizers.clear()
+        store.create(nc)
+        NodeClaimHydration(store).reconcile(nc)
+        assert nc.metadata.labels[api_labels.NODEPOOL_LABEL_KEY] == "default"
+        assert api_labels.TERMINATION_FINALIZER in nc.metadata.finalizers
+
+    def test_hydration_idempotent(self):
+        from karpenter_tpu.api.objects import ObjectMeta, OwnerReference
+        from karpenter_tpu.controllers.hydration import NodeClaimHydration
+        from karpenter_tpu.kube.store import Store
+        store = Store(FakeClock())
+        nc = NodeClaim(metadata=ObjectMeta(
+            name="old-nc", namespace="",
+            owner_refs=[OwnerReference(kind="NodePool", name="default")]))
+        store.create(nc)
+        h = NodeClaimHydration(store)
+        h.reconcile(nc)
+        rv = nc.metadata.resource_version
+        h.reconcile(nc)  # second pass: nothing to backfill, no write
+        assert nc.metadata.resource_version == rv

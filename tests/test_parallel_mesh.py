@@ -106,8 +106,12 @@ def test_disjoint_limit_resources_across_pools():
 
 
 class TestMultihostHelpers:
-    def test_init_multihost_single_host_noop(self):
+    def test_init_multihost_single_host_noop(self, monkeypatch):
         from karpenter_tpu.parallel.mesh import init_multihost
+        # isolate from ambient multi-host bootstrap env (TPU CI images)
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
         assert init_multihost() == 1  # no coordinator: plain single host
 
     def test_local_result_slice_covers_all_groups_single_process(self):
