@@ -109,3 +109,50 @@ class TestConcurrentSolves:
             assert got and all(g == want for g in got)
         finally:
             server.stop(0)
+
+
+class TestNoGcGuard:
+    def test_nested_and_threaded_sections_restore_gc(self):
+        """no_gc() must be reentrant and thread-safe: the collector resumes
+        only when the LAST overlapping section exits, and the outer state is
+        restored exactly."""
+        import gc
+        import threading
+        from karpenter_tpu.utils.gcpause import no_gc
+        assert gc.isenabled()
+        with no_gc():
+            assert not gc.isenabled()
+            with no_gc():  # reentrant
+                assert not gc.isenabled()
+            assert not gc.isenabled()  # still inside the outer section
+        assert gc.isenabled()
+
+        barrier = threading.Barrier(4)
+        states = []
+
+        def worker():
+            with no_gc():
+                barrier.wait()
+                states.append(gc.isenabled())
+                barrier.wait()
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert states == [False] * 4
+        assert gc.isenabled()  # restored after the last section exits
+
+    def test_no_gc_noop_when_already_disabled(self):
+        """Inside the sidecar server (GC disabled process-wide) the guard
+        must not re-enable collection on exit."""
+        import gc
+        from karpenter_tpu.utils.gcpause import no_gc
+        gc.disable()
+        try:
+            with no_gc():
+                assert not gc.isenabled()
+            assert not gc.isenabled()  # stays off: we didn't turn it off
+        finally:
+            gc.enable()
