@@ -119,7 +119,7 @@ class TestNoGcGuard:
         import gc
         import threading
         from karpenter_tpu.utils.gcpause import no_gc
-        assert gc.isenabled()
+        gc.enable()  # establish the precondition (test-order independence)
         with no_gc():
             assert not gc.isenabled()
             with no_gc():  # reentrant
@@ -127,21 +127,30 @@ class TestNoGcGuard:
             assert not gc.isenabled()  # still inside the outer section
         assert gc.isenabled()
 
-        barrier = threading.Barrier(4)
-        states = []
+        # staggered exits: thread 0 leaves its section FIRST while the
+        # others are still inside — GC must stay off until the last exit
+        inside = threading.Barrier(4, timeout=30)
+        t0_exited = threading.Event()
+        mid_states = []
 
-        def worker():
+        def worker(i):
             with no_gc():
-                barrier.wait()
-                states.append(gc.isenabled())
-                barrier.wait()
+                inside.wait()
+                if i != 0:
+                    assert t0_exited.wait(timeout=30)
+                    mid_states.append(gc.isenabled())
+            if i == 0:
+                t0_exited.set()
 
-        threads = [threading.Thread(target=worker) for _ in range(4)]
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
-        assert states == [False] * 4
+            t.join(timeout=60)
+            assert not t.is_alive()
+        # after thread 0 exited, the remaining sections still held GC off
+        assert mid_states == [False] * 3
         assert gc.isenabled()  # restored after the last section exits
 
     def test_no_gc_noop_when_already_disabled(self):
