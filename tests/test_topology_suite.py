@@ -443,3 +443,54 @@ class TestNodePoolTaints:
         pool = make_nodepool(startup_taints=[Taint(key="init", value="x")])
         h = hsolve(make_pods(2), pools=[pool])
         assert not h.pod_errors
+
+
+class TestNodePoolRequirementSpread:
+    """topology_test.go:967-1042: a custom topology key whose domains are
+    DEFINED by two pools' requirements — spread must balance across pools."""
+
+    def test_balance_across_nodepool_requirement_domains(self):
+        pool_a = make_nodepool(name="pool-a", requirements=[
+            NodeSelectorRequirement("example.com/shard", "In", ("s1",))])
+        pool_b = make_nodepool(name="pool-b", requirements=[
+            NodeSelectorRequirement("example.com/shard", "In", ("s2",))])
+        pods = make_pods(8, cpu="500m", labels={"app": "demo"},
+                         spread=[tsc(key="example.com/shard")])
+        r = hsolve(pods, pools=[pool_a, pool_b])
+        assert not r.pod_errors
+        counts = domain_fill(r, "example.com/shard")
+        assert set(counts) == {"s1", "s2"}
+        assert abs(counts["s1"] - counts["s2"]) <= 1
+
+    def test_schedule_anyway_violates_capacity_type_skew(self):
+        """topology_test.go:702-732: a REAL violation — one matching pod
+        already runs on spot, the pool now only offers on-demand, so every
+        new pod widens the skew; ScheduleAnyway lands them regardless."""
+        existing = running_on(make_pods(1, labels={"app": "demo"}),
+                              "node-spot")
+        view = StaticClusterView(existing, {
+            "node-spot": {CT: api_labels.CAPACITY_TYPE_SPOT,
+                          HOST: "node-spot"}})
+        pool = make_nodepool(name="default", requirements=[
+            NodeSelectorRequirement(CT, "In",
+                                    (api_labels.CAPACITY_TYPE_ON_DEMAND,))])
+        def pods():
+            return make_pods(5, cpu="500m", labels={"app": "demo"},
+                             spread=[tsc(key=CT, anyway=True)])
+        r = hsolve(pods(), pools=[pool])  # without the view: trivially fine
+        assert not r.pod_errors
+        r = hsolve(pods(), pools=[pool], view=view)
+        # skew ends at (spot=1, on-demand=5): violated, but ScheduleAnyway
+        assert not r.pod_errors
+        assert domain_fill(r, CT)[api_labels.CAPACITY_TYPE_ON_DEMAND] == 5
+
+    def test_do_not_schedule_ignores_unreachable_capacity_type_domain(self):
+        """A spot-only pool makes the on-demand domain unreachable: skew is
+        computed within the reachable domain alone, so nothing blocks."""
+        pool = make_nodepool(name="default", requirements=[
+            NodeSelectorRequirement(CT, "In",
+                                    (api_labels.CAPACITY_TYPE_SPOT,))])
+        pods = make_pods(6, cpu="500m", labels={"app": "demo"},
+                         spread=[tsc(key=CT, max_skew=1)])
+        r = hsolve(pods, pools=[pool])
+        assert not r.pod_errors
