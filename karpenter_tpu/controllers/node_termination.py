@@ -106,6 +106,7 @@ class NodeTermination(Controller):
                 log.info("instance already terminated; releasing node",
                          node=node.name)
                 self._release_pods(node)
+                self._record_terminated(node)
                 self.store.remove_finalizer(
                     node, api_labels.TERMINATION_FINALIZER)
                 return None
@@ -125,8 +126,24 @@ class NodeTermination(Controller):
                           volume_attachments=attached)
                 return Result(requeue_after=1.0)
         log.info("terminated node", node=node.name)
+        self._record_terminated(node)
         self.store.remove_finalizer(node, api_labels.TERMINATION_FINALIZER)
         return None
+
+    def _record_terminated(self, node: Node) -> None:
+        """termination/metrics.go:30-62: counter + drain-duration summary +
+        node-lifetime histogram, all by nodepool."""
+        from ..metrics import registry as metrics
+        labels = {"nodepool": node.metadata.labels.get(
+            api_labels.NODEPOOL_LABEL_KEY, "")}
+        now = self.clock.now()
+        metrics.NODES_TERMINATED.inc(labels)
+        if node.metadata.deletion_timestamp is not None:
+            metrics.NODE_TERMINATION_DURATION.observe(
+                max(0.0, now - node.metadata.deletion_timestamp), labels)
+        if node.metadata.creation_timestamp:
+            metrics.NODE_LIFETIME_DURATION.observe(
+                max(0.0, now - node.metadata.creation_timestamp), labels)
 
     def _annotate_termination_time(self, node: Node, nc) -> None:
         """controller.go: stamp the hard deadline from the claim's

@@ -167,6 +167,8 @@ class NodeClaimLifecycle(Controller):
         nc.conditions.set_true(COND_REGISTERED, reason="Registered",
                                now=self.clock.now())
         self.store.update(nc)
+        from ..metrics import registry as metrics
+        metrics.NODES_CREATED.inc({"nodepool": nc.nodepool_name})
 
     # -- initialization -----------------------------------------------------
 
@@ -222,5 +224,7 @@ class NodeClaimLifecycle(Controller):
             self.cloud_provider.delete(nc)
         except NodeClaimNotFoundError:
             pass
+        from ..metrics import registry as metrics
+        metrics.NODECLAIMS_TERMINATED.inc({"nodepool": nc.nodepool_name})
         self.store.remove_finalizer(nc, api_labels.TERMINATION_FINALIZER)
         return None

@@ -184,6 +184,16 @@ NODES_CREATED = REGISTRY.counter(
 NODES_TERMINATED = REGISTRY.counter(
     "karpenter_nodes_terminated_total", "Number of nodes terminated",
     ("nodepool",))
+NODE_TERMINATION_DURATION = REGISTRY.histogram(
+    "karpenter_nodes_termination_duration_seconds",
+    "Deletion-timestamp to finalizer removal (drain + detach + instance)",
+    ("nodepool",),
+    buckets=(1, 5, 10, 30, 60, 120, 300, 600, 1800, 3600))
+NODE_LIFETIME_DURATION = REGISTRY.histogram(
+    "karpenter_nodes_lifetime_duration_seconds",
+    "Node creation to termination",
+    ("nodepool",),
+    buckets=(60, 300, 1800, 3600, 6 * 3600, 24 * 3600, 7 * 24 * 3600))
 PODS_STARTUP_DURATION = REGISTRY.histogram(
     "karpenter_pods_startup_duration_seconds",
     "Time from pod creation to running")

@@ -116,3 +116,43 @@ class TestNodeAllocatableGauge:
                   "resource_type": "cpu"}
         got = NODE_ALLOCATABLE.value(labels)
         assert got == node.status.allocatable["cpu"]
+
+
+class TestTerminationMetrics:
+    """node/termination/suite_test.go:840-877: terminated counters, the
+    termination-duration summary, and the lifetime histogram fire with the
+    nodepool label when a node finalizes."""
+
+    def test_termination_metrics_fire_on_finalize(self):
+        from karpenter_tpu.api.objects import Node as NodeKind
+        from karpenter_tpu.metrics.registry import (NODE_LIFETIME_DURATION,
+                                                    NODE_TERMINATION_DURATION,
+                                                    NODECLAIMS_TERMINATED,
+                                                    NODES_CREATED,
+                                                    NODES_TERMINATED)
+        from karpenter_tpu.operator.operator import Operator
+        from test_operator import settle
+        op = Operator(clock=FakeClock())
+        labels = {"nodepool": "default"}
+        created0 = NODES_CREATED.value(labels)
+        term0 = NODES_TERMINATED.value(labels)
+        nct0 = NODECLAIMS_TERMINATED.value(labels)
+        dur0 = NODE_TERMINATION_DURATION.count(labels)
+        life0 = NODE_LIFETIME_DURATION.count(labels)
+        op.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        op.store.create(pod)
+        settle(op)
+        assert NODES_CREATED.value(labels) == created0 + 1
+        op.store.delete(pod)
+        [node] = op.store.list(NodeKind)
+        op.clock.step(120)
+        op.store.delete(node)
+        settle(op)
+        assert op.store.get(NodeKind, node.name) is None
+        assert NODES_TERMINATED.value(labels) == term0 + 1
+        assert NODECLAIMS_TERMINATED.value(labels) == nct0 + 1
+        assert NODE_TERMINATION_DURATION.count(labels) == dur0 + 1
+        assert NODE_LIFETIME_DURATION.count(labels) == life0 + 1
+        # the lifetime observation reflects the node's ~120 s of life
+        assert NODE_LIFETIME_DURATION.sum(labels) >= 100
