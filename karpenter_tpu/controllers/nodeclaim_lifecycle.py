@@ -53,6 +53,11 @@ class NodeClaimLifecycle(Controller):
         self.registration_ttl = registration_ttl
 
     def reconcile(self, nc: NodeClaim) -> Optional[Result]:
+        if self.store.get(NodeClaim, nc.metadata.name,
+                          nc.metadata.namespace) is None:
+            # already fully deleted (finalizer dropped); the manager hands us
+            # the stale event snapshot — controller-runtime's NotFound->ignore
+            return None
         if nc.metadata.deletion_timestamp is not None:
             return self._finalize(nc)
         if api_labels.TERMINATION_FINALIZER not in nc.metadata.finalizers:

@@ -18,7 +18,7 @@ from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.informers import wire_informers
 from karpenter_tpu.utils.clock import FakeClock
 
-from factories import make_pod
+from factories import make_nodepool, make_pod
 
 
 @pytest.fixture
