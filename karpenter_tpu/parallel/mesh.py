@@ -163,42 +163,76 @@ _sharded_cache: OrderedDict = OrderedDict()
 _SHARDED_CACHE_MAX = 16
 
 
-def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensors:
-    """precompute() over a device mesh: pads to the mesh grid, shards inputs,
-    runs the same kernel under GSPMD, gathers + un-pads the result.
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh's devices span more than this process."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
 
-    Support boundary: single-process meshes (any number of local devices).
-    A mesh spanning multiple processes needs its inputs distributed with
-    jax.make_array_from_process_local_data and its outputs fetched as
-    per-process local shards (local_result_slice gives the row spans) —
-    explicit guard below rather than a cryptic crash inside jit."""
-    if any(d.process_index != jax.process_index()
-           for d in mesh.devices.flat):
-        raise NotImplementedError(
-            "sharded_precompute currently supports single-process meshes; "
-            "for a multi-host fleet, distribute inputs with "
-            "jax.make_array_from_process_local_data and fetch each host's "
-            "rows per local_result_slice()")
+
+def _to_global(arr, sharding: NamedSharding):
+    """Lift a fully-replicated host copy into a global sharded jax.Array.
+
+    Multi-host contract (SURVEY §5 distributed backend): every process holds
+    the SAME problem — the cluster store is replicated, exactly like every
+    reference scheduler replica sees the same apiserver state — so each
+    process materializes only its addressable shards from its local copy.
+    """
+    host = np.asarray(arr)
+    return jax.make_array_from_process_local_data(
+        sharding, host, global_shape=host.shape)
+
+
+def _fetch_replicated(arr) -> np.ndarray:
+    """Host copy of a fully-replicated (P()) multi-process array: any local
+    shard holds the complete value."""
+    return np.asarray(arr.addressable_shards[0].data)
+
+
+def _assemble_local(arr) -> np.ndarray:
+    """Zeros-filled global-shape host buffer holding only this process's
+    shards (the caller restricts reads to local_result_slice() spans)."""
+    out = np.zeros(arr.shape, dtype=arr.dtype)
+    for shard in arr.addressable_shards:
+        out[shard.index] = np.asarray(shard.data)
+    return out
+
+
+def _run_sharded_kernel(p: binpack.PackProblem, mesh: Mesh, replicate_out: bool):
+    """Shared dispatch: pad to the mesh grid, shard inputs, run the kernel
+    under GSPMD. Returns (out_arrays, padded, G, T). In a multi-process mesh
+    the inputs are distributed via jax.make_array_from_process_local_data;
+    out_shardings stay sharded unless ``replicate_out``, in which case XLA
+    inserts one all-gather (ICI/DCN) inside the program so every process
+    holds the full result."""
+    multiproc = is_multiprocess(mesh)
     g_mult, t_mult = mesh.shape[GROUPS_AXIS], mesh.shape[CATALOG_AXIS]
     padded, G, T = pad_problem(p, g_mult, t_mult)
     args, statics = binpack.device_args(padded)
-    key = (mesh, tuple(sorted(statics.items())))
+    in_sh = _arg_shardings(mesh)
+    if multiproc:
+        args = jax.tree.map(_to_global, args, in_sh)
+    key = (mesh, replicate_out, tuple(sorted(statics.items())))
     fn = _sharded_cache.get(key)
     if fn is None:
         if len(_sharded_cache) >= _SHARDED_CACHE_MAX:
             # LRU single eviction (was: clear-all, a recompile storm when
             # two meshes alternate at the cap)
             _sharded_cache.popitem(last=False)
+        out_sh = (tuple(NamedSharding(mesh, P()) for _ in range(6))
+                  if replicate_out else _out_shardings(mesh))
         fn = jax.jit(
             lambda *a: binpack.precompute_kernel(*a, **statics),
-            in_shardings=_arg_shardings(mesh),
-            out_shardings=_out_shardings(mesh))
+            in_shardings=in_sh,
+            out_shardings=out_sh)
         _sharded_cache[key] = fn
     else:
         _sharded_cache.move_to_end(key)
-    out = fn(*args)
-    compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
-        jax.device_get(out)
+    return fn(*args), padded, G, T
+
+
+def _unpad_tensors(raw, padded: binpack.PackProblem, G: int, T: int
+                   ) -> binpack.PackTensors:
+    compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = raw
     t = binpack.unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,
                                exist_ok, exist_cap,
                                padded.zone_values.shape[0])
@@ -210,6 +244,64 @@ def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensor
         zone_adm=t.zone_adm[:G],
         exist_ok=t.exist_ok[:G],
         exist_cap=t.exist_cap[:G])
+
+
+def sharded_precompute(p: binpack.PackProblem, mesh: Mesh) -> binpack.PackTensors:
+    """precompute() over a device mesh: pads to the mesh grid, shards inputs,
+    runs the same kernel under GSPMD, gathers + un-pads the result.
+
+    Works for single-process meshes (any number of local devices) and for
+    meshes spanning multiple processes (a multi-host fleet joined via
+    init_multihost()). In the multi-process case every process receives the
+    FULL result — the downstream greedy pack (binpack.pack / the host
+    oracle) is deterministic over identical tensors, so every host arrives
+    at byte-identical launch decisions without any leader, the way the
+    reference's scheduler replicas converge through the shared apiserver.
+    The gather is a single XLA all-gather of the packed bitfields riding
+    ICI/DCN; callers that post-process per group-row instead can use
+    sharded_precompute_local() to skip it."""
+    multiproc = is_multiprocess(mesh)
+    out, padded, G, T = _run_sharded_kernel(p, mesh, replicate_out=multiproc)
+    if multiproc:
+        raw = tuple(_fetch_replicated(o) for o in out)
+    else:
+        raw = jax.device_get(out)
+    return _unpad_tensors(raw, padded, G, T)
+
+
+def sharded_precompute_local(p: binpack.PackProblem, mesh: Mesh
+                             ) -> "Tuple[binpack.PackTensors, list]":
+    """Multi-host bandwidth optimization: compute the sharded precompute and
+    fetch ONLY this process's group rows, skipping the cross-host result
+    gather entirely. Returns ``(tensors, spans)`` where ``spans`` is
+    local_result_slice()'s [start, stop) group-row list; tensor rows outside
+    the spans are zeros and must not be read.
+
+    Requires every local groups-axis row to be catalog-complete on this
+    process (true for make_solver_mesh() grids, where a process's devices
+    tile whole rows); raises ValueError otherwise rather than returning
+    rows with silent holes."""
+    multiproc = is_multiprocess(mesh)
+    if multiproc:
+        me = jax.process_index()
+        for r in range(mesh.devices.shape[0]):
+            row_procs = {d.process_index for d in mesh.devices[r]}
+            if me in row_procs and row_procs != {me}:
+                raise ValueError(
+                    f"groups-axis row {r} spans processes {sorted(row_procs)}; "
+                    "local fetch needs catalog-complete rows — use "
+                    "sharded_precompute() (replicated gather) instead")
+    out, padded, G, T = _run_sharded_kernel(p, mesh, replicate_out=False)
+    if multiproc:
+        raw = tuple(_assemble_local(o) for o in out)
+    else:
+        raw = jax.device_get(out)
+    tensors = _unpad_tensors(raw, padded, G, T)
+    Gp = padded.group_req.shape[0]
+    spans = [(start, min(stop, G))
+             for start, stop in local_result_slice(mesh, Gp)
+             if start < G]
+    return tensors, spans
 
 
 def init_multihost(coordinator_address: Optional[str] = None,
