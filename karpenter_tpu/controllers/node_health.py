@@ -25,11 +25,13 @@ class NodeHealth(Controller):
     kinds = (Node,)
 
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
 
     def reconcile(self, node: Node) -> Optional[Result]:
         if node.metadata.deletion_timestamp is not None:
@@ -49,13 +51,18 @@ class NodeHealth(Controller):
         elapsed = self.clock.now() - since
         if elapsed < policy.toleration_duration:
             return Result(requeue_after=policy.toleration_duration - elapsed)
-        if self._circuit_broken():
-            return Result(requeue_after=60.0)
-        # delete the backing claim (controller.go:121-126); bare nodes delete
-        # directly
         from ..api.nodeclaim import NodeClaim
         nc = next((c for c in self.store.list(NodeClaim)
                    if c.status.node_name == node.name), None)
+        if self._circuit_broken():
+            # controller.go:207-210: tell the operator WHY repair stalled
+            from ..events import catalog as events_catalog
+            self.recorder.publish(*events_catalog.node_repair_blocked(
+                node.name, nc.name if nc is not None else "",
+                "more than 20% nodes are unhealthy in the cluster"))
+            return Result(requeue_after=60.0)
+        # delete the backing claim (controller.go:121-126); bare nodes delete
+        # directly
         if nc is not None:
             if nc.metadata.deletion_timestamp is None:
                 self.store.delete(nc)

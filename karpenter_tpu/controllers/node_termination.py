@@ -29,6 +29,7 @@ from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim
 from ..api.objects import Node, Pod
 from ..api.storage import PersistentVolumeClaim, VolumeAttachment
+from ..events import catalog as events_catalog
 from ..kube.store import Store
 from ..logging import get_logger
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
@@ -47,15 +48,24 @@ DEFAULT_POD_GRACE_SECONDS = 30.0  # core/v1 terminationGracePeriodSeconds defaul
 log = get_logger("node.termination")
 
 
+def _fmt_time(ts: float) -> str:
+    """RFC3339 rendering of a runtime timestamp for event messages."""
+    from ..kube.k8s_codec import ts_to_k8s
+    return ts_to_k8s(ts) or ""
+
+
 class NodeTermination(Controller):
     name = "node.termination"
     kinds = (Node,)
 
     def __init__(self, store: Store, cluster: Cluster,
-                 clock: Optional[Clock] = None, cloud_provider=None):
+                 clock: Optional[Clock] = None, cloud_provider=None,
+                 recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
         # for the instance-already-gone shortcut; None skips the check
         self.cloud_provider = cloud_provider
         # pod key -> eviction backoff state (the eviction queue's rate
@@ -112,8 +122,21 @@ class NodeTermination(Controller):
                 return None
         self._taint(node)
         self._annotate_termination_time(node, owning)
+        term_ts = self._termination_time(node)
+        if term_ts is not None:
+            # controller.go:272-280: surface the hard deadline every pass
+            # (the recorder's dedupe collapses repeats)
+            self.recorder.publish(events_catalog.node_tgp_expiring(
+                node.name, _fmt_time(term_ts)))
+            if owning is not None:
+                self.recorder.publish(events_catalog.nodeclaim_tgp_expiring(
+                    owning.name, _fmt_time(term_ts)))
         remaining = self._drain(node)
         if remaining:
+            # controller.go:115-119: a drain pass that leaves pods behind is
+            # a NodeDrainError -> FailedDraining warning
+            self.recorder.publish(events_catalog.node_failed_to_drain(
+                node.name, f"{remaining} pods are waiting to be evicted"))
             log.debug("draining node", node=node.name, pods_remaining=remaining)
             return Result(requeue_after=1.0)
         # drained: wait for volumes to detach unless past the TGP deadline
@@ -202,6 +225,11 @@ class NodeTermination(Controller):
             for p in list(pods):
                 grace = p.spec.termination_grace_period_seconds or 0
                 if now + grace >= term_time:
+                    # terminator.go:140-157: proactive delete with clamped
+                    # grace, bypassing PDB + do-not-disrupt
+                    self.recorder.publish(events_catalog.disrupt_pod_delete(
+                        p, int(max(0.0, term_time - now)),
+                        _fmt_time(term_time)))
                     self._force_delete(p)
                     pods.remove(p)
 
@@ -307,4 +335,5 @@ class NodeTermination(Controller):
     def _evict(self, pod: Pod) -> None:
         # mechanically identical to force-delete in the standalone runtime;
         # the distinction is the caller's gates (PDB / do-not-disrupt)
+        self.recorder.publish(events_catalog.evict_pod(pod))  # eviction.go:208
         self._force_delete(pod)

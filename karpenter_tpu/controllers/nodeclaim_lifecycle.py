@@ -45,11 +45,14 @@ class NodeClaimLifecycle(Controller):
 
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None,
-                 registration_ttl: float = REGISTRATION_TTL_SECONDS):
+                 registration_ttl: float = REGISTRATION_TTL_SECONDS,
+                 recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
         self.registration_ttl = registration_ttl
 
     def reconcile(self, nc: NodeClaim) -> Optional[Result]:
@@ -84,8 +87,11 @@ class NodeClaimLifecycle(Controller):
             self.cloud_provider.create(nc)
         except InsufficientCapacityError as e:
             # launch.go:78-86: ICE deletes the claim so the provisioner retries
+            from ..events import catalog as events_catalog
             log.warning("insufficient capacity, deleting nodeclaim",
                         nodeclaim=nc.name, error=str(e))
+            self.recorder.publish(
+                events_catalog.insufficient_capacity(nc, str(e)))
             self.store.delete(nc)
             return Result()
         except CloudProviderError as e:

@@ -18,6 +18,7 @@ from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim
 from ..api.objects import Node
 from ..controllers.manager import Result, SingletonController
+from ..events import catalog as events_catalog
 from ..kube.store import Store
 from ..logging import get_logger
 from ..provisioning.provisioner import Provisioner
@@ -62,10 +63,12 @@ class OrchestrationQueue(SingletonController):
     name = "disruption.queue"
 
     def __init__(self, store: Store, cluster: Cluster,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
         self.items: List[QueuedCommand] = []
         self._backoff = ItemBackoff(QUEUE_BASE_DELAY, QUEUE_MAX_DELAY)
 
@@ -106,13 +109,21 @@ class OrchestrationQueue(SingletonController):
                 # replacement died (launch failure / liveness): roll back
                 self._rollback(qc)
                 return "done"
+            # queue.go:243-249: narrate replacement progress (dedupe
+            # collapses the per-pass repeats)
+            self.recorder.publish(
+                events_catalog.disruption_launching(nc, qc.command.reason))
             if not nc.initialized():
+                self.recorder.publish(
+                    events_catalog.disruption_waiting_on_readiness(nc))
                 return "wait"
         # all replacements ready: delete the candidates (queue.go:258-274)
         for c in qc.command.candidates:
             nc = c.state_node.nodeclaim
             live = self.store.get(NodeClaim, nc.name) if nc is not None else None
             if live is not None and live.metadata.deletion_timestamp is None:
+                self.recorder.publish(*events_catalog.disruption_terminating(
+                    c.state_node.name(), live.name, qc.command.reason))
                 self.store.delete(live)
         return "done"
 
@@ -139,19 +150,21 @@ class DisruptionController(SingletonController):
 
     def __init__(self, store: Store, cluster: Cluster, provisioner: Provisioner,
                  queue: OrchestrationQueue, clock: Optional[Clock] = None,
-                 spot_to_spot_enabled: bool = False):
+                 spot_to_spot_enabled: bool = False, recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.provisioner = provisioner
         self.queue = queue
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
         self.methods: List[Method] = [
-            Drift(cluster, provisioner),
-            Emptiness(cluster, provisioner),
+            Drift(cluster, provisioner, recorder=self.recorder),
+            Emptiness(cluster, provisioner, recorder=self.recorder),
             MultiNodeConsolidation(cluster, provisioner, spot_to_spot_enabled,
-                                   clock=self.clock),
+                                   clock=self.clock, recorder=self.recorder),
             SingleNodeConsolidation(cluster, provisioner, spot_to_spot_enabled,
-                                    clock=self.clock),
+                                    clock=self.clock, recorder=self.recorder),
         ]
         self.last_command: Optional[Command] = None
         # command awaiting the consolidation-TTL re-validation
@@ -215,12 +228,14 @@ class DisruptionController(SingletonController):
         candidates = get_candidates(
             self.cluster, self.provisioner, method.should_disrupt,
             disrupting_provider_ids=disrupting,
-            disruption_class=method.disruption_class)
+            disruption_class=method.disruption_class,
+            recorder=self.recorder)
         metrics.DISRUPTION_ELIGIBLE_NODES.set(
             len(candidates), {"reason": method.reason})
         if not candidates:
             return False
-        budgets = build_disruption_budget_mapping(self.cluster, method.reason)
+        budgets = build_disruption_budget_mapping(self.cluster, method.reason,
+                                                  recorder=self.recorder)
         started = self.clock.now()
         cmd, results = method.compute_command(budgets, candidates)
         metrics.DISRUPTION_EVAL_DURATION.observe(

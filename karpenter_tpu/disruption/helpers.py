@@ -16,6 +16,7 @@ from ..api.nodeclaim import COND_INSTANCE_TERMINATING
 from ..api.nodepool import NodePool
 from ..api.objects import Pod
 from ..api.policy import PodDisruptionBudget
+from ..events import catalog as events_catalog
 from ..provisioning.provisioner import Provisioner
 from ..state.cluster import Cluster
 from ..utils import node as node_utils
@@ -48,9 +49,12 @@ def build_pdb_limits(cluster: Cluster) -> Limits:
 
 def get_candidates(cluster: Cluster, provisioner: Provisioner,
                    should_disrupt, disrupting_provider_ids=(),
-                   disruption_class: str = "graceful") -> List[Candidate]:
+                   disruption_class: str = "graceful",
+                   recorder=None) -> List[Candidate]:
     """helpers.go:144-161: candidates from disruptable cluster nodes that the
-    method's ShouldDisrupt predicate accepts."""
+    method's ShouldDisrupt predicate accepts. Blocked candidates publish
+    DisruptionBlocked for managed nodes (types.go:74-101: events only when
+    NodeClaim != nil, so unmanaged nodes stay silent)."""
     now = cluster.clock.now()
     nodepools = {np.name: np for np in cluster.store.list(NodePool)}
     instance_types = {
@@ -66,7 +70,10 @@ def get_candidates(cluster: Cluster, provisioner: Provisioner,
             cand = new_candidate(now, sn, by_node.get(sn.name(), []),
                                  pdb_limits, nodepools, instance_types,
                                  disrupting_provider_ids, disruption_class)
-        except CandidateError:
+        except CandidateError as err:
+            if recorder is not None and sn.nodeclaim is not None:
+                recorder.publish(*events_catalog.disruption_blocked(
+                    sn.name(), sn.nodeclaim.name, str(err)))
             continue
         if should_disrupt(cand):
             out.append(cand)
@@ -80,7 +87,8 @@ def _node_not_ready(sn) -> bool:
     return cond is not None and cond[0] != "True"
 
 
-def build_disruption_budget_mapping(cluster: Cluster, reason: str) -> Dict[str, int]:
+def build_disruption_budget_mapping(cluster: Cluster, reason: str,
+                                    recorder=None) -> Dict[str, int]:
     """helpers.go:197-245: allowed = budget - already-disrupting, per pool.
     Only managed+initialized nodes count toward the total (uninitialized
     replacements must not inflate percentage budgets); claims with the
@@ -103,6 +111,12 @@ def build_disruption_budget_mapping(cluster: Cluster, reason: str) -> Dict[str, 
     for np in cluster.store.list(NodePool):
         total = np.allowed_disruptions(now, nodes_per_pool.get(np.name, 0), reason)
         allowed[np.name] = max(0, total - disrupting_per_pool.get(np.name, 0))
+        # helpers.go:240-242: a populated pool whose budget is zero for this
+        # reason tells the operator disruption is deliberately blocked
+        if recorder is not None and nodes_per_pool.get(np.name, 0) != 0 \
+                and total == 0:
+            recorder.publish(
+                events_catalog.nodepool_blocked_for_reason(np.name, reason))
     return allowed
 
 

@@ -17,10 +17,27 @@ from ..api import labels as api_labels
 from ..api.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
 from ..api.nodepool import (REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED,
                             WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED)
+from ..events import catalog as events_catalog
+from ..events.recorder import Recorder
 from ..scheduling.requirement import IN, Requirement
 from ..state.cluster import Cluster
 from .helpers import simulate_scheduling
 from .types import Candidate, CandidateError, Command
+
+
+def format_sim_errors(sim_errors: Dict[str, str]) -> str:
+    """Results.NonPendingPodSchedulingErrors() analog
+    (scheduling/scheduler.go:163-177): one string naming every
+    simulation-only pod that failed to reschedule."""
+    if not sim_errors:
+        return ""
+    return "not all pods would schedule, " + "; ".join(
+        sorted(sim_errors.values()))
+
+
+def _nodeclaim_name(c: Candidate) -> str:
+    nc = c.state_node.nodeclaim
+    return nc.name if nc is not None else ""
 
 MULTI_NODE_CONSOLIDATION_CANDIDATES = 100   # multinodeconsolidation.go:35
 MIN_SPOT_TO_SPOT_INSTANCE_TYPES = 15        # consolidation.go:47
@@ -63,14 +80,19 @@ class Emptiness(Method):
     reason = REASON_EMPTY
     consolidation_type = "empty"
 
-    def __init__(self, cluster: Cluster, provisioner=None):
+    def __init__(self, cluster: Cluster, provisioner=None, recorder=None):
         self.cluster = cluster
+        self.recorder = recorder or Recorder(cluster.clock)
 
     def should_disrupt(self, c: Candidate) -> bool:
         policy = c.nodepool.spec.disruption.consolidation_policy
         if policy not in (WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED):
             return False
         if c.nodepool.spec.disruption.consolidate_after is None:
+            # emptiness.go:46-49
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, _nodeclaim_name(c),
+                f'NodePool "{c.nodepool_name}" has consolidation disabled'))
             return False
         if c.state_node.nodeclaim is None or \
                 not c.state_node.nodeclaim.conditions.is_true(COND_CONSOLIDATABLE):
@@ -91,9 +113,10 @@ class Drift(Method):
     reason = REASON_DRIFTED
     disruption_class = "eventual"
 
-    def __init__(self, cluster: Cluster, provisioner):
+    def __init__(self, cluster: Cluster, provisioner, recorder=None):
         self.cluster = cluster
         self.provisioner = provisioner
+        self.recorder = recorder or Recorder(cluster.clock)
 
     def should_disrupt(self, c: Candidate) -> bool:
         nc = c.state_node.nodeclaim
@@ -115,6 +138,10 @@ class Drift(Method):
             except CandidateError:
                 continue
             if sim_errors:
+                # drift.go:101-106: report WHY the drifted node can't move
+                self.recorder.publish(*events_catalog.disruption_blocked(
+                    c.name, _nodeclaim_name(c),
+                    format_sim_errors(sim_errors)))
                 continue
             return Command(candidates=[c],
                            replacements=list(results.new_nodeclaims),
@@ -167,21 +194,49 @@ class consolidation(Method):
     reason = REASON_UNDERUTILIZED
 
     def __init__(self, cluster: Cluster, provisioner,
-                 spot_to_spot_enabled: bool = False, clock=None):
+                 spot_to_spot_enabled: bool = False, clock=None,
+                 recorder=None):
         self.cluster = cluster
         self.provisioner = provisioner
         self.spot_to_spot_enabled = spot_to_spot_enabled
         self.clock = clock or cluster.clock
+        self.recorder = recorder or Recorder(self.clock)
         # per-method memoized cluster token (consolidation.go:60): each
         # method tracks the last cluster state IT found nothing in, so one
         # method marking consolidated never suppresses the others
         self._last_state: Optional[float] = None
 
     def should_disrupt(self, c: Candidate) -> bool:
-        if c.nodepool.spec.disruption.consolidation_policy != \
-                WHEN_EMPTY_OR_UNDERUTILIZED:
+        """consolidation.go:85-117: the price-comparison prerequisites and
+        policy gates publish Unconsolidatable so operators can see WHY a
+        node never consolidates."""
+        ncn = _nodeclaim_name(c)
+        if c.instance_type is None:
+            it_label = c.state_node.labels().get(
+                api_labels.LABEL_INSTANCE_TYPE, "")
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, ncn, f'Instance Type "{it_label}" not found'))
+            return False
+        if not c.capacity_type:
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, ncn, 'Node does not have label '
+                f'"{api_labels.CAPACITY_TYPE_LABEL_KEY}"'))
+            return False
+        if not c.zone:
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, ncn, 'Node does not have label '
+                f'"{api_labels.LABEL_TOPOLOGY_ZONE}"'))
             return False
         if c.nodepool.spec.disruption.consolidate_after is None:
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, ncn,
+                f'NodePool "{c.nodepool_name}" has consolidation disabled'))
+            return False
+        if c.nodepool.spec.disruption.consolidation_policy != \
+                WHEN_EMPTY_OR_UNDERUTILIZED:
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                c.name, ncn, f'NodePool "{c.nodepool_name}" has non-empty '
+                'consolidation disabled'))
             return False
         nc = c.state_node.nodeclaim
         return nc is not None and nc.conditions.is_true(COND_CONSOLIDATABLE)
@@ -228,15 +283,28 @@ class consolidation(Method):
             return Command(reason=self.reason), None
         return self.decide(candidates, results, sim_errors)
 
+    def _unconsolidatable_single(self, candidates: List[Candidate],
+                                 reason: str) -> None:
+        """consolidation.go publishes decide-stage events only in the
+        single-candidate case (multi-node probes would spam every prefix)."""
+        if len(candidates) == 1:
+            self.recorder.publish(*events_catalog.unconsolidatable(
+                candidates[0].name, _nodeclaim_name(candidates[0]), reason))
+
     def decide(self, candidates: List[Candidate], results, sim_errors
                ) -> Tuple[Command, object]:
         """The post-simulation decision (consolidation.go:144-222)."""
         if sim_errors:
+            self._unconsolidatable_single(
+                candidates, format_sim_errors(sim_errors))  # :146-149
             return Command(reason=self.reason), None
         if not results.new_nodeclaims:
             return Command(candidates=list(candidates), reason=self.reason,
                            consolidation_type=self.consolidation_type), results
         if len(results.new_nodeclaims) != 1:
+            self._unconsolidatable_single(
+                candidates, "Can't remove without creating "
+                f"{len(results.new_nodeclaims)} candidates")  # :160-164
             return Command(reason=self.reason), None
 
         candidate_price = 0.0
@@ -262,8 +330,13 @@ class consolidation(Method):
 
         filtered, err = replacement.remove_instance_types_by_price_and_min_values(
             replacement.requirements, candidate_price)
-        if err is not None or filtered is None or \
-                not filtered.instance_type_options:
+        if err is not None or filtered is None:
+            self._unconsolidatable_single(
+                candidates, f"Filtering by price: {err}")  # :196-200
+            return Command(reason=self.reason), None
+        if not filtered.instance_type_options:
+            self._unconsolidatable_single(
+                candidates, "Can't replace with a cheaper node")  # :202-206
             return Command(reason=self.reason), None
         # OD->[OD,spot] must pin spot so a failed spot launch doesn't upgrade
         # to pricier on-demand (consolidation.go:212-219)
@@ -281,6 +354,9 @@ class consolidation(Method):
                       ) -> Tuple[Command, object]:
         """consolidation.go:229-302."""
         if not self.spot_to_spot_enabled:
+            self._unconsolidatable_single(
+                candidates, "SpotToSpotConsolidation is disabled, can't "
+                "replace a spot node with a spot node")  # :233-237
             return Command(reason=self.reason), None
         replacement = results.new_nodeclaims[0]
         replacement.requirements.add(Requirement(
@@ -288,14 +364,24 @@ class consolidation(Method):
             [api_labels.CAPACITY_TYPE_SPOT]))
         filtered, err = replacement.remove_instance_types_by_price_and_min_values(
             replacement.requirements, candidate_price)
-        if err is not None or filtered is None or \
-                not filtered.instance_type_options:
+        if err is not None or filtered is None:
+            self._unconsolidatable_single(
+                candidates, f"Filtering by price: {err}")  # :248-252
+            return Command(reason=self.reason), None
+        if not filtered.instance_type_options:
+            self._unconsolidatable_single(
+                candidates, "Can't replace with a cheaper node")  # :254-258
             return Command(reason=self.reason), None
         if len(candidates) > 1:
             return Command(candidates=list(candidates), replacements=[filtered],
                            reason=self.reason,
                            consolidation_type=self.consolidation_type), results
         if len(filtered.instance_type_options) < MIN_SPOT_TO_SPOT_INSTANCE_TYPES:
+            self._unconsolidatable_single(
+                candidates, "SpotToSpotConsolidation requires "
+                f"{MIN_SPOT_TO_SPOT_INSTANCE_TYPES} cheaper instance type "
+                "options than the current candidate to consolidate, got "
+                f"{len(filtered.instance_type_options)}")  # :274-278
             return Command(reason=self.reason), None
         # cap the launch list so the launched type is always inside it (no
         # continual-consolidation ping-pong); with minValues the cap is the

@@ -1,0 +1,245 @@
+"""Event constructors for every controller flow.
+
+The reference defines per-flow event packages; this module is their single
+tpu-side catalog, one constructor per reference event:
+
+- provisioning: /root/reference/pkg/controllers/provisioning/scheduling/
+  events.go:34-62 (Nominated, FailedScheduling)
+- disruption: /root/reference/pkg/controllers/disruption/events/
+  events.go:31-140 (DisruptionLaunching, DisruptionWaitingReadiness,
+  DisruptionTerminating, Unconsolidatable, DisruptionBlocked,
+  NodePool budget blocks)
+- termination: /root/reference/pkg/controllers/node/termination/terminator/
+  events/events.go:30-77 (Evicted, Disrupted, FailedDraining,
+  TerminationGracePeriodExpiring)
+- lifecycle: /root/reference/pkg/controllers/nodeclaim/lifecycle/
+  events.go:28-36 (InsufficientCapacityError)
+- health: /root/reference/pkg/controllers/node/health/events.go:28-76
+  (NodeRepairBlocked)
+
+Messages follow the reference strings so operators migrating from the
+reference can keep their event-based alerting.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .recorder import Event
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+_MAX_MESSAGE = 700  # lifecycle/events.go truncateMessage bound
+
+
+def _truncate(msg: str) -> str:
+    if len(msg) <= _MAX_MESSAGE:
+        return msg
+    return msg[:_MAX_MESSAGE] + "..."
+
+
+def _title(reason: str) -> str:
+    """cases.Title(NoLower) analog: upper-case the first rune only."""
+    return reason[:1].upper() + reason[1:] if reason else reason
+
+
+# -- provisioning (scheduling/events.go) ------------------------------------
+
+def nominate_pod(pod, node_name: str = "", nodeclaim_name: str = "") -> Event:
+    """scheduling/events.go:34-50 NominatePodEvent."""
+    info = []
+    if nodeclaim_name:
+        info.append(f"nodeclaim/{nodeclaim_name}")
+    if node_name:
+        info.append(f"node/{node_name}")
+    return Event(
+        object_kind="Pod", object_name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        type=NORMAL, reason="Nominated",
+        message=f"Pod should schedule on: {', '.join(info)}",
+        dedupe_values=(pod.uid,))
+
+
+def pod_failed_to_schedule(pod, err: str) -> Event:
+    """scheduling/events.go:52-61 PodFailedToScheduleEvent (5 min dedupe)."""
+    return Event(
+        object_kind="Pod", object_name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        type=WARNING, reason="FailedScheduling",
+        message=f"Failed to schedule pod, {err}",
+        dedupe_ttl=5 * 60.0, dedupe_values=(pod.uid,))
+
+
+# -- disruption (disruption/events/events.go) --------------------------------
+
+def disruption_launching(nodeclaim, reason: str) -> Event:
+    """events.go:31-39 Launching."""
+    return Event(
+        object_kind="NodeClaim", object_name=nodeclaim.name,
+        type=NORMAL, reason="DisruptionLaunching",
+        message=f"Launching NodeClaim: {_title(reason)}",
+        dedupe_values=(nodeclaim.name, reason))
+
+
+def disruption_waiting_on_readiness(nodeclaim) -> Event:
+    """events.go:41-48 WaitingOnReadiness."""
+    return Event(
+        object_kind="NodeClaim", object_name=nodeclaim.name,
+        type=NORMAL, reason="DisruptionWaitingReadiness",
+        message="Waiting on readiness to continue disruption",
+        dedupe_values=(nodeclaim.name,))
+
+
+def disruption_terminating(node_name: str, nodeclaim_name: str,
+                           reason: str) -> List[Event]:
+    """events.go:51-69 Terminating: one event on the Node, one on the
+    NodeClaim."""
+    return [
+        Event(object_kind="Node", object_name=node_name,
+              type=NORMAL, reason="DisruptionTerminating",
+              message=f"Disrupting Node: {_title(reason)}",
+              dedupe_values=(node_name, reason)),
+        Event(object_kind="NodeClaim", object_name=nodeclaim_name,
+              type=NORMAL, reason="DisruptionTerminating",
+              message=f"Disrupting NodeClaim: {_title(reason)}",
+              dedupe_values=(nodeclaim_name, reason)),
+    ]
+
+
+def unconsolidatable(node_name: str, nodeclaim_name: str,
+                     reason: str) -> List[Event]:
+    """events.go:73-92 Unconsolidatable (15 min dedupe)."""
+    return [
+        Event(object_kind="Node", object_name=node_name,
+              type=NORMAL, reason="Unconsolidatable", message=reason,
+              dedupe_ttl=15 * 60.0, dedupe_values=(node_name,)),
+        Event(object_kind="NodeClaim", object_name=nodeclaim_name,
+              type=NORMAL, reason="Unconsolidatable", message=reason,
+              dedupe_ttl=15 * 60.0, dedupe_values=(nodeclaim_name,)),
+    ]
+
+
+def disruption_blocked(node_name: Optional[str],
+                       nodeclaim_name: Optional[str],
+                       reason: str) -> List[Event]:
+    """events.go:96-116 Blocked."""
+    evs = []
+    if node_name:
+        evs.append(Event(
+            object_kind="Node", object_name=node_name,
+            type=NORMAL, reason="DisruptionBlocked",
+            message=f"Cannot disrupt Node: {reason}",
+            dedupe_values=(node_name,)))
+    if nodeclaim_name:
+        evs.append(Event(
+            object_kind="NodeClaim", object_name=nodeclaim_name,
+            type=NORMAL, reason="DisruptionBlocked",
+            message=f"Cannot disrupt NodeClaim: {reason}",
+            dedupe_values=(nodeclaim_name,)))
+    return evs
+
+
+def nodepool_blocked_for_reason(nodepool_name: str, reason: str) -> Event:
+    """events.go:118-127 NodePoolBlockedForDisruptionReason (1 min dedupe:
+    budgets can change every minute)."""
+    return Event(
+        object_kind="NodePool", object_name=nodepool_name,
+        type=NORMAL, reason="DisruptionBlocked",
+        message=(f"No allowed disruptions for disruption reason {reason} "
+                 "due to blocking budget"),
+        dedupe_ttl=60.0, dedupe_values=(nodepool_name, reason))
+
+
+def nodepool_blocked(nodepool_name: str) -> Event:
+    """events.go:129-140 NodePoolBlocked (1 min dedupe)."""
+    return Event(
+        object_kind="NodePool", object_name=nodepool_name,
+        type=NORMAL, reason="DisruptionBlocked",
+        message="No allowed disruptions due to blocking budget",
+        dedupe_ttl=60.0, dedupe_values=(nodepool_name,))
+
+
+# -- termination (terminator/events/events.go) -------------------------------
+
+def evict_pod(pod) -> Event:
+    """events.go:30-38 EvictPod."""
+    return Event(
+        object_kind="Pod", object_name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        type=NORMAL, reason="Evicted", message="Evicted pod",
+        dedupe_values=(pod.metadata.name,))
+
+
+def disrupt_pod_delete(pod, grace_period_seconds, termination_time) -> Event:
+    """events.go:40-48 DisruptPodDelete: forced delete when the node's
+    terminationGracePeriod expires, bypassing PDBs + do-not-disrupt."""
+    return Event(
+        object_kind="Pod", object_name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        type=NORMAL, reason="Disrupted",
+        message=(f"Deleting the pod to accommodate the terminationTime "
+                 f"{termination_time} of the node. The pod was granted "
+                 f"{grace_period_seconds} seconds of grace-period of its "
+                 f"{pod.spec.termination_grace_period_seconds} "
+                 "terminationGracePeriodSeconds. This bypasses the PDB of "
+                 "the pod and the do-not-disrupt annotation."),
+        dedupe_values=(pod.metadata.name,))
+
+
+def node_failed_to_drain(node_name: str, err: str) -> Event:
+    """events.go:50-58 NodeFailedToDrain."""
+    return Event(
+        object_kind="Node", object_name=node_name,
+        type=WARNING, reason="FailedDraining",
+        message=f"Failed to drain node, {err}",
+        dedupe_values=(node_name,))
+
+
+def node_tgp_expiring(node_name: str, termination_time: str) -> Event:
+    """events.go:60-68 NodeTerminationGracePeriodExpiring."""
+    return Event(
+        object_kind="Node", object_name=node_name,
+        type=WARNING, reason="TerminationGracePeriodExpiring",
+        message=f"All pods will be deleted by {termination_time}",
+        dedupe_values=(node_name,))
+
+
+def nodeclaim_tgp_expiring(nodeclaim_name: str, termination_time: str) -> Event:
+    """events.go:70-77 NodeClaimTerminationGracePeriodExpiring."""
+    return Event(
+        object_kind="NodeClaim", object_name=nodeclaim_name,
+        type=WARNING, reason="TerminationGracePeriodExpiring",
+        message=f"All pods will be deleted by {termination_time}",
+        dedupe_values=(nodeclaim_name,))
+
+
+# -- nodeclaim lifecycle (lifecycle/events.go) -------------------------------
+
+def insufficient_capacity(nodeclaim, err: str) -> Event:
+    """lifecycle/events.go:28-36 InsufficientCapacityErrorEvent."""
+    return Event(
+        object_kind="NodeClaim", object_name=nodeclaim.name,
+        type=WARNING, reason="InsufficientCapacityError",
+        message=f"NodeClaim {nodeclaim.name} event: {_truncate(err)}",
+        dedupe_values=(nodeclaim.name,))
+
+
+# -- node health (health/events.go) ------------------------------------------
+
+def node_repair_blocked(node_name: str, nodeclaim_name: str,
+                        reason: str) -> List[Event]:
+    """health/events.go:28-76 NodeRepairBlocked (15 min dedupe). The
+    reference emits both events with InvolvedObject=node (events.go:31,39 —
+    the second differs only in dedupe key); one per object is the evident
+    intent and what operators need. Bare nodes (no NodeClaim) publish the
+    Node event only."""
+    evs = [Event(object_kind="Node", object_name=node_name,
+                 type=WARNING, reason="NodeRepairBlocked", message=reason,
+                 dedupe_ttl=15 * 60.0, dedupe_values=(node_name,))]
+    if nodeclaim_name:
+        evs.append(Event(object_kind="NodeClaim", object_name=nodeclaim_name,
+                         type=WARNING, reason="NodeRepairBlocked",
+                         message=reason, dedupe_ttl=15 * 60.0,
+                         dedupe_values=(nodeclaim_name,)))
+    return evs

@@ -141,6 +141,45 @@ class KubeApiStore:
             payload = resp.read()
         return json.loads(payload.decode()) if payload else None
 
+    # -- event posting -------------------------------------------------------
+
+    _EVENT_API_VERSIONS = {"NodeClaim": "karpenter.sh/v1",
+                           "NodePool": "karpenter.sh/v1"}
+    _CLUSTER_SCOPED_KINDS = ("Node", "NodeClaim", "NodePool")
+
+    def post_event(self, ev) -> None:
+        """POST a core/v1 Event for a recorder event (the client-go
+        EventRecorder path the reference rides; recorder.go:47-100 handles
+        dedupe before this is called). Best-effort: HTTP failures raise and
+        the Recorder swallows them."""
+        import uuid
+
+        ns = ev.namespace or "default"
+        ts = k8s_codec.ts_to_k8s(ev.timestamp or self.clock.now())
+        body = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {
+                "name": f"{ev.object_name}.{uuid.uuid4().hex[:16]}",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "kind": ev.object_kind,
+                "name": ev.object_name,
+                "apiVersion": self._EVENT_API_VERSIONS.get(
+                    ev.object_kind, "v1"),
+                **({} if ev.object_kind in self._CLUSTER_SCOPED_KINDS
+                   else {"namespace": ns}),
+            },
+            "reason": ev.reason, "message": ev.message, "type": ev.type,
+            "source": {"component": "karpenter"},
+            "firstTimestamp": ts, "lastTimestamp": ts, "count": 1,
+        }
+        url = f"{self.base_url}/api/v1/namespaces/{ns}/events"
+        try:
+            self._request("POST", url, body)
+        except urllib.error.HTTPError as e:
+            raise self._map_error(e) from e
+
     # -- Store surface -------------------------------------------------------
 
     def create(self, obj) -> object:

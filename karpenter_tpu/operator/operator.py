@@ -56,6 +56,13 @@ class Operator:
         self.cloud_provider = decorate_cloud_provider(
             cloud_provider or KwokCloudProvider(store=self.store))
         self.recorder = Recorder(self.clock)
+        if self.options.store_backend == "kube":
+            # publish real v1.Event objects through the adapter so operators
+            # see karpenter's narrative in `kubectl get events` — buffered
+            # off-thread (the reference's client-go event-broadcaster path):
+            # a slow apiserver must never stall the reconcile loop
+            from ..events.recorder import AsyncSink
+            self.recorder.sink = AsyncSink(self.store.post_event)
         self.manager = Manager(self.store, self.clock)
         self.serving: Optional[ServingGroup] = None
 
@@ -77,13 +84,16 @@ class Operator:
                                        cluster=cluster, session=session)
         self.provisioner = Provisioner(self.store, self.cluster,
                                        self.cloud_provider, self.clock,
-                                       scheduler_factory=scheduler_factory)
+                                       scheduler_factory=scheduler_factory,
+                                       recorder=self.recorder)
         self.provisioner.batcher.idle = self.options.batch_idle_duration
         self.provisioner.batcher.max_duration = self.options.batch_max_duration
-        self.queue = OrchestrationQueue(self.store, self.cluster, self.clock)
+        self.queue = OrchestrationQueue(self.store, self.cluster, self.clock,
+                                        recorder=self.recorder)
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.queue, self.clock,
-            spot_to_spot_enabled=gates.spot_to_spot_consolidation)
+            spot_to_spot_enabled=gates.spot_to_spot_consolidation,
+            recorder=self.recorder)
 
         controllers = [
             self.provisioner,
@@ -93,11 +103,12 @@ class Operator:
             self.queue,
             self.disruption,
             NodeClaimLifecycle(self.store, self.cluster, self.cloud_provider,
-                               self.clock),
+                               self.clock, recorder=self.recorder),
             NodeClaimDisruptionMarker(self.store, self.cluster,
                                       self.cloud_provider, self.clock),
             NodeTermination(self.store, self.cluster, self.clock,
-                            cloud_provider=self.cloud_provider),
+                            cloud_provider=self.cloud_provider,
+                            recorder=self.recorder),
             Expiration(self.store, self.clock),
             GarbageCollection(self.store, self.cloud_provider, self.clock),
             PodEvents(self.store, self.cluster, self.clock),
@@ -115,7 +126,8 @@ class Operator:
             self.provisioner.profile_dir = "/tmp/karpenter-tpu-profile"
         if gates.node_repair:
             controllers.append(NodeHealth(self.store, self.cluster,
-                                          self.cloud_provider, self.clock))
+                                          self.cloud_provider, self.clock,
+                                          recorder=self.recorder))
         if self.options.kwok_kubelet and (
                 isinstance(self.cloud_provider, KwokCloudProvider)
                 or isinstance(getattr(self.cloud_provider, "_delegate", None),

@@ -22,6 +22,7 @@ from ..api.nodeclaim import NodeClaim
 from ..api.nodepool import NodePool, order_by_weight
 from ..api.objects import Node, Pod
 from ..controllers.manager import Controller, Result, SingletonController
+from ..events import catalog as events_catalog
 from ..kube.store import Store
 from ..logging import get_logger
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
@@ -145,11 +146,13 @@ class Provisioner(SingletonController):
 
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None, batcher: Optional[Batcher] = None,
-                 scheduler_factory=None):
+                 scheduler_factory=None, recorder=None):
+        from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or store.clock
+        self.recorder = recorder or Recorder(self.clock)
         self.batcher = batcher or Batcher(self.clock)
         # scheduler_factory(nodepools, instance_types, state_nodes,
         # daemonset_pods, cluster) -> object with solve(pods); defaults to the
@@ -286,13 +289,31 @@ class Provisioner(SingletonController):
                 {"nodepool": api_nc.nodepool_name})
             for p in nc.pods:
                 self.nominations[f"{p.namespace}/{p.name}"] = api_nc.name
+                # provisioner.go:388: pods bound for a brand-new claim are
+                # nominated against the claim (no node exists yet)
+                self.recorder.publish(
+                    events_catalog.nominate_pod(p, nodeclaim_name=api_nc.name))
 
     def _record(self, results) -> None:
+        """Results.Record analog (scheduling/scheduler.go:117-151): publish
+        FailedScheduling per pod error and Nominated per existing-node pod,
+        then persist the nomination state."""
         nominations: Dict[str, str] = {}
+        if results.pod_errors:
+            # one LIST builds the uid index (a per-uid get_by_uid would be a
+            # full cluster pod LIST per unschedulable pod on a kube backend)
+            by_uid = {p.uid: p for p in self.store.list(Pod)}
+            for uid, err in results.pod_errors.items():
+                p = by_uid.get(uid)
+                if p is not None:
+                    self.recorder.publish(
+                        events_catalog.pod_failed_to_schedule(p, err))
         for existing in results.existing_nodes:
             for p in existing.pods:
                 self.cluster.nominate_node_for_pod(existing.name, p)
                 nominations[f"{p.namespace}/{p.name}"] = existing.name
+                self.recorder.publish(
+                    events_catalog.nominate_pod(p, node_name=existing.name))
         self.cluster.mark_pod_scheduling_decisions(results.pod_errors, nominations)
         # bind pods packed onto live existing nodes immediately
         for existing in results.existing_nodes:

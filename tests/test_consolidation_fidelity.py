@@ -280,9 +280,11 @@ class TestSpotToSpotTruncation:
     def _method(self, enabled=True):
         from karpenter_tpu.disruption.methods import SingleNodeConsolidation
         from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.events.recorder import Recorder
         m = SingleNodeConsolidation.__new__(SingleNodeConsolidation)
         m.spot_to_spot_enabled = enabled
         m.clock = FakeClock()
+        m.recorder = Recorder(m.clock)
         return m
 
     def _results(self, n_types, min_values=None):
@@ -333,6 +335,11 @@ class TestSpotToSpotTruncation:
 
         class StubCandidate:
             capacity_type = api_labels.CAPACITY_TYPE_SPOT
+            name = "stub-node"
+
+            class _SN:
+                nodeclaim = None
+            state_node = _SN()
 
             def price(self):
                 return 1e9

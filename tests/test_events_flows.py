@@ -1,0 +1,382 @@
+"""Events published from every controller flow.
+
+The reference emits deduped events from provisioning
+(scheduling/scheduler.go:117-151, scheduling/events.go:34-62), disruption
+(disruption/events/events.go, published from types.go:74-101,
+helpers.go:240-242, consolidation.go:85-258, orchestration/queue.go:243-264),
+termination (terminator/events/events.go, published from
+termination/controller.go:115-119,272-280, terminator.go:140-157,
+eviction.go:208), node repair (health/controller.go:102,209) and lifecycle
+(lifecycle/launch.go:78-86). Each scenario here drives one flow end-to-end
+through the operator's shared Recorder and asserts the event lands.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.nodepool import Budget
+from karpenter_tpu.api.objects import Node, ObjectMeta, Pod
+from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods
+from test_operator import settle
+
+
+@pytest.fixture
+def op():
+    return Operator(clock=FakeClock())
+
+
+def reasons(op, name):
+    return set(op.recorder.reasons_for(name))
+
+
+class TestProvisioningEvents:
+    """scheduling/scheduler.go:117-151 Results.Record +
+    provisioner.go:388."""
+
+    def test_failed_scheduling_event_on_unschedulable_pod(self, op):
+        op.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="100000")  # no instance type fits 100k cores
+        op.store.create(pod)
+        settle(op)
+        evs = [e for e in op.recorder.for_object(pod.metadata.name)
+               if e.reason == "FailedScheduling"]
+        assert evs, "unschedulable pod published no FailedScheduling"
+        assert evs[0].type == "Warning"
+        assert evs[0].message.startswith("Failed to schedule pod, ")
+
+    def test_nominated_event_for_new_nodeclaim(self, op):
+        op.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="500m")
+        op.store.create(pod)
+        settle(op)
+        evs = [e for e in op.recorder.for_object(pod.metadata.name)
+               if e.reason == "Nominated"]
+        assert evs
+        assert "nodeclaim/" in evs[0].message
+
+    def test_nominated_event_for_existing_node(self, op):
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        [node] = op.store.list(Node)
+        # second pod packs onto the already-initialized node
+        p2 = make_pod(cpu="100m")
+        op.store.create(p2)
+        settle(op)
+        evs = [e for e in op.recorder.for_object(p2.metadata.name)
+               if e.reason == "Nominated"]
+        assert evs
+        assert f"node/{node.name}" in evs[0].message
+
+
+def _make_node_with_pod(op, pool="default", annotations=None, cpu="2500m"):
+    """Provision one node carrying one pod; returns (node, pod)."""
+    pod = make_pod(cpu=cpu)
+    if annotations:
+        pod.metadata.annotations.update(annotations)
+    op.store.create(pod)
+    settle(op)
+    [node] = op.store.list(Node)
+    return node, pod
+
+
+class TestDisruptionEvents:
+    def test_blocked_event_for_do_not_disrupt_pod(self, op):
+        """types.go:74-82: a candidate rejected by ValidatePodsDisruptable
+        publishes DisruptionBlocked on Node and NodeClaim."""
+        op.store.create(make_nodepool(name="default"))
+        node, _ = _make_node_with_pod(
+            op, annotations={api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"})
+        op.clock.step(21)  # past consolidateAfter
+        settle(op)
+        op.disruption.reconcile()
+        assert "DisruptionBlocked" in reasons(op, node.name)
+        [nc] = op.store.list(NodeClaim)
+        assert "DisruptionBlocked" in reasons(op, nc.name)
+        msg = [e for e in op.recorder.for_object(node.name)
+               if e.reason == "DisruptionBlocked"][0].message
+        assert msg.startswith("Cannot disrupt Node: ")
+
+    def test_nodepool_budget_blocked_event(self, op):
+        """helpers.go:240-242: a populated pool with a zero budget."""
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        op.store.create(pool)
+        _make_node_with_pod(op)
+        op.clock.step(21)
+        settle(op)
+        op.disruption.reconcile()
+        evs = [e for e in op.recorder.for_object("default")
+               if e.object_kind == "NodePool"]
+        assert evs and evs[0].reason == "DisruptionBlocked"
+        assert "blocking budget" in evs[0].message
+
+    def test_unconsolidatable_when_consolidation_disabled(self, op):
+        """consolidation.go:104-108: consolidateAfter: Never."""
+        pool = make_nodepool(name="default")
+        pool.spec.disruption.consolidate_after = None  # Never
+        op.store.create(pool)
+        node, _ = _make_node_with_pod(op)
+        op.clock.step(21)
+        settle(op)
+        op.disruption.reconcile()
+        evs = [e for e in op.recorder.for_object(node.name)
+               if e.reason == "Unconsolidatable"]
+        assert evs
+        assert 'NodePool "default" has consolidation disabled' == evs[0].message
+
+    def test_terminating_events_on_emptiness_execution(self, op):
+        """orchestration/queue.go:258-264: the command's candidates get
+        DisruptionTerminating on Node and NodeClaim when executed."""
+        op.store.create(make_nodepool(name="default"))
+        pod = make_pod(cpu="2500m")
+        op.store.create(pod)
+        settle(op)
+        [node] = op.store.list(Node)
+        [nc] = op.store.list(NodeClaim)
+        op.store.delete(pod)  # node is now empty -> emptiness candidate
+        settle(op)
+        op.clock.step(21)
+        settle(op)
+        for _ in range(6):
+            op.disruption.reconcile()
+            op.queue.reconcile()
+            settle(op, rounds=2)
+            op.clock.step(8)
+        assert "DisruptionTerminating" in reasons(op, node.name)
+        assert "DisruptionTerminating" in reasons(op, nc.name)
+        term = [e for e in op.recorder.for_object(node.name)
+                if e.reason == "DisruptionTerminating"][0]
+        assert term.message == "Disrupting Node: Empty"
+
+
+class TestTerminationEvents:
+    def test_evicted_event_per_drained_pod(self, op):
+        op.store.create(make_nodepool(name="default"))
+        node, pod = _make_node_with_pod(op)
+        op.store.delete(node)
+        settle(op)
+        assert "Evicted" in reasons(op, pod.metadata.name)
+
+    def test_failed_draining_when_pdb_blocks(self, op):
+        op.store.create(make_nodepool(name="default"))
+        node, pod = _make_node_with_pod(op)
+        pod.metadata.labels["app"] = "guarded"
+        op.store.update(pod)
+        from karpenter_tpu.api.objects import LabelSelector
+        op.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PDBSpec(selector=LabelSelector(
+                match_labels={"app": "guarded"}), max_unavailable="0")))
+        settle(op)
+        op.store.delete(node)
+        op.step()
+        evs = [e for e in op.recorder.for_object(node.name)
+               if e.reason == "FailedDraining"]
+        assert evs and evs[0].type == "Warning"
+        assert evs[0].message.startswith("Failed to drain node, ")
+
+    def test_tgp_expiring_and_disrupted_pod_events(self, op):
+        """controller.go:272-280 + terminator.go:140-157: a claim with
+        terminationGracePeriod stamps the deadline, pods whose grace can't
+        fit are proactively Disrupted."""
+        pool = make_nodepool(name="default")
+        pool.spec.template.spec.termination_grace_period = 60.0
+        op.store.create(pool)
+        pod = make_pod(cpu="2500m")
+        pod.spec.termination_grace_period_seconds = 3600  # can't fit in 60s
+        op.store.create(pod)
+        settle(op)
+        [node] = op.store.list(Node)
+        [nc] = op.store.list(NodeClaim)
+        op.store.delete(node)
+        op.step()
+        assert "TerminationGracePeriodExpiring" in reasons(op, node.name)
+        assert "TerminationGracePeriodExpiring" in reasons(op, nc.name)
+        dis = [e for e in op.recorder.for_object(pod.metadata.name)
+               if e.reason == "Disrupted"]
+        assert dis
+        assert "bypasses the PDB" in dis[0].message
+
+
+class TestLifecycleEvents:
+    def test_insufficient_capacity_event(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+        op = Operator(clock=FakeClock(), cloud_provider=FakeCloudProvider())
+        op.store.create(make_nodepool(name="default"))
+        op.cloud_provider.next_create_err = InsufficientCapacityError("no c-1x")
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        evs = [e for e in op.recorder.events
+               if e.reason == "InsufficientCapacityError"]
+        assert evs and evs[0].type == "Warning"
+        assert "no c-1x" in evs[0].message
+
+
+class TestRecorderSink:
+    def test_sink_receives_published_events(self):
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import Recorder
+        clock = FakeClock()
+        seen = []
+        rec = Recorder(clock, sink=seen.append)
+        rec.publish(evict_pod(make_pod()))
+        assert len(seen) == 1 and seen[0].reason == "Evicted"
+        # deduped events must not reach the sink twice
+        rec.publish(evict_pod(make_pod(name=seen[0].object_name)))
+        assert len(seen) == 1
+
+    def test_sink_errors_do_not_break_publish(self):
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import Recorder
+
+        def boom(ev):
+            raise RuntimeError("apiserver down")
+
+        rec = Recorder(FakeClock(), sink=boom)
+        rec.publish(evict_pod(make_pod()))
+        assert len(rec.events) == 1
+
+    def test_kube_post_event_body_shape(self, monkeypatch):
+        """post_event speaks core/v1 Events: involvedObject carries the right
+        apiVersion per kind, cluster-scoped kinds omit namespace."""
+        from karpenter_tpu.events.recorder import Event
+        from karpenter_tpu.kube.apiserver import KubeApiStore
+        store = KubeApiStore.__new__(KubeApiStore)
+        store.base_url = "http://api.test"
+        store.clock = FakeClock()
+        calls = []
+        monkeypatch.setattr(
+            store, "_request",
+            lambda method, url, body=None: calls.append((method, url, body)))
+        store.post_event(Event(
+            object_kind="NodeClaim", object_name="default-1", type="Normal",
+            reason="DisruptionLaunching", message="Launching NodeClaim: Empty"))
+        store.post_event(Event(
+            object_kind="Pod", object_name="web-1", namespace="apps",
+            type="Warning", reason="FailedScheduling", message="no capacity"))
+        (m1, u1, b1), (m2, u2, b2) = calls
+        assert m1 == m2 == "POST"
+        assert u1.endswith("/api/v1/namespaces/default/events")
+        assert b1["involvedObject"] == {
+            "kind": "NodeClaim", "name": "default-1",
+            "apiVersion": "karpenter.sh/v1"}
+        assert u2.endswith("/api/v1/namespaces/apps/events")
+        assert b2["involvedObject"]["namespace"] == "apps"
+        assert b2["involvedObject"]["apiVersion"] == "v1"
+        assert b2["source"] == {"component": "karpenter"}
+
+
+class TestDedupeFidelity:
+    def test_message_churn_still_dedupes(self):
+        """recorder.go:74: the dedupe key is type+reason+DedupeValues, not
+        the message — FailedDraining with a shrinking pod count must stay
+        one event per window."""
+        from karpenter_tpu.events.catalog import node_failed_to_drain
+        from karpenter_tpu.events.recorder import Recorder
+        rec = Recorder(FakeClock())
+        for n in (5, 4, 3, 2, 1):
+            rec.publish(node_failed_to_drain(
+                "node-a", f"{n} pods are waiting to be evicted"))
+        assert len(rec.events) == 1
+
+    def test_same_name_different_namespace_not_deduped(self):
+        """Pod dedupe rides the UID (scheduling/events.go:60), so
+        identically-named pods in different namespaces each publish."""
+        from karpenter_tpu.events.catalog import pod_failed_to_schedule
+        from karpenter_tpu.events.recorder import Recorder
+        rec = Recorder(FakeClock())
+        rec.publish(pod_failed_to_schedule(
+            make_pod(name="web-0", namespace="team-a"), "no capacity"))
+        rec.publish(pod_failed_to_schedule(
+            make_pod(name="web-0", namespace="team-b"), "no capacity"))
+        assert len(rec.events) == 2
+
+    def test_repair_blocked_skips_bare_node_claim_event(self):
+        from karpenter_tpu.events.catalog import node_repair_blocked
+        evs = node_repair_blocked("node-a", "", "too many unhealthy")
+        assert [e.object_kind for e in evs] == ["Node"]
+
+
+class TestAsyncSink:
+    def test_delivers_off_thread_and_flushes(self):
+        import threading
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import AsyncSink, Recorder
+        seen = []
+        threads = set()
+
+        def deliver(ev):
+            threads.add(threading.current_thread().name)
+            seen.append(ev)
+
+        sink = AsyncSink(deliver)
+        rec = Recorder(FakeClock(), sink=sink)
+        for i in range(5):
+            rec.publish(evict_pod(make_pod(name=f"p-{i}")))
+        sink.flush()
+        assert len(seen) == 5
+        assert threads == {"karpenter-event-sink"}
+        sink.close()
+
+    def test_slow_delivery_does_not_block_publish(self):
+        import time
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import AsyncSink, Recorder
+
+        def slow(ev):
+            time.sleep(0.2)
+
+        sink = AsyncSink(slow)
+        rec = Recorder(FakeClock(), sink=sink)
+        t0 = time.monotonic()
+        for i in range(10):
+            rec.publish(evict_pod(make_pod(name=f"s-{i}")))
+        assert time.monotonic() - t0 < 0.1  # publish never blocked
+        sink.close()
+
+    def test_delivery_errors_swallowed(self):
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import AsyncSink, Recorder
+
+        def boom(ev):
+            raise RuntimeError("apiserver down")
+
+        sink = AsyncSink(boom)
+        rec = Recorder(FakeClock(), sink=sink)
+        rec.publish(evict_pod(make_pod(name="x-1")))
+        sink.flush()  # must not raise or hang
+        assert len(rec.events) == 1
+        sink.close()
+
+
+class TestQueueReadinessEvents:
+    def test_launching_and_waiting_events_for_replacements(self, op):
+        """orchestration/queue.go:243-249: a consolidation with a replacement
+        narrates Launching then WaitingReadiness until initialization."""
+        from karpenter_tpu.disruption.controller import QueuedCommand
+        from karpenter_tpu.disruption.types import Command
+        op.store.create(make_nodepool(name="default"))
+        op.store.create(make_pod(cpu="500m"))
+        settle(op)
+        [nc] = op.store.list(NodeClaim)
+        # a synthetic queued command waiting on a fresh (uninitialized) claim
+        repl = NodeClaim(metadata=ObjectMeta(
+            name="repl-1",
+            labels={api_labels.NODEPOOL_LABEL_KEY: "default"}))
+        op.store.create(repl)
+        op.queue.add(QueuedCommand(
+            command=Command(candidates=[], reason="underutilized"),
+            replacement_names=["repl-1"], enqueued_at=op.clock.now()))
+        op.queue.reconcile()
+        assert "DisruptionLaunching" in reasons(op, "repl-1")
+        assert "DisruptionWaitingReadiness" in reasons(op, "repl-1")
+        msg = [e for e in op.recorder.for_object("repl-1")
+               if e.reason == "DisruptionLaunching"][0].message
+        assert msg == "Launching NodeClaim: Underutilized"
