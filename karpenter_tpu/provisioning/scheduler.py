@@ -34,6 +34,10 @@ from .topology import Topology
 MAX_INSTANCE_TYPES = 60  # nodeclaimtemplate.go:35
 
 _hostname_seq = itertools.count(1)
+# ONE claim-name counter for every solver path (host oracle, tensor,
+# sidecar decode): independent counters minted colliding names — two paths
+# both producing "default-00342" in one process is a store ConflictError
+claim_name_seq = itertools.count(1)
 
 
 class NodeClaimTemplate:
@@ -219,7 +223,7 @@ class InFlightNodeClaim:
                              [it.name for it in instance_types], min_values=mv))
         nc = APINodeClaim(
             metadata=ObjectMeta(
-                name=f"{t.nodepool_name}-{next(_hostname_seq):05d}",
+                name=f"{t.nodepool_name}-{next(claim_name_seq):05d}",
                 labels=dict(t.labels), annotations=dict(t.annotations),
                 owner_refs=[OwnerReference(kind="NodePool", name=t.nodepool_name,
                                            uid=t.nodepool_uid, block_owner_deletion=True)]),

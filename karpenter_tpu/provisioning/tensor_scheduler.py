@@ -28,11 +28,11 @@ from ..scheduling.requirements import (ALLOW_UNDEFINED_WELL_KNOWN, Requirements,
                                        label_requirements)
 from ..utils import resources as res
 from .grouping import PodGroup, group_pods, partition_pods
+# claim_name_seq: ONE process-wide claim-name sequence shared with the host
+# oracle (independent counters minted colliding claim names)
 from .scheduler import (MAX_INSTANCE_TYPES, NodeClaimTemplate, Results, Scheduler,
-                        _daemon_overhead, _req_to_selector)
+                        _daemon_overhead, _req_to_selector, claim_name_seq)
 from .topology import ClusterView, Topology
-
-_name_seq = itertools.count(1)
 
 
 def _pow2_bucket(n: int, minimum: int) -> int:
@@ -160,7 +160,7 @@ class TensorNodeClaim:
                              [it.name for it in instance_types], min_values=mv))
         return APINodeClaim(
             metadata=ObjectMeta(
-                name=f"{t.nodepool_name}-{next(_name_seq):05d}",
+                name=f"{t.nodepool_name}-{next(claim_name_seq):05d}",
                 labels=dict(t.labels), annotations=dict(t.annotations),
                 owner_refs=[OwnerReference(kind="NodePool", name=t.nodepool_name,
                                            uid=t.nodepool_uid, block_owner_deletion=True)]),

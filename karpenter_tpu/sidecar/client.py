@@ -267,7 +267,7 @@ def decode_results_rows(data: bytes, pods: List[Pod], catalog: list
                         ) -> "RemoteResults":
     """Rebuild RemoteResults from a row-referencing response frame."""
     from . import wire
-    from ..provisioning.tensor_scheduler import _name_seq
+    from ..provisioning.scheduler import claim_name_seq
     header, blobs = wire.unpack(data)
     all_rows = wire.unpack_u32(blobs["rows"]).tolist()
     all_its = (wire.unpack_u16(blobs["its"]) if header.get("its_u16", True)
@@ -292,7 +292,7 @@ def decode_results_rows(data: bytes, pods: List[Pod], catalog: list
     for si, off, n in header["claims"]:
         proto = shape_protos[si]
         pool = header["shapes"][si]["nodepool"]
-        name = f"{pool}-{next(_name_seq):05d}"
+        name = f"{pool}-{next(claim_name_seq):05d}"
         results.new_nodeclaims.append(RemoteNodeClaim(
             api_nodeclaim=_stamp_api_claim(proto, name),
             pods=[pods[r] for r in all_rows[off:off + n]],

@@ -1,0 +1,831 @@
+"""Consolidation regression corpus, ported scenario-by-scenario from
+/root/reference/pkg/controllers/disruption/consolidation_test.go (4,382 LoC)
+on the expectations harness (tests/expectations.py — the
+pkg/test/expectations analog). Each test cites its Go source range.
+
+Families covered here: Replace (:870-2233), Delete (:2234-3071), TTL
+validation races (:3072-3498), Multi-NodeClaim (:3499-3984), Node Lifetime
+(:3985-4065), Topology (:4066-4254), Events (:102-179), plus the
+do-not-disrupt / PDB candidate-gating tables. Budget interplay lives in
+test_consolidation_suite.py (ported earlier rounds).
+
+Not ported: PDB unhealthyPodEvictionPolicy entries (:1703-1794) — the PDB
+model carries minAvailable/maxUnavailable only (DEVIATIONS: no unhealthy
+pod tracking in the standalone runtime).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import COND_CONSOLIDATABLE, NodeClaim
+from karpenter_tpu.api.objects import Node, NodeSelectorRequirement
+from karpenter_tpu.scheduling.requirement import EXISTS, IN
+
+from expectations import (OD, SPOT, Env, MinValuesReq, bind_pod, catalog,
+                          cheapest_instance, consolidation_nodepool,
+                          instance_named, make_env, make_nodeclaim_and_node,
+                          make_pdb, make_replacements_ready,
+                          most_expensive_instance, sorted_by_price)
+from factories import make_nodepool, make_pod
+
+
+def _it_label(obj):
+    return obj.metadata.labels.get(api_labels.LABEL_INSTANCE_TYPE, "")
+
+
+class TestReplace:
+    """consolidation_test.go:870-2233."""
+
+    @pytest.mark.parametrize("capacity_type", [OD, SPOT])
+    def test_can_replace_node(self, capacity_type):
+        """:871-931 'can replace node' (on-demand and spot entries): a pod
+        on the most expensive instance moves to a cheaper replacement; the
+        old claim and node are deleted."""
+        env = make_env(spot_to_spot=True)
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=capacity_type,
+            instance_type=most_expensive_instance(capacity_type))
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption()
+        claims, nodes = env.nodeclaims(), env.nodes()
+        assert len(claims) == 1 and len(nodes) == 1
+        assert claims[0].name != nc.name, "old claim survived"
+        assert not env.nodeclaim_exists(nc.name)
+        assert not env.node_exists(node.name)
+        # the replacement must not be the most expensive type (:922-924)
+        assert _it_label(nodes[0]) != most_expensive_instance(capacity_type).name
+        # the pod rode over
+        live_pods = [p for p in env.store.list(type(make_pod()))
+                     if p.spec.node_name]
+        assert all(p.spec.node_name == nodes[0].name for p in live_pods)
+
+    def test_spot_to_spot_fewer_than_15_cheaper_blocks(self):
+        """:932-1005 'cannot replace spot with spot if less than minimum
+        InstanceTypes flexibility': restrict the pool so fewer than 15
+        cheaper spot types exist; the node stays and the Unconsolidatable
+        event names the floor."""
+        spot_sorted = sorted_by_price(SPOT)
+        allowed = [it.name for it in spot_sorted[:5]] + [spot_sorted[-1].name]
+        pool = consolidation_nodepool()
+        pool.spec.template.spec.requirements = [NodeSelectorRequirement(
+            key=api_labels.LABEL_INSTANCE_TYPE, operator=IN,
+            values=tuple(allowed))]
+        env = make_env(pool, spot_to_spot=True)
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=spot_sorted[-1])
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name), "node must not consolidate"
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any("SpotToSpotConsolidation requires 15 cheaper instance "
+                   "type options" in m for m in msgs), msgs
+
+    def test_spot_to_spot_disabled_blocks_with_event(self):
+        """:1009-1080 'cannot replace spot with spot if the
+        spotToSpotConsolidation is disabled'."""
+        env = make_env(spot_to_spot=False)
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT,
+            instance_type=most_expensive_instance(SPOT))
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any("SpotToSpotConsolidation is disabled" in m for m in msgs)
+
+    def test_spot_to_spot_launch_list_capped_at_15_cheapest(self):
+        """:1082-1185: the single-node spot replacement launches with AT
+        MOST the 15 cheapest cheaper types (no continual-consolidation
+        ping-pong), every option strictly cheaper than the candidate."""
+        env = make_env(spot_to_spot=True)
+        cand_it = most_expensive_instance(SPOT)
+        cand_price = max(o.price for o in cand_it.offerings
+                         if o.capacity_type == SPOT)
+        nc, node = make_nodeclaim_and_node(env, capacity_type=SPOT,
+                                           instance_type=cand_it)
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None, "no command computed"
+        cmd, _ = env.disruption.pending
+        [replacement] = cmd.replacements
+        opts = replacement.instance_type_options
+        assert 0 < len(opts) <= 15
+        for it in opts:
+            cheapest_spot = min(o.price for o in it.offerings
+                                if o.capacity_type == SPOT)
+            assert cheapest_spot < cand_price
+
+    def test_min_values_broken_by_price_filter_blocks(self):
+        """:1487-1581 'Consolidation should fail if filterByPrice breaks
+        the minimum requirement from the NodePools': minValues demands more
+        instance-type flexibility than the cheaper-than-candidate set can
+        offer, so no command forms."""
+        by_price = sorted_by_price(OD)
+        # candidate near the cheap end: far fewer than 40 strictly-cheaper
+        # types exist, but minValues demands 40 (satisfiable against the
+        # full 144-type catalog, so the simulation itself succeeds)
+        cand = by_price[3]
+        pool = consolidation_nodepool()
+        pool.spec.template.spec.requirements = [MinValuesReq(
+            key=api_labels.LABEL_INSTANCE_TYPE, operator=EXISTS,
+            min_values=40)]
+        env = make_env(pool)
+        nc, node = make_nodeclaim_and_node(env, instance_type=cand)
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        assert env.nodeclaim_exists(nc.name)
+
+    def test_replace_when_another_nodepool_unusable(self):
+        """:1582-1645 'can replace nodes if another nodePool returns no
+        instance types': a broken second pool must not veto the good
+        pool's consolidation."""
+        broken = consolidation_nodepool(name="broken")
+        broken.spec.template.spec.requirements = [NodeSelectorRequirement(
+            key=api_labels.LABEL_INSTANCE_TYPE, operator=IN,
+            values=("does-not-exist",))]
+        env = make_env(consolidation_nodepool(), broken)
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+        [replacement] = env.nodes()
+        assert _it_label(replacement) != most_expensive_instance(OD).name
+
+    def test_pdb_blocking_eviction_blocks_candidate(self):
+        """:1646-1702 'can replace nodes, considers PDB': maxUnavailable=0
+        over the node's pod blocks the candidate outright."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m", labels={"app": "guarded"})
+        make_pdb(env, {"app": "guarded"}, max_unavailable="0")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        msgs = [e.message for e in env.events("DisruptionBlocked")]
+        assert any("pdb" in m for m in msgs), msgs
+
+    def test_pdb_with_headroom_allows_replacement(self):
+        """:1646-1702 (the allowing entries): a PDB with eviction headroom
+        does not block consolidation."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m", labels={"app": "guarded"})
+        make_pdb(env, {"app": "guarded"}, max_unavailable="1")
+        env.clock.step(600)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+
+    def test_pdb_namespace_must_match(self):
+        """:1795-1862 'can replace nodes, PDB namespace must match': a
+        blocking PDB in a DIFFERENT namespace is irrelevant."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m", labels={"app": "guarded"},
+                 namespace="default")
+        make_pdb(env, {"app": "guarded"}, max_unavailable="0",
+                 namespace="other-ns")
+        env.clock.step(600)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+
+    def test_do_not_disrupt_node_annotation_blocks(self):
+        """:1863-1955 'considers karpenter.sh/do-not-disrupt on nodes'."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD),
+            annotations={api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"})
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        msgs = [e.message for e in env.events("DisruptionBlocked")]
+        assert any(api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY in m
+                   for m in msgs), msgs
+
+    def test_do_not_disrupt_pod_annotation_blocks(self):
+        """:1956-2020 'considers karpenter.sh/do-not-disrupt on pods'."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        pod = make_pod(cpu="500m")
+        pod.metadata.annotations[api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = \
+            "true"
+        bind_pod(env, node, pod)
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+
+    def test_terminal_do_not_disrupt_pod_does_not_block(self):
+        """:2021-2233 (terminal/terminating entries): a Succeeded or Failed
+        do-not-disrupt pod no longer blocks consolidation."""
+        for phase in ("Succeeded", "Failed"):
+            env = make_env()
+            nc, node = make_nodeclaim_and_node(
+                env, instance_type=most_expensive_instance(OD))
+            done = make_pod(cpu="500m", name=f"done-{phase.lower()}")
+            done.metadata.annotations[
+                api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+            bind_pod(env, node, done)
+            done.status.phase = phase
+            env.store.update(done)
+            live = bind_pod(env, node, cpu="100m",
+                            name=f"live-{phase.lower()}")
+            env.clock.step(600)
+            env.run_disruption()
+            assert not env.node_exists(node.name), phase
+
+
+class TestDelete:
+    """consolidation_test.go:2234-3071."""
+
+    def _two_cheap_nodes(self, env, cpu="32"):
+        # cheapest SPOT type: the kwok catalog prices every type's spot
+        # offering below its on-demand one, so an on-demand "cheapest" node
+        # always has a cheaper spot REPLACEMENT — true delete semantics
+        # need candidates nothing undercuts (the reference builds its test
+        # catalog with the same property: leastExpensiveInstance has the
+        # floor price)
+        it = cheapest_instance(SPOT)
+        pair = [make_nodeclaim_and_node(
+            env, instance_type=it, capacity_type=SPOT,
+            allocatable={"cpu": cpu, "memory": "128Gi", "pods": "100"})
+            for _ in range(2)]
+        return pair
+
+    def test_can_delete_node(self):
+        """:2259-2304 'can delete nodes': two cheapest-type nodes, three
+        pods that fit on one — the emptier node deletes with NO
+        replacement."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = self._two_cheap_nodes(env)
+        bind_pod(env, node0, cpu="500m")
+        bind_pod(env, node0, cpu="500m")
+        bind_pod(env, node1, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption()
+        assert len(env.nodes()) == 1
+        assert len(env.nodeclaims()) == 1
+        # no replacement was launched: the survivor is one of the originals
+        assert env.nodes()[0].name in (node0.name, node1.name)
+
+    def test_wont_delete_when_pods_dont_fit_elsewhere(self):
+        """:2680-2740 (delete guards): both nodes nearly full — removing
+        either strands pods, so nothing is disrupted."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = self._two_cheap_nodes(env, cpu="3")
+        for node in (node0, node1):
+            for _ in range(3):
+                bind_pod(env, node, cpu="900m")  # 2.7 of 3 allocatable
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert len(env.nodes()) == 2
+
+    def test_delete_prefers_lower_disruption_cost(self):
+        """:2234-2304 + types.go disruption-cost ordering: with unequal pod
+        counts the lighter node goes."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = self._two_cheap_nodes(env)
+        for _ in range(4):
+            bind_pod(env, node0, cpu="400m")
+        bind_pod(env, node1, cpu="400m")
+        env.clock.step(600)
+        env.run_disruption()
+        assert env.node_exists(node0.name)
+        assert not env.node_exists(node1.name)
+
+    def test_delete_respects_do_not_disrupt_pod(self):
+        """:2775-2860: the delete path honors do-not-disrupt too."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = self._two_cheap_nodes(env)
+        bind_pod(env, node0, cpu="500m")
+        guarded = make_pod(cpu="500m")
+        guarded.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        bind_pod(env, node1, guarded)
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        # node1 is protected; node0's pod fits on node1? No - node1 is
+        # blocked as a candidate but node0 can still consolidate INTO it
+        assert env.node_exists(node1.name)
+
+
+class TestCandidateLabelGates:
+    """consolidation_test.go:140-216 (Events + Metrics contexts): the
+    price-comparison prerequisites and the eligible-nodes gauge."""
+
+    def test_unresolvable_instance_type_fires_event(self):
+        """:140-152: a candidate whose instance-type label names nothing in
+        the catalog can't be price-compared."""
+        from karpenter_tpu.api.nodeclaim import COND_DRIFTED
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(env,
+                                           instance_type="tpu-ghost-type")
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        # the repo's drift marker ALSO flags unknown instance types
+        # (InstanceTypeNotFound) and Drift ranks above consolidation; the
+        # reference scenario runs without the marker controller, so clear
+        # the condition to reach the consolidation guard under test
+        live = env.store.get(type(nc), nc.name)
+        live.conditions.set_false(COND_DRIFTED, reason="Test",
+                                  now=env.clock.now())
+        env.store.update(live)
+        env.disruption.reconcile()
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any('Instance Type "tpu-ghost-type" not found' == m
+                   for m in msgs), msgs
+        assert env.node_exists(node.name)
+
+    def test_missing_capacity_type_label_fires_event(self):
+        """:153-165."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(env)
+        for obj in (node, nc):
+            del obj.metadata.labels[api_labels.CAPACITY_TYPE_LABEL_KEY]
+            env.store.update(obj)
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any(api_labels.CAPACITY_TYPE_LABEL_KEY in m for m in msgs), msgs
+
+    def test_missing_zone_label_fires_event(self):
+        """:166-179."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(env)
+        for obj in (node, nc):
+            del obj.metadata.labels[api_labels.LABEL_TOPOLOGY_ZONE]
+            env.store.update(obj)
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any(api_labels.LABEL_TOPOLOGY_ZONE in m for m in msgs), msgs
+
+    def test_eligible_nodes_metric_reported(self):
+        """:181-216 'should correctly report eligible nodes': the gauge
+        follows the candidate count for the underutilized reason."""
+        from karpenter_tpu.api.nodepool import REASON_UNDERUTILIZED
+        from karpenter_tpu.metrics.registry import DISRUPTION_ELIGIBLE_NODES
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        assert DISRUPTION_ELIGIBLE_NODES.value(
+            {"reason": REASON_UNDERUTILIZED}) >= 1
+
+
+class TestReplacePriceGuards:
+    """consolidation_test.go:2048-2233."""
+
+    def test_wont_replace_when_replacement_more_expensive(self):
+        """:2048-2131 'won't replace node if any spot replacement is more
+        expensive': a pod filling the cheapest spot node leaves no cheaper
+        home — nothing is disrupted."""
+        env = make_env(spot_to_spot=True)
+        it = cheapest_instance(SPOT)
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "3", "memory": "12Gi", "pods": "100"})
+        bind_pod(env, node, cpu="2500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        assert env.nodeclaim_exists(nc.name)
+
+    def test_spot_candidate_already_among_cheapest_not_replaced(self):
+        """:1050-1120 'cannot replace spot with spot if it is part of the
+        15 cheapest instance types': churn protection — a cheapest-tier
+        spot node stays put."""
+        env = make_env(spot_to_spot=True)
+        it = sorted_by_price(SPOT)[2]  # comfortably inside the 15 cheapest
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "3", "memory": "12Gi", "pods": "100"})
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+
+
+class TestDeleteEdgeCases:
+    """consolidation_test.go:2351-3005."""
+
+    def test_non_karpenter_capacity_can_fit_pods(self):
+        """:2351-2404 'can delete nodes, when non-Karpenter capacity can
+        fit pods': an unmanaged node's headroom counts, so the managed
+        node deletes without any replacement."""
+        from karpenter_tpu.api.objects import NodeSpec, NodeStatus, ObjectMeta
+        from karpenter_tpu.utils import resources as res
+        env = make_env()
+        unmanaged = Node(
+            metadata=ObjectMeta(
+                name="byo-node",
+                labels={api_labels.LABEL_HOSTNAME: "byo-node",
+                        api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a"}),
+            spec=NodeSpec(provider_id="byo://node"),
+            status=NodeStatus(
+                capacity=res.parse_list({"cpu": "32", "memory": "128Gi",
+                                         "pods": "100"}),
+                allocatable=res.parse_list({"cpu": "32", "memory": "128Gi",
+                                            "pods": "100"})))
+        env.store.create(unmanaged)
+        it = cheapest_instance(SPOT)
+        nc, node = make_nodeclaim_and_node(env, capacity_type=SPOT,
+                                           instance_type=it)
+        for _ in range(3):
+            bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+        assert env.node_exists("byo-node")
+        # no replacement claim was launched
+        assert len(env.nodeclaims()) == 0
+
+    def test_evicts_pods_without_owner_ref(self):
+        """:2662-2713 'can delete nodes, evicts pods without an ownerRef':
+        ownerless pods don't pin the node."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = [
+            make_nodeclaim_and_node(env, capacity_type=SPOT,
+                                    instance_type=cheapest_instance(SPOT))
+            for _ in range(2)]
+        bind_pod(env, node0, cpu="500m")   # factories make ownerless pods
+        env.clock.step(600)
+        env.run_disruption()
+        # the empty node AND eventually the loaded one consolidate down to
+        # one; the ownerless pod was evicted (unbound), then re-placed
+        assert len(env.nodes()) == 1
+
+    def test_wont_delete_when_pods_need_uninitialized_node(self):
+        """:2714-2758 'won't delete node if it would require pods to
+        schedule on an uninitialized node'."""
+        env = make_env()
+        it = cheapest_instance(SPOT)
+        nc0, node0 = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "3", "memory": "12Gi", "pods": "100"})
+        nc1, node1 = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it, initialized=False,
+            allocatable={"cpu": "3", "memory": "12Gi", "pods": "100"})
+        bind_pod(env, node0, cpu="2500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node0.name), (
+            "pods were parked on an uninitialized node")
+
+    def test_permanently_pending_pod_does_not_block(self):
+        """:2907-2962 'can delete nodes with a permanently pending pod':
+        a pod that was already unschedulable BEFORE consolidation must not
+        veto it (AllNonPendingPodsScheduled ignores it)."""
+        env = make_env()
+        (nc0, node0), (nc1, node1) = [
+            make_nodeclaim_and_node(env, capacity_type=SPOT,
+                                    instance_type=cheapest_instance(SPOT))
+            for _ in range(2)]
+        bind_pod(env, node1, cpu="500m")
+        forever_pending = make_pod(
+            cpu="500m",
+            node_selector={api_labels.LABEL_INSTANCE_TYPE: "no-such-type"})
+        env.store.create(forever_pending)
+        env.clock.step(600)
+        env.settle()
+        env.run_disruption()
+        assert len(env.nodes()) == 1, "pending pod blocked consolidation"
+
+    def test_anti_affinity_blocks_merge(self):
+        """:4193-4254 'won't delete node if it would violate pod
+        anti-affinity': one anti-affinity pod per node over the hostname
+        domain — neither node can absorb the other's pod."""
+        from factories import affinity_term
+        env = make_env()
+        it = cheapest_instance(SPOT)
+        duo = [make_nodeclaim_and_node(env, capacity_type=SPOT,
+                                       instance_type=it) for _ in range(2)]
+        for _, node in duo:
+            p = make_pod(cpu="500m", labels={"app": "exclusive"},
+                         pod_anti_affinity=[affinity_term(
+                             api_labels.LABEL_HOSTNAME,
+                             key="app", value="exclusive")])
+            bind_pod(env, node, p)
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert len(env.nodes()) == 2, "anti-affinity was violated"
+
+
+class TestBudgetMarkerInterplay:
+    """consolidation_test.go:608-860: a budget-blocked pass must NOT mark
+    the cluster consolidated — when budget opens, consolidation proceeds
+    even though nothing else changed."""
+
+    def test_budget_block_does_not_mark_consolidated(self):
+        pool = consolidation_nodepool(budgets=("0",))
+        env = make_env(pool)
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=2)
+        assert env.node_exists(node.name)
+        for m in env.disruption.methods[2:]:
+            assert not m.is_consolidated(), (
+                "budget-blocked pass marked the cluster consolidated")
+        # budget opens; NOTHING else changes — consolidation must fire
+        live_pool = env.store.get(type(pool), "default")
+        live_pool.spec.disruption.budgets = []
+        env.store.update(live_pool)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+
+
+class TestParallelization:
+    """consolidation_test.go:4255-4381."""
+
+    def test_pending_pods_provision_while_consolidating(self):
+        """:4256-4308 'should schedule an additional node when receiving
+        pending pods while consolidating': the TTL wait must not starve
+        the provisioner."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD),
+            allocatable={"cpu": "3", "memory": "12Gi", "pods": "10"})
+        bind_pod(env, node, cpu="2500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        # a burst of pending pods arrives mid-TTL
+        for i in range(3):
+            env.store.create(make_pod(cpu="2000m", name=f"burst-{i}"))
+        env.settle()
+        bound = [p for p in env.store.list(type(make_pod()))
+                 if p.metadata.name.startswith("burst-") and p.spec.node_name]
+        assert len(bound) == 3, "provisioner starved during consolidation TTL"
+
+
+class TestTTLValidation:
+    """consolidation_test.go:3072-3498: the 15 s consolidation TTL and the
+    re-validation races inside it (validation.go:83-215)."""
+
+    def _expensive_node_with_pod(self, env):
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        pod = bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        return nc, node, pod
+
+    def test_command_waits_for_ttl(self):
+        """:3072-3130 'should wait for the node TTL for non-empty nodes
+        before consolidating': after the compute pass the node still
+        exists; it goes only once the TTL elapsed and validation passed."""
+        env = make_env()
+        nc, node, _ = self._expensive_node_with_pod(env)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        assert env.node_exists(node.name), "deleted before the TTL"
+        env.clock.step(7)
+        env.disruption.reconcile()  # mid-TTL: still pending
+        assert env.node_exists(node.name)
+        env.run_disruption()
+        assert not env.node_exists(node.name)
+
+    def test_new_do_not_disrupt_pod_during_ttl_aborts(self):
+        """:3131-3220 'should not consolidate if a do-not-disrupt pod
+        schedules during the TTL wait'."""
+        env = make_env()
+        nc, node, _ = self._expensive_node_with_pod(env)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        guarded = make_pod(cpu="100m")
+        guarded.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        bind_pod(env, node, guarded)
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        env.settle()
+        assert env.node_exists(node.name), "validation missed the new pod"
+
+    def test_new_pdb_during_ttl_aborts(self):
+        """:3221-3300 'should not consolidate if a PDB is added during the
+        TTL wait'."""
+        env = make_env()
+        nc, node, pod = self._expensive_node_with_pod(env)
+        pod.metadata.labels["app"] = "late-guard"
+        env.store.update(pod)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        make_pdb(env, {"app": "late-guard"}, max_unavailable="0")
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        env.settle()
+        assert env.node_exists(node.name)
+
+    def test_nomination_during_ttl_aborts(self):
+        """:3301-3390 'should not consolidate if the candidate is nominated
+        for a pending pod during the TTL wait' (the parallelization race,
+        :4255+)."""
+        env = make_env()
+        nc, node, _ = self._expensive_node_with_pod(env)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        env.cluster.nominate_node_for_pod(node.name, make_pod(cpu="100m"))
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        env.settle()
+        assert env.node_exists(node.name)
+
+    def test_candidate_deleted_during_ttl_aborts(self):
+        """:3391-3498: the candidate vanishing mid-TTL abandons the
+        command instead of crashing."""
+        env = make_env()
+        nc, node, _ = self._expensive_node_with_pod(env)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        env.store.delete(nc)
+        env.settle()
+        env.clock.step(16)
+        env.disruption.reconcile()  # must not raise
+        env.queue.reconcile()
+
+
+class TestMultiNodeClaim:
+    """consolidation_test.go:3499-3984."""
+
+    @pytest.mark.parametrize("spot_to_spot", [False, True])
+    def test_merge_3_nodes_into_1(self, spot_to_spot):
+        """:3545-3657 'can merge 3 nodes into 1': three lightly-loaded
+        expensive nodes collapse into one replacement."""
+        ct = SPOT if spot_to_spot else OD
+        env = make_env(spot_to_spot=spot_to_spot)
+        trio = [make_nodeclaim_and_node(
+            env, capacity_type=ct,
+            instance_type=most_expensive_instance(ct)) for _ in range(3)]
+        for _, node in trio:
+            bind_pod(env, node, cpu="300m")
+        env.clock.step(600)
+        env.run_disruption(rounds=6)
+        assert len(env.nodes()) == 1
+        for _, node in trio:
+            assert not env.node_exists(node.name)
+        assert _it_label(env.nodes()[0]) != most_expensive_instance(ct).name
+
+    def test_wont_merge_2_nodes_into_1_of_same_type(self):
+        """:3658-3740 'won't merge 2 nodes into 1 of the same type':
+        replacing [cheap, cheap] with one cheap node is just deleting one —
+        the delete path handles it; the REPLACE decision must not launch a
+        same-type replacement (multinodeconsolidation.go:180-217)."""
+        env = make_env()
+        it = cheapest_instance(OD)
+        (nc0, node0), (nc1, node1) = [
+            make_nodeclaim_and_node(
+                env, instance_type=it,
+                allocatable={"cpu": "4", "memory": "16Gi", "pods": "100"})
+            for _ in range(2)]
+        # each node half-full: both sets of pods fit on ONE node of the
+        # same type, but a replacement launch of that type is forbidden
+        for node in (node0, node1):
+            bind_pod(env, node, cpu="1500m")
+        env.clock.step(600)
+        env.run_disruption(rounds=6)
+        nodes = env.nodes()
+        assert len(nodes) == 1
+        # delete-not-replace: the survivor is one of the originals
+        assert nodes[0].name in (node0.name, node1.name)
+
+    def test_multi_validation_failure_falls_through(self):
+        """:3813-3984 'should continue to single/multi consolidation when
+        the earlier method fails validation after the node ttl': blocking
+        one candidate mid-TTL doesn't wedge the controller; the next pass
+        still consolidates the other."""
+        env = make_env()
+        (nc0, node0) = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        (nc1, node1) = make_nodeclaim_and_node(
+            env, instance_type=most_expensive_instance(OD))
+        bind_pod(env, node0, cpu="300m")
+        bind_pod(env, node1, cpu="300m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        assert env.disruption.pending is not None
+        # poison node0 mid-TTL
+        guarded = make_pod(cpu="100m")
+        guarded.metadata.annotations[
+            api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        bind_pod(env, node0, guarded)
+        env.clock.step(16)
+        env.disruption.reconcile()
+        env.queue.reconcile()
+        env.settle()
+        assert env.node_exists(node0.name)
+        # subsequent passes consolidate node1 alone
+        env.run_disruption(rounds=6)
+        assert not env.node_exists(node1.name)
+        assert env.node_exists(node0.name)
+
+
+class TestNodeLifetime:
+    """consolidation_test.go:3985-4065 'Node Lifetime Consideration'."""
+
+    def test_prefers_node_nearer_expiry(self):
+        """:3985-4065: with expireAfter set, the candidate ordering weights
+        disruption cost by remaining lifetime — the older node (less
+        lifetime left) consolidates first."""
+        pool = consolidation_nodepool()
+        pool.spec.template.spec.expire_after = 3600.0
+        env = make_env(pool)
+        it = cheapest_instance(SPOT)
+        nc_old, node_old = make_nodeclaim_and_node(
+            env, instance_type=it, capacity_type=SPOT, expire_after=3600.0)
+        env.clock.step(3000)  # old node: 600 s of life left
+        nc_new, node_new = make_nodeclaim_and_node(
+            env, instance_type=it, capacity_type=SPOT, expire_after=3600.0)
+        bind_pod(env, node_old, cpu="500m")
+        bind_pod(env, node_new, cpu="500m")
+        env.clock.step(60)
+        env.settle()
+        # single-node pass: both nodes' pods fit on the other; the OLD one
+        # must be chosen
+        env.run_disruption(rounds=1)
+        if len(env.nodes()) == 2:  # multi pass declined; drive more rounds
+            env.run_disruption(rounds=4)
+        assert env.node_exists(node_new.name)
+        assert not env.node_exists(node_old.name)
+
+
+class TestTopologyConsideration:
+    """consolidation_test.go:4066-4254."""
+
+    def test_zonal_spread_blocks_skew_breaking_delete(self):
+        """:4066-4150 'can replace node maintaining zonal topology spread':
+        three spread pods across three zones; deleting a zone's node would
+        break maxSkew=1, so the replacement must stay in the same zone (or
+        nothing is disrupted) — the pod set never collapses to two zones."""
+        from factories import spread_zone
+        env = make_env()
+        zones = ("test-zone-a", "test-zone-b", "test-zone-c")
+        spread = [spread_zone(key="app", value="spread-demo")]
+        trio = []
+        for z in zones:
+            nc, node = make_nodeclaim_and_node(
+                env, zone=z, instance_type=most_expensive_instance(OD))
+            pod = make_pod(cpu="500m", labels={"app": "spread-demo"},
+                           spread=spread)
+            bind_pod(env, node, pod)
+            trio.append((nc, node, pod))
+        env.clock.step(600)
+        env.run_disruption(rounds=6)
+        # wherever consolidation landed, the spread constraint holds: pods
+        # still cover three distinct zones
+        pod_zones = set()
+        for p in env.store.list(type(make_pod())):
+            if not p.spec.node_name:
+                continue
+            n = env.store.get(Node, p.spec.node_name)
+            if n is not None:
+                pod_zones.add(
+                    n.metadata.labels.get(api_labels.LABEL_TOPOLOGY_ZONE))
+        assert len(pod_zones) == 3, f"skew broken: {pod_zones}"
+
+
+class TestEventsContext:
+    """consolidation_test.go:102-179 'Events'."""
+
+    def test_no_unconsolidatable_event_when_policy_allows(self):
+        """:103-117: WhenEmptyOrUnderutilized + 0s consolidateAfter fires
+        NO ConsolidationDisabled-style event."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(env)
+        env.clock.step(600)
+        env.disruption.reconcile()
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert not any("consolidation disabled" in m for m in msgs), msgs
+
+    def test_unconsolidatable_event_when_when_empty_and_pods(self):
+        """:118-141: WhenEmpty policy + a non-empty node fires the
+        'non-empty consolidation disabled' event from the underutilized
+        methods."""
+        from karpenter_tpu.api.nodepool import WHEN_EMPTY
+        pool = consolidation_nodepool(consolidate_after=60.0)
+        pool.spec.disruption.consolidation_policy = WHEN_EMPTY
+        env = make_env(pool)
+        nc, node = make_nodeclaim_and_node(env)
+        bind_pod(env, node, cpu="500m")
+        env.clock.step(600)
+        env.disruption.reconcile()
+        msgs = [e.message for e in env.events("Unconsolidatable")]
+        assert any("non-empty consolidation disabled" in m for m in msgs), msgs
