@@ -372,6 +372,11 @@ class PackResult:
     existing: Dict[int, list] = field(default_factory=dict)  # node idx -> pods
     errors: Dict[str, str] = field(default_factory=dict)     # pod uid -> error
     cohorts: List[Cohort] = field(default_factory=list)
+    # a nodepool limit excluded capacity during this pack: WHO gets the
+    # scarce budget is order-dependent, so pack errors under limit pressure
+    # are not oracle-final (the production scheduler re-solves on the host
+    # path instead of trusting them; see TensorScheduler._solve)
+    limit_constrained: bool = False
 
 
 def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
@@ -533,6 +538,7 @@ class Packer:
         while placed < n_pods:
             it_fit = it_set & self._under_limits(m, it_set)
             if not it_fit.any():
+                self.result.limit_constrained = True
                 break
             # size the fill from the LIMIT-FILTERED set: per_node came from
             # the unfiltered max-capacity type, which limits may have
@@ -633,13 +639,21 @@ class Packer:
             enc=cohort_enc, pods_by_group={g: fill}))
         return True
 
-    def _cohort_capacity(self, g: int, cohort: Cohort) -> Tuple[int, np.ndarray]:
+    def _cohort_capacity(self, g: int, cohort: Cohort,
+                         zone_override: Optional[int] = None,
+                         extra_mask: Optional[np.ndarray] = None
+                         ) -> Tuple[int, np.ndarray]:
         """Max additional pods of group g per cohort node + surviving it set.
         Negative free capacity floors the per-IT min below zero, which the
-        callers' cap<=0 check treats identically to the old clamp-to-zero."""
-        it_ok = (self.t.it_ok_z[g, cohort.m, :, cohort.zone] if cohort.zone is not None
+        callers' cap<=0 check treats identically to the old clamp-to-zero.
+        zone_override/extra_mask evaluate a PROSPECTIVE zone commitment of a
+        zone-free cohort (see _fill_cohorts) without mutating it."""
+        zone = cohort.zone if zone_override is None else zone_override
+        it_ok = (self.t.it_ok_z[g, cohort.m, :, zone] if zone is not None
                  else self.t.it_ok[g, cohort.m])
         ts = cohort.it_set & it_ok
+        if extra_mask is not None:
+            ts = ts & extra_mask
         if not ts.any():
             return 0, ts
         nz = self._req_nz[g]
@@ -664,8 +678,27 @@ class Packer:
             if remaining <= 0:
                 break
             cohort = self.result.cohorts[ci]
+            commit_zone = False
+            extra_mask = None
             if zone is not None and cohort.zone != zone:
-                continue
+                if cohort.zone is not None:
+                    continue
+                # zone-free cohort: a zonal pod joining an in-flight claim
+                # NARROWS the claim to its zone in the host scheduler
+                # (nodeclaim.go Add intersects requirements) — mirror that
+                # by committing the cohort to this zone, provided every
+                # group already aboard stays feasible there
+                extra_mask = np.ones_like(cohort.it_set)
+                ok = True
+                for gp in cohort.pods_by_group:
+                    if not self.t.zone_adm[gp, cohort.m, zone]:
+                        ok = False
+                        break
+                    extra_mask = extra_mask & \
+                        self.t.it_ok_z[gp, cohort.m, :, zone]
+                if not ok:
+                    continue
+                commit_zone = True
             if zone is None and cohort.zone is not None:
                 # group must admit the cohort's zone; np_compatible handles it
                 pass
@@ -673,7 +706,9 @@ class Packer:
                 continue
             if not np_compatible(cohort.enc, _row(self.p.group_enc, g), allow):
                 continue
-            cap, ts = self._cohort_capacity(g, cohort)
+            cap, ts = self._cohort_capacity(
+                g, cohort, zone_override=zone if commit_zone else None,
+                extra_mask=extra_mask)
             if per_node_cap:
                 existing_fill = cohort.pods_by_group.get(g, 0)
                 cap = min(cap, max(0, per_node_cap - existing_fill))
@@ -682,12 +717,20 @@ class Packer:
             # fill each node of the cohort up to cap; split if not all consumed
             fill_nodes = min(cohort.n, -(-remaining // cap))
             if fill_nodes < cohort.n:
+                # the UNFILLED nodes keep the cohort's original zone state:
+                # only nodes actually receiving zonal pods narrow their zone
                 rest = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
                               requests=cohort.requests.copy(), n=cohort.n - fill_nodes,
                               enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
                 cohort.n = fill_nodes
                 self.result.cohorts.append(rest)
-            per_last = remaining - cap * (fill_nodes - 1)
+            # take at most cap per node: when demand exceeds the cohort's
+            # total capacity (remaining > cap * n), every node takes exactly
+            # cap and the leftover moves on — per_last derived from the raw
+            # remaining overfilled the last node past the per-node cap
+            # (e.g. 14 hostname-spread pods on one node at maxSkew=1)
+            take = min(remaining, cap * fill_nodes)
+            per_last = take - cap * (fill_nodes - 1)
             if per_last != cap and fill_nodes > 1:
                 # last node takes the remainder; split it off
                 last = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
@@ -695,16 +738,30 @@ class Packer:
                               enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
                 cohort.n = fill_nodes - 1
                 self.result.cohorts.append(last)
+                if commit_zone:
+                    self._commit_cohort_zone(cohort, zone)
+                    self._commit_cohort_zone(last, zone)
                 self._commit_to_cohort(last, g, per_last, ts)
                 self._commit_to_cohort(cohort, g, cap, ts)
-                placed = cap * (fill_nodes - 1) + per_last
+                placed = take
             else:
-                fill = min(cap, remaining if fill_nodes == 1 else cap)
+                fill = per_last if fill_nodes == 1 else cap
+                if commit_zone:
+                    self._commit_cohort_zone(cohort, zone)
                 self._commit_to_cohort(cohort, g, fill, ts)
                 placed = fill * fill_nodes
             placed_total += placed
             remaining -= placed
         return placed_total
+
+    def _commit_cohort_zone(self, cohort: Cohort, zone: int) -> None:
+        """Pin a zone-free cohort to a zone: both the zone field AND the
+        encoded requirements narrow (the enc drives offering admission in
+        price ordering and keys the materialize order-cache — a stale
+        all-zones enc would rank unreachable offerings and collide cache
+        entries across differently-pinned cohorts)."""
+        cohort.zone = zone
+        cohort.enc = np_combine(cohort.enc, self._zone_enc(zone))
 
     def _commit_to_cohort(self, cohort: Cohort, g: int, fill: int, ts: np.ndarray):
         cohort.requests = cohort.requests + self.p.group_req[g] * fill
@@ -871,15 +928,23 @@ class Packer:
             if not it_ok.any():
                 continue
             limits = self.template_limits[m]
+            limit_pruned = False
             if limits is not None:
                 it_fit = it_ok & self._under_limits(m, it_ok)
                 if not it_fit.any():
+                    self.result.limit_constrained = True
                     continue
+                limit_pruned = bool((it_fit != it_ok).any())
                 it_ok = it_fit
             # fill sized from the (limit-filtered) surviving set
             per = int(self.t.ppn[g, m][it_ok].max())
             fill = min(per, c)
             if fill <= 0:
+                if limit_pruned:
+                    # the surviving (smaller) types hold zero pods: this
+                    # failure exists only because limits pruned the big
+                    # ones — not an oracle-final verdict
+                    self.result.limit_constrained = True
                 continue
             if not self._append_cohort(g, m, None, it_ok, fill,
                                        self._node_enc(g, m, None)):

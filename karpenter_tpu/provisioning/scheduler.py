@@ -422,6 +422,10 @@ class Results:
     new_nodeclaims: List[InFlightNodeClaim] = field(default_factory=list)
     existing_nodes: List[ExistingNode] = field(default_factory=list)
     pod_errors: Dict[str, str] = field(default_factory=dict)  # pod uid -> error
+    # tensor path only: a nodepool limit excluded capacity during the pack,
+    # so pod_errors are order-dependent rather than oracle-final
+    # (PackResult.limit_constrained; drives the host re-solve guard)
+    limit_constrained: bool = False
 
     def all_pods_scheduled(self) -> bool:
         return not self.pod_errors

@@ -229,6 +229,14 @@ class TensorScheduler:
         # non-relaxable groups are final
         relaxable_err = None
         if results.pod_errors and not self.force_tensor:
+            # errors minted while a nodepool LIMIT was excluding capacity
+            # aren't oracle-final: the greedy order decides who gets the
+            # scarce budget, and the packer's group order can strand a pod
+            # the host's pod order would place — re-solve on the host path
+            # (the oracle). Bounded cost: limits+errors batches are rare.
+            if results.limit_constrained:
+                return self._host_solve(
+                    pods, "pack errors under nodepool limit pressure")
             err_uids = set(results.pod_errors)
             relaxable_err = [
                 g for g in groups
@@ -856,7 +864,8 @@ class TensorScheduler:
             existing.append(TensorExistingNode(self.state_nodes[n], pods))
         errors = dict(pr.errors)
         return Results(new_nodeclaims=new_claims, existing_nodes=existing,
-                       pod_errors=errors)
+                       pod_errors=errors,
+                       limit_constrained=pr.limit_constrained)
 
 
 class _FallbackError(Exception):

@@ -1,0 +1,197 @@
+"""Adversarial tensor-vs-oracle parity fuzzer.
+
+BASELINE.md's north-star clause says the tensor path must land within 2% of
+the solver it replaces; the scenario batteries pin known shapes, this
+fuzzer sweeps the space BETWEEN them: seeded random pods x pools x zones x
+taints x spreads x affinities, solved by both paths, asserting
+
+- exact agreement on WHICH pods fail (by name, not just count), and
+- node-count delta <= max(1, 2%).
+
+Every case is seed-pinned (deterministic rng), so a divergence reproduces
+by running its seed. The generator stays inside the tensor kernel's
+supported feature set (zone/hostname spreads, zone/hostname affinity,
+hostname anti-affinity, selectors, taints) with per-deployment unique
+label values — kernel-unsupported shapes have their own fallback tests in
+test_partition.py / test_binpack_parity.py.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import Taint, Toleration
+from karpenter_tpu.cloudprovider import kwok
+
+from factories import (affinity_term, make_nodepool, make_pod,
+                       make_scheduler, spread_hostname, spread_zone)
+from test_binpack_parity import host_solve, tensor_solve
+
+ZONES = ("test-zone-a", "test-zone-b", "test-zone-c")
+CPUS = ("100m", "250m", "500m", "1", "1500m", "2", "3")
+MEMS = ("128Mi", "256Mi", "512Mi", "1Gi", "2Gi", "4Gi")
+
+
+def gen_nodepools(rng: random.Random):
+    pools = []
+    n_pools = rng.choice((1, 1, 1, 2, 2, 3))
+    for i in range(n_pools):
+        kwargs = {"name": f"pool-{i}"}
+        if rng.random() < 0.35:
+            kwargs["taints"] = [Taint(key=f"team-{i}", value="x")]
+        if rng.random() < 0.3:
+            from karpenter_tpu.api.objects import NodeSelectorRequirement
+            zones = rng.sample(ZONES, rng.choice((1, 2)))
+            kwargs["requirements"] = [NodeSelectorRequirement(
+                key=api_labels.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=tuple(zones))]
+        if rng.random() < 0.25:
+            kwargs["limits"] = {"cpu": str(rng.choice((8, 16, 64)))}
+        kwargs["weight"] = rng.choice((None, 1, 10, 50))
+        pools.append(make_nodepool(**kwargs))
+    return pools
+
+
+def gen_pods(rng: random.Random, pools):
+    """2-6 deployments of 3-18 pods each; every deployment gets its own
+    label value so selectors never span groups (a kernel support
+    boundary with its own fallback tests)."""
+    pods = []
+    n_deploys = rng.randint(2, 6)
+    for d in range(n_deploys):
+        n = rng.randint(3, 18)
+        label_val = f"d{d}"
+        kwargs = {
+            "cpu": rng.choice(CPUS),
+            "memory": rng.choice(MEMS),
+            "labels": {"app": label_val},
+        }
+        tainted = [p for p in pools if p.spec.template.spec.taints]
+        if tainted and rng.random() < 0.5:
+            kwargs["tolerations"] = [
+                Toleration(key=t.key, operator="Exists")
+                for p in tainted for t in p.spec.template.spec.taints]
+        if rng.random() < 0.25:
+            kwargs["node_selector"] = {
+                api_labels.LABEL_TOPOLOGY_ZONE: rng.choice(ZONES)}
+        shape = rng.random()
+        if shape < 0.2:
+            kwargs["spread"] = [spread_zone(
+                max_skew=rng.choice((1, 1, 2)), key="app", value=label_val)]
+        elif shape < 0.3:
+            kwargs["spread"] = [spread_hostname(
+                max_skew=1, key="app", value=label_val)]
+        elif shape < 0.4:
+            kwargs["pod_affinity"] = [affinity_term(
+                rng.choice((api_labels.LABEL_TOPOLOGY_ZONE,
+                            api_labels.LABEL_HOSTNAME)),
+                key="app", value=label_val)]
+        elif shape < 0.5:
+            kwargs["pod_anti_affinity"] = [affinity_term(
+                api_labels.LABEL_HOSTNAME, key="app", value=label_val)]
+        if rng.random() < 0.06:
+            kwargs["cpu"] = "1000"  # unschedulable: no type holds 1000 cores
+        for i in range(n):
+            pods.append(make_pod(name=f"fz-{d}-{i:03d}", **kwargs))
+    return pods
+
+
+def gen_catalog(rng: random.Random):
+    its = kwok.construct_instance_types()
+    n = rng.choice((24, 48, 96, 144))
+    if n >= len(its):
+        return its
+    # a contiguous prefix keeps small/large family balance; an offset adds
+    # variety without dropping every small type
+    off = rng.choice((0, 0, 4, 8))
+    return its[off:off + n]
+
+
+def names(pods):
+    return sorted(p.metadata.name for p in pods)
+
+
+def error_names(results, pods):
+    by_uid = {p.uid: p.metadata.name for p in pods}
+    return sorted(by_uid.get(uid, uid) for uid in results.pod_errors)
+
+
+def run_seed(seed: int):
+    """The PRODUCTION parity contract per scenario:
+
+    1. If the production TensorScheduler fell back (documented reasons
+       only: limit-pressure errors, relaxable preferences, inexpressible
+       batch), its results ARE host results — exact equality.
+    2. Otherwise tensor pod_errors must be a SUBSET of the oracle's, by
+       name: the tensor path never strands a pod the oracle places.
+    3. With equal error sets, node count within max(1, 2%) (BASELINE.md
+       north-star clause).
+    4. A strict subset (tensor places MORE pods) happens only when the
+       oracle's greedy order strands required-affinity pods behind a
+       shared in-flight claim — pinned in DEVIATIONS.md — and then the
+       extra placements may add nodes, so the bound widens by the number
+       of extra pods placed.
+    """
+    from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+    rng = random.Random(seed)
+    pools = gen_nodepools(rng)
+    its = {p.name: gen_catalog(rng) for p in pools}
+    # identical pod batches for each path: the generator is deterministic
+    # per seed, and solving mutates pod state (topology records,
+    # preference relaxation), so each path gets its own copy
+    pods_t = gen_pods(random.Random(seed + 1), pools)
+    pods_h = gen_pods(random.Random(seed + 1), pools)
+    assert names(pods_t) == names(pods_h)
+    ts = TensorScheduler(pools, its)  # production config: fallback armed
+    t = ts.solve(pods_t)
+    h = host_solve(pools, its, pods_h)
+    et, eh = error_names(t, pods_t), error_names(h, pods_h)
+    th, hh = len(t.new_nodeclaims), len(h.new_nodeclaims)
+    if ts.fallback_reason:
+        # host-solved: byte-identical verdicts expected
+        assert et == eh, (seed, ts.fallback_reason)
+        assert th == hh, (seed, ts.fallback_reason, th, hh)
+        return th, hh
+    assert set(et) <= set(eh), (
+        seed, f"tensor stranded pods the oracle places: "
+        f"{sorted(set(et) - set(eh))[:5]}")
+    extra_placed = len(set(eh) - set(et))
+    if extra_placed == 0:
+        assert abs(th - hh) <= max(1, round(0.02 * hh)), (seed, th, hh)
+    else:
+        # oracle strandings (DEVIATIONS: affinity-group co-pack): the
+        # affinity groups involved must actually exist, and the node bound
+        # widens by the extra pods placed
+        assert any(p.spec.affinity is not None for p in pods_t), seed
+        assert abs(th - hh) <= max(1, round(0.02 * hh)) + extra_placed, \
+            (seed, th, hh, extra_placed)
+    return th, hh
+
+
+# seed-pinned corpus: any failure names its seed for replay
+@pytest.mark.parametrize("seed", list(range(1000, 1040)))
+def test_fuzz_parity(seed):
+    run_seed(seed)
+
+
+def test_fuzz_covers_the_feature_space():
+    """Meta-check: across the pinned seeds the generator actually exercised
+    multi-pool, taints, selectors, spreads, affinities, and unschedulable
+    pods — guarding against a silent generator regression that would turn
+    the fuzzer into a trivial-parity rubber stamp."""
+    saw = {"multi_pool": False, "taints": False, "selector": False,
+           "spread": False, "affinity": False, "unschedulable": False}
+    for seed in range(1000, 1040):
+        rng = random.Random(seed)
+        pools = gen_nodepools(rng)
+        pods = gen_pods(random.Random(seed + 1), pools)
+        saw["multi_pool"] |= len(pools) > 1
+        saw["taints"] |= any(p.spec.template.spec.taints for p in pools)
+        saw["selector"] |= any(p.spec.node_selector for p in pods)
+        saw["spread"] |= any(p.spec.topology_spread_constraints for p in pods)
+        saw["affinity"] |= any(p.spec.affinity is not None for p in pods)
+        saw["unschedulable"] |= any(
+            p.requests().get("cpu", 0) >= 1000_000 for p in pods)
+    missing = [k for k, v in saw.items() if not v]
+    assert not missing, f"fuzzer never generated: {missing}"
