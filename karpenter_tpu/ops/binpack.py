@@ -456,7 +456,9 @@ class Packer:
                  exist_counts: Optional[np.ndarray] = None,
                  host_match_total: Optional[np.ndarray] = None,
                  vol_group_counts: Optional[list] = None,
-                 vol_node_remaining: Optional[list] = None):
+                 vol_node_remaining: Optional[list] = None,
+                 group_ports: Optional[list] = None,
+                 exist_port_block: Optional[np.ndarray] = None):
         self.p = p
         self.t = t
         self.groups = groups
@@ -485,6 +487,24 @@ class Packer:
         # draws down the same driver budget.
         self.vol_group_counts = vol_group_counts
         self.vol_node_remaining = vol_node_remaining
+        # host-port semantics, tensorized (hostportusage.go:34-90):
+        # group_ports[g] = (ip, port, protocol) triples or (); identical
+        # specs mean any two pods of a port group conflict -> one pod per
+        # node; a precomputed GxG matrix gates cross-group co-location and
+        # exist_port_block[G, N] excludes nodes already using the ports
+        self.group_ports = group_ports
+        self.exist_port_block = exist_port_block
+        if group_ports is not None and any(group_ports):
+            from ..scheduling.hostports import triples_conflict
+            pg = [g for g in range(self.G) if group_ports[g]]
+            self._port_conflict = np.zeros((self.G, self.G), dtype=bool)
+            for i, gi in enumerate(pg):
+                for gj in pg[i:]:
+                    if triples_conflict(group_ports[gi], group_ports[gj]):
+                        self._port_conflict[gi, gj] = True
+                        self._port_conflict[gj, gi] = True
+        else:
+            self._port_conflict = None
         # domain-name tie-break order for zone selection (host parity)
         self._zone_names = np.array(p.vocab.values[p.zone_key], dtype=object)
         self.result = PackResult()
@@ -706,6 +726,10 @@ class Packer:
                 continue
             if not np_compatible(cohort.enc, _row(self.p.group_enc, g), allow):
                 continue
+            if self._port_conflict is not None and any(
+                    self._port_conflict[g, gp]
+                    for gp in cohort.pods_by_group):
+                continue  # a conflicting host port is already bound aboard
             cap, ts = self._cohort_capacity(
                 g, cohort, zone_override=zone if commit_zone else None,
                 extra_mask=extra_mask)
@@ -863,6 +887,34 @@ class Packer:
             return 1, np.where(cnt > 0, 0, 1)
         return 0, np.where(cnt > 0, 0, INT32_MAX)
 
+    def _apply_port_caps(self, g: int, per_node_cap: int,
+                         node_caps: Optional[np.ndarray]
+                         ) -> Tuple[int, Optional[np.ndarray]]:
+        """Identical host-port specs all conflict pairwise, so a port group
+        holds at most ONE pod per node (fresh or existing), and nodes whose
+        current pods already bind a conflicting port are out entirely."""
+        if not self.group_ports or not self.group_ports[g]:
+            return per_node_cap, node_caps
+        per_node_cap = 1 if per_node_cap == 0 else min(per_node_cap, 1)
+        caps = np.ones(self.exist_avail.shape[0], dtype=np.int64)
+        if self.exist_port_block is not None:
+            # the block covers the REAL nodes; exist_avail may be padded
+            blocked = np.nonzero(self.exist_port_block[g])[0]
+            caps[blocked] = 0
+        # ports bound onto existing nodes EARLIER IN THIS PACK (the
+        # pre-solve block can't know them): any conflicting group already
+        # placed on a node takes that node out (scheduler.py:329 semantics
+        # — the oracle updates usage per placement)
+        if self._port_conflict is not None:
+            for n, fills in self.result.existing.items():
+                for g2, _fill in fills:
+                    if self._port_conflict[g, g2]:
+                        caps[n] = 0
+                        break
+        if node_caps is not None:
+            caps = np.minimum(caps, node_caps)
+        return per_node_cap, caps
+
     def _pack_group(self, g: int) -> None:
         group = self.groups[g]
         c = group.count
@@ -880,6 +932,8 @@ class Packer:
             self._pack_affinity_host(g, c)  # always alone (grouping)
             return
         per_node_cap, node_caps = self._host_caps(g, host_spec)
+        per_node_cap, node_caps = self._apply_port_caps(g, per_node_cap,
+                                                        node_caps)
 
         if zone_spec is None:
             placed = self._fill_existing(g, c, None, per_node_cap, node_caps)
@@ -900,8 +954,8 @@ class Packer:
                                               node_caps)
         elif zone_spec.kind == "affinity-zone":
             self._pack_affinity_zone(g, c, zone_spec, per_node_cap, node_caps)
-        else:  # anti-zone (always alone)
-            self._pack_anti_zone(g, c, zone_spec)
+        else:  # anti-zone (always alone among zone kinds)
+            self._pack_anti_zone(g, c, zone_spec, per_node_cap, node_caps)
 
     def _place_new(self, g: int, remaining: int, zone: Optional[int],
                    per_node_cap: int) -> int:
@@ -1083,7 +1137,9 @@ class Packer:
         if placed < c:
             self._error_group(g, c - placed, "zonal pod affinity: zone capacity exhausted")
 
-    def _pack_anti_zone(self, g: int, c: int, spec) -> None:
+    def _pack_anti_zone(self, g: int, c: int, spec,
+                        per_node_cap: int = 0,
+                        node_caps: Optional[np.ndarray] = None) -> None:
         """Zonal anti-affinity: pods may only land in EMPTY domains
         (topologygroup.go:316-342). Self-selecting: each placement occupies a
         zone, and peers in the same batch are mutually excluded but not yet
@@ -1096,7 +1152,7 @@ class Packer:
         if spec.self_select:
             placed = 0
             for z in np.where(empty)[0]:
-                placed = self._fill_zone(g, 1, int(z), 0, None)
+                placed = self._fill_zone(g, 1, int(z), per_node_cap, node_caps)
                 if placed:
                     self.zone_counts[g, z] += 1
                     break
@@ -1110,7 +1166,8 @@ class Packer:
         for z in np.where(empty)[0]:
             if placed >= c:
                 break
-            placed += self._fill_zone(g, c - placed, int(z), 0, None)
+            placed += self._fill_zone(g, c - placed, int(z), per_node_cap,
+                                      node_caps)
         if placed < c:
             self._error_group(g, c - placed, "unsatisfiable zonal anti-affinity")
 

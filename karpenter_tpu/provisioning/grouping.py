@@ -85,6 +85,12 @@ class PodGroup:
     labels: dict
     topo: List[TopoSpec] = field(default_factory=list)
     has_relaxable: bool = False       # preferred affinities / ScheduleAnyway present
+    # (ip, port, protocol) triples shared by every pod of the group
+    # (identical specs): within the group any two pods conflict on the same
+    # node, so the packer caps host-port groups at one pod per node and
+    # excludes cross-group/existing-node conflicts
+    # (hostportusage.go:34-90 semantics, tensorized)
+    host_ports: tuple = ()
 
     @property
     def count(self) -> int:
@@ -96,6 +102,14 @@ def _req_signature(reqs: Requirements):
         (k, reqs.get(k).complement, frozenset(reqs.get(k).values),
          reqs.get(k).greater_than, reqs.get(k).less_than, reqs.get(k).min_values)
         for k in reqs))
+
+
+def _port_triples(pod: Pod) -> tuple:
+    """Canonical (ip, port, protocol) triples (hostportusage.go entry shape;
+    an unset hostIP binds the wildcard)."""
+    from ..scheduling.hostports import WILDCARD
+    return tuple((hp.host_ip or WILDCARD, hp.port, hp.protocol)
+                 for hp in pod.spec.host_ports)
 
 
 def _selector_is_self(selector, labels: dict) -> bool:
@@ -209,7 +223,25 @@ def group_pods(pods: List[Pod]) -> "Tuple[Optional[List[PodGroup]], str]":
     return groups, ""
 
 
-def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None):
+def _batch_conflicted_port_keys(pods: List[Pod]) -> set:
+    """(port, protocol) keys used by 2+ batch pods with overlapping IPs
+    (wildcard or duplicate). Users of such a key pairwise conflict
+    (hostportusage.go:56-60); a key used once — or by distinct specific
+    IPs only — constrains nothing within the batch."""
+    by_pp: Dict[tuple, list] = {}
+    for pod in pods:
+        for ip, port, proto in _port_triples(pod):
+            by_pp.setdefault((port, proto), []).append(ip)
+    from ..scheduling.hostports import WILDCARD
+    bad = set()
+    for key, ips in by_pp.items():
+        if len(ips) > 1 and (WILDCARD in ips or len(set(ips)) < len(ips)):
+            bad.add(key)
+    return bad
+
+
+def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None,
+                   port_occupied=None):
     """Returns (groups, leftover_pods, reason): every pod lands on exactly
     one side. `groups` are tensor-eligible equivalence classes; `leftover`
     pods carry constraint shapes only the host oracle understands (host
@@ -229,6 +261,44 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
     equal-content specs that this signature reunifies)."""
     groups: Dict = {}
     order: List = []
+    # host-port eligibility (round 5): with a ``port_occupied`` checker the
+    # caller vouches for existing-node usage, and ports that conflict with
+    # NOTHING (batch-unique, unoccupied) constrain nothing — their pods
+    # merge into ordinary groups instead of exploding G into single-pod
+    # port groups. Without the checker (prefix sim, dryrun), port pods
+    # demote to the host path wholesale, exactly the round-4 behavior.
+    any_ports = any(p.spec.host_ports for p in pods) or (
+        prebuckets is not None and any(
+            b and b[0].spec.host_ports for b in prebuckets))
+    bad_port_keys = ()
+    if any_ports and port_occupied is not None:
+        bad_port_keys = _batch_conflicted_port_keys(
+            pods if prebuckets is None else
+            [p for b in prebuckets for p in b])
+
+    _port_sig_memo: Dict[tuple, object] = {}
+
+    def port_sig(pod):
+        """() when the pod's ports constrain nothing; the triples when they
+        conflict (capped per-spec group); None -> demote (no checker).
+        Memoized by triples: port_occupied scans every state node's usage,
+        and identical specs (a deployment) must not re-pay that per pod."""
+        triples = _port_triples(pod)
+        if not triples:
+            return ()
+        if port_occupied is None:
+            return None
+        out = _port_sig_memo.get(triples, _port_sig_memo)
+        if out is not _port_sig_memo:
+            return out
+        if any((port, proto) in bad_port_keys
+               for _, port, proto in triples) or port_occupied(triples):
+            out = triples
+        else:
+            out = ()
+        _port_sig_memo[triples] = out
+        return out
+
     # structural tokens memoized by sub-object identity: pods stamped from one
     # deployment share their spec sub-objects, so the expensive structural
     # hashing runs once per deployment, not once per pod — and the per-pod
@@ -266,13 +336,14 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
                    tuple(tuple(sorted(r.items()))
                          for r in probe.container_requests),
                    tuple(_init_sig(r) for r in probe.init_container_requests),
-                   not probe.spec.host_ports,
+                   port_sig(probe),
                    () if not probe.spec.volumes
                    else tuple(probe.spec.volumes))
             g = groups.get(sig)
             if g is None:
+                psig = port_sig(probe)
                 reason = ""
-                if probe.spec.host_ports:
+                if psig is None:
                     reason = "host ports require per-pod conflict tracking"
                 elif not all(ref.ephemeral for ref in probe.spec.volumes):
                     reason = ("persistent volume claims shared across pods "
@@ -280,12 +351,18 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
                 specs, relaxable = _classify_topology(probe)
                 if specs is None and not reason:
                     reason = "unsupported topology constraint shape"
+                elif psig and specs and any(
+                        sp.kind == AFFINITY_HOST for sp in specs) and not reason:
+                    # co-location demanded, >1/node forbidden: host-path only
+                    reason = ("host ports with hostname pod-affinity need "
+                              "per-pod host tracking")
                 g = PodGroup(pods=[], requirements=pod_requirements(probe),
                              requests=probe.requests(),
                              tolerations=tuple(probe.spec.tolerations),
                              labels=dict(probe.labels), topo=specs or [],
                              has_relaxable=relaxable
-                             or has_preferred_node_affinity(probe))
+                             or has_preferred_node_affinity(probe),
+                             host_ports=psig or ())
                 if reason:
                     reasons[id(g)] = reason
                 groups[sig] = g
@@ -319,15 +396,18 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
             rt,
             () if not pod.init_container_requests
             else tuple(tok(r, init_key) for r in pod.init_container_requests),
-            not spec.host_ports,
+            # port status keys the bucket: conflicting port specs must not
+            # merge; constraint-free ports vanish from the signature
+            () if not spec.host_ports else port_sig(pod),
             # volume content keys the bucket: ephemeral groups with distinct
             # storage classes must not merge (different CSI drivers/caps)
             () if not spec.volumes else tuple(spec.volumes),
         )
         g = groups.get(sig)
         if g is None:
+            psig = port_sig(pod)
             reason = ""
-            if spec.host_ports:
+            if psig is None:
                 reason = "host ports require per-pod conflict tracking"
             elif not all(ref.ephemeral for ref in spec.volumes):
                 # ephemeral volumes tensorize exactly: each pod brings its
@@ -340,11 +420,16 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
             specs, relaxable = _classify_topology(pod)
             if specs is None and not reason:
                 reason = "unsupported topology constraint shape"
+            elif psig and specs and any(
+                    sp.kind == AFFINITY_HOST for sp in specs) and not reason:
+                reason = ("host ports with hostname pod-affinity need "
+                          "per-pod host tracking")
             g = PodGroup(pods=[], requirements=pod_requirements(pod),
                          requests=pod.requests(),
                          tolerations=tuple(pod.spec.tolerations),
                          labels=dict(pod.labels), topo=specs or [],
-                         has_relaxable=relaxable or has_preferred_node_affinity(pod))
+                         has_relaxable=relaxable or has_preferred_node_affinity(pod),
+                         host_ports=psig or ())
             if reason:
                 reasons[id(g)] = reason
             groups[sig] = g

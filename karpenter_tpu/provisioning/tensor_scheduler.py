@@ -213,7 +213,18 @@ class TensorScheduler:
             return self._solve(pods, prebuckets)
 
     def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
-        groups, leftover, reason = partition_pods(pods, prebuckets=prebuckets)
+        # port eligibility needs existing-node usage: a port occupied on a
+        # live node makes its pods CONFLICTED (capped groups with per-node
+        # exclusion) instead of constraint-free
+        if self.state_nodes:
+            usages = [sn.host_port_usage() for sn in self.state_nodes]
+
+            def port_occupied(triples):
+                return any(u.conflicts_triples(triples) for u in usages)
+        else:
+            port_occupied = lambda triples: False  # noqa: E731
+        groups, leftover, reason = partition_pods(
+            pods, prebuckets=prebuckets, port_occupied=port_occupied)
         self.partition = (sum(g.count for g in groups), len(leftover))
         if not groups:
             return self._host_solve(pods, reason)
@@ -305,6 +316,11 @@ class TensorScheduler:
                         and en._store is not None:
                     from ..scheduling.volumeusage import get_volumes
                     en._volume_usage.add(get_volumes(en._store, p))
+                # seed port usage too: a host-side port pod must see the
+                # slots the tensor pass just bound (hostportusage.go:34-90)
+                if p.spec.host_ports:
+                    from ..scheduling.hostports import get_host_ports
+                    en._host_port_usage.add(p, get_host_ports(p))
         tmpl_idx = {t.nodepool_name: i for i, t in enumerate(host.templates)}
         for tnc in tensor_results.new_nodeclaims:
             i = tmpl_idx.get(tnc.template.nodepool_name)
@@ -319,6 +335,9 @@ class TensorScheduler:
             for p in nc.pods:
                 host.topology.record(p, nc.requirements,
                                      ALLOW_UNDEFINED_WELL_KNOWN)
+                if p.spec.host_ports:
+                    from ..scheduling.hostports import get_host_ports
+                    nc.host_port_usage.add(p, get_host_ports(p))
             host.new_nodeclaims.append(nc)
             remaining = host.remaining_resources.get(nct.nodepool_name)
             if remaining is not None:
@@ -669,6 +688,15 @@ class TensorScheduler:
 
     def _tensor_solve(self, groups: List[PodGroup], pods: List[Pod]) -> Results:
         self.fallback_reason = ""
+        if any(p.spec.host_ports for p in self.daemonset_pods) and any(
+                p.spec.host_ports for p in pods):
+            # daemonset ports occupy EVERY node of a template; modeling
+            # that per-template exclusion stays host-side (rare combo).
+            # Checked against PODS, not groups: a batch-unique port pod
+            # carries group.host_ports=() yet still binds its port — it
+            # must not slip past this guard onto a daemonset's port
+            raise _FallbackError(
+                "daemonset host ports need per-pod conflict tracking")
         problem, templates, catalog = self.build_problem(groups)
         vocab = problem.vocab
         zone_key = problem.zone_key
@@ -712,12 +740,29 @@ class TensorScheduler:
             exist_counts = pad_exist_counts(problem, exist_counts)
         vol_group_counts, vol_node_remaining = \
             self._volume_limit_state(groups)
+        group_ports = None
+        exist_port_block = None
+        if any(g.host_ports for g in groups):
+            group_ports = [g.host_ports for g in groups]
+            if self.state_nodes:
+                # indexed by the problem's exist-node order (= state_nodes
+                # position, the space _fill_existing's node_caps[n] uses)
+                exist_port_block = np.zeros(
+                    (len(groups), len(self.state_nodes)), dtype=bool)
+                for gi, gp in enumerate(group_ports):
+                    if not gp:
+                        continue
+                    for ni, sn in enumerate(self.state_nodes):
+                        exist_port_block[gi, ni] = \
+                            sn.host_port_usage().conflicts_triples(gp)
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
                                 initial_zone_counts=izc, exist_order=sn_order,
                                 exist_counts=exist_counts,
                                 host_match_total=host_total,
                                 vol_group_counts=vol_group_counts,
-                                vol_node_remaining=vol_node_remaining)
+                                vol_node_remaining=vol_node_remaining,
+                                group_ports=group_ports,
+                                exist_port_block=exist_port_block)
         pr = packer.pack()
         return self._materialize(pr, problem, groups, templates, catalog,
                                  vocab, zone_key)

@@ -11,7 +11,13 @@ from typing import List
 
 from ..api.objects import HostPort, Pod
 
-_WILDCARD = "0.0.0.0"
+WILDCARD = _WILDCARD = "0.0.0.0"
+
+
+def ips_overlap(a: str, b: str) -> bool:
+    """The ONE ip-overlap rule (hostportusage.go:56-60): equal, or either
+    side binds the wildcard. Every conflict predicate routes through it."""
+    return a == b or a == _WILDCARD or b == _WILDCARD
 
 
 @dataclass(frozen=True)
@@ -24,7 +30,7 @@ class _Entry:
     def conflicts(self, other: "_Entry") -> bool:
         if self.port != other.port or self.protocol != other.protocol:
             return False
-        return self.ip == other.ip or self.ip == _WILDCARD or other.ip == _WILDCARD
+        return ips_overlap(self.ip, other.ip)
 
 
 def get_host_ports(pod: Pod) -> "list[_Entry]":
@@ -72,3 +78,24 @@ class HostPortUsage:
         out = HostPortUsage()
         out._by_port = {k: list(v) for k, v in self._by_port.items()}
         return out
+
+    def conflicts_triples(self, triples) -> bool:
+        """Conflict check for anonymous (ip, port, protocol) triples — the
+        tensor packer's existing-node exclusion (no pod identity: a group's
+        ports either fit a node or they don't)."""
+        for ip, port, protocol in triples:
+            for e in self._by_port.get((port, protocol), ()):
+                if ips_overlap(ip, e.ip):
+                    return True
+        return False
+
+
+def triples_conflict(a, b) -> bool:
+    """Whether any port of triple-set a conflicts with any of b
+    (hostportusage.go:56-60 pairwise: port+protocol equal and IPs overlap
+    via the wildcard)."""
+    for ip1, port1, proto1 in a:
+        for ip2, port2, proto2 in b:
+            if port1 == port2 and proto1 == proto2 and ips_overlap(ip1, ip2):
+                return True
+    return False

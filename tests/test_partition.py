@@ -24,14 +24,63 @@ class TestPartitionPods:
         groups, leftover, reason = partition_pods(pods)
         assert len(groups) == 2 and not leftover and reason == ""
 
-    def test_host_port_pods_split_out(self):
+    def test_unique_host_ports_merge_into_ordinary_groups(self):
+        """Host-port pods tensorize (round 5): batch-unique unoccupied
+        ports constrain nothing, so their pods merge into the same group
+        as port-free pods of identical spec instead of exploding G."""
         plain = make_pods(10, cpu="100m")
         ported = [make_pod(cpu="100m", host_ports=[HostPort(port=8080 + i)])
                   for i in range(3)]
-        groups, leftover, reason = partition_pods(plain + ported)
-        assert sum(g.count for g in groups) == 10
-        assert len(leftover) == 3
-        assert "host port" in reason
+        groups, leftover, reason = partition_pods(
+            plain + ported, port_occupied=lambda t: False)
+        assert sum(g.count for g in groups) == 13
+        assert not leftover
+        assert not any(g.host_ports for g in groups)
+
+    def test_conflicting_host_ports_make_capped_groups(self):
+        """The same (port, protocol) used twice conflicts: those pods form
+        per-spec groups carrying their triples (one pod per node)."""
+        clash = [make_pod(cpu="100m", labels={"app": f"c{i}"},
+                          host_ports=[HostPort(port=9000)])
+                 for i in range(2)]
+        groups, leftover, reason = partition_pods(
+            clash, port_occupied=lambda t: False)
+        assert not leftover
+        assert len(groups) == 2
+        assert all(g.host_ports == (("0.0.0.0", 9000, "TCP"),)
+                   for g in groups)
+
+    def test_occupied_port_makes_capped_group(self):
+        """A port in use on an existing node flips its pods to conflicted
+        even when batch-unique."""
+        pod = make_pod(cpu="100m", host_ports=[HostPort(port=8080)])
+        groups, leftover, reason = partition_pods(
+            [pod], port_occupied=lambda t: any(p == 8080 for _, p, _ in t))
+        assert not leftover
+        [g] = groups
+        assert g.host_ports == (("0.0.0.0", 8080, "TCP"),)
+
+    def test_without_checker_port_pods_demote(self):
+        """Callers that can't vouch for existing-node usage (prefix sim,
+        dryrun via group_pods) keep the round-4 demotion."""
+        ported = [make_pod(cpu="100m", host_ports=[HostPort(port=8080)])]
+        groups, leftover, reason = partition_pods(ported)
+        assert not groups and len(leftover) == 1
+        assert "host ports require per-pod conflict tracking" in reason
+
+    def test_host_port_with_hostname_affinity_demotes(self):
+        from factories import affinity_term
+        ported = [make_pod(cpu="100m", labels={"app": "x"},
+                           host_ports=[HostPort(port=8080)],
+                           pod_affinity=[affinity_term(
+                               api_labels.LABEL_HOSTNAME,
+                               key="app", value="x")])
+                  for _ in range(2)]
+        groups, leftover, reason = partition_pods(
+            ported, port_occupied=lambda t: False)
+        assert not groups
+        assert len(leftover) == 2
+        assert "host ports with hostname pod-affinity" in reason
 
     def test_coupled_groups_both_demoted(self):
         # A's spread selector {tier=x} self-matches AND matches B's labels:
@@ -77,7 +126,8 @@ class TestPartitionedSolve:
         ts = TensorScheduler([pool], {"default": its})
         r = ts.solve(plain + spreadp + ported)
         assert not r.pod_errors
-        assert ts.partition == (52, 4)
+        # host-port pods tensorize now: the whole batch rides the kernel
+        assert ts.partition == (56, 0)
         assert ts.fallback_reason == ""
         placed = sum(len(nc.pods) for nc in r.new_nodeclaims) + \
             sum(len(en.pods) for en in r.existing_nodes)
@@ -172,3 +222,149 @@ class TestPartitionedSolve:
         launched = sum(nc.requests.get("cpu", 0) for nc in r.new_nodeclaims)
         biggest = max(it.capacity.get("cpu", 0) for it in its)
         assert launched <= 8000 + biggest
+
+
+class TestTensorHostPorts:
+    """hostportusage.go:34-90 semantics on the tensor path (round 5): same
+    port+protocol with overlapping IPs conflicts; distinct protocols, ports,
+    or disjoint specific IPs coexist; existing usage excludes nodes. Every
+    scenario asserts tensor-vs-host parity (fallback_reason stays empty)."""
+
+    def _solve(self, pods, state_nodes=()):
+        ts = TensorScheduler([make_nodepool()], {"default": _its()},
+                             state_nodes=list(state_nodes),
+                             force_tensor=True)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        return r
+
+    def _host(self, pods, state_nodes=()):
+        s = make_scheduler([make_nodepool()], _its(), pods,
+                           state_nodes=list(state_nodes))
+        return s.solve(pods)
+
+    def test_same_port_group_one_pod_per_node(self):
+        pods = [make_pod(cpu="100m", name=f"p-{i}",
+                         host_ports=[HostPort(port=8080)])
+                for i in range(5)]
+        t = self._solve(pods)
+        assert not t.pod_errors
+        assert len(t.new_nodeclaims) == 5
+        for nc in t.new_nodeclaims:
+            assert len(nc.pods) == 1
+        h = self._host([make_pod(cpu="100m", name=f"h-{i}",
+                                 host_ports=[HostPort(port=8080)])
+                        for i in range(5)])
+        assert len(h.new_nodeclaims) == 5
+
+    def test_conflicting_groups_never_share_a_node(self):
+        a = [make_pod(cpu="100m", labels={"app": "a"}, name=f"a-{i}",
+                      host_ports=[HostPort(port=9000)]) for i in range(3)]
+        b = [make_pod(cpu="200m", labels={"app": "b"}, name=f"b-{i}",
+                      host_ports=[HostPort(port=9000)]) for i in range(3)]
+        t = self._solve(a + b)
+        assert not t.pod_errors
+        for nc in t.new_nodeclaims:
+            ported = [p for p in nc.pods if p.spec.host_ports]
+            assert len(ported) <= 1, "port 9000 double-booked on one node"
+
+    def test_distinct_ports_can_share_a_node(self):
+        a = [make_pod(cpu="100m", labels={"app": "a"}, name=f"a-{i}",
+                      host_ports=[HostPort(port=9000)]) for i in range(2)]
+        b = [make_pod(cpu="100m", labels={"app": "b"}, name=f"b-{i}",
+                      host_ports=[HostPort(port=9001)]) for i in range(2)]
+        filler = make_pods(6, cpu="100m")
+        t = self._solve(a + b + filler)
+        assert not t.pod_errors
+        # a 9000-pod and a 9001-pod may legally co-locate; the solve must
+        # not open one node per ported pod when ports don't clash
+        per_node = [sum(1 for p in nc.pods if p.spec.host_ports)
+                    for nc in t.new_nodeclaims]
+        assert max(per_node, default=0) >= 2
+
+    def test_different_protocols_do_not_conflict(self):
+        a = [make_pod(cpu="100m", labels={"app": "a"}, name=f"a-{i}",
+                      host_ports=[HostPort(port=9000, protocol="TCP")])
+             for i in range(2)]
+        b = [make_pod(cpu="100m", labels={"app": "b"}, name=f"b-{i}",
+                      host_ports=[HostPort(port=9000, protocol="UDP")])
+             for i in range(2)]
+        t = self._solve(a + b + make_pods(4, cpu="100m"))
+        assert not t.pod_errors
+        per_node = [sum(1 for p in nc.pods if p.spec.host_ports)
+                    for nc in t.new_nodeclaims]
+        assert max(per_node, default=0) >= 2
+
+    def test_disjoint_specific_ips_do_not_conflict(self):
+        a = [make_pod(cpu="100m", labels={"app": "a"}, name="ip-a",
+                      host_ports=[HostPort(port=9000, host_ip="10.0.0.1")])]
+        b = [make_pod(cpu="100m", labels={"app": "b"}, name="ip-b",
+                      host_ports=[HostPort(port=9000, host_ip="10.0.0.2")])]
+        t = self._solve(a + b + make_pods(4, cpu="100m"))
+        assert not t.pod_errors
+        per_node = [sum(1 for p in nc.pods if p.spec.host_ports)
+                    for nc in t.new_nodeclaims]
+        assert max(per_node, default=0) >= 2
+
+    def test_wildcard_conflicts_with_specific_ip(self):
+        a = [make_pod(cpu="100m", labels={"app": "a"}, name="w-a",
+                      host_ports=[HostPort(port=9000)])]  # 0.0.0.0
+        b = [make_pod(cpu="100m", labels={"app": "b"}, name="w-b",
+                      host_ports=[HostPort(port=9000, host_ip="10.0.0.1")])]
+        t = self._solve(a + b)
+        assert not t.pod_errors
+        for nc in t.new_nodeclaims:
+            assert sum(1 for p in nc.pods if p.spec.host_ports) <= 1
+
+    def test_existing_node_port_occupancy_excludes_node(self):
+        from factories import make_state_node
+        from karpenter_tpu.scheduling.hostports import get_host_ports
+        sn = make_state_node("live-1", cpu="8", memory="16Gi")
+        occupant = make_pod(cpu="100m", name="occupant",
+                            host_ports=[HostPort(port=8080)])
+        sn.host_port_usage().add(occupant, get_host_ports(occupant))
+        newcomer = make_pod(cpu="100m", name="newcomer",
+                            host_ports=[HostPort(port=8080)])
+        t = self._solve([newcomer], state_nodes=[sn])
+        assert not t.pod_errors
+        # the live node's port is taken: a fresh node must open
+        assert not any(en.pods for en in t.existing_nodes)
+        assert len(t.new_nodeclaims) == 1
+        # a non-conflicting port lands on the live node
+        other = make_pod(cpu="100m", name="other",
+                         host_ports=[HostPort(port=9090)])
+        t2 = self._solve([other], state_nodes=[make_state_node(
+            "live-2", cpu="8", memory="16Gi")])
+        assert not t2.pod_errors
+
+    def test_port_mix_parity_with_host_oracle(self):
+        """The bench shape: 10% host-port stragglers now ride the kernel;
+        node counts track the oracle within the 2% clause."""
+        def batch(tag):
+            plain = make_pods(36, cpu="500m", memory="512Mi")
+            ported = [make_pod(cpu="100m", name=f"{tag}-{i}",
+                               host_ports=[HostPort(port=8000 + (i % 3))])
+                      for i in range(4)]
+            return plain + ported
+        t = self._solve(batch("t"))
+        h = self._host(batch("h"))
+        assert len(t.pod_errors) == len(h.pod_errors) == 0
+        th, hh = len(t.new_nodeclaims), len(h.new_nodeclaims)
+        assert abs(th - hh) <= max(1, round(0.02 * hh)), (th, hh)
+
+    def test_conflicting_groups_never_share_an_existing_node(self):
+        """Two conflicting port groups against ONE live node with headroom:
+        the second group must see the port the first bound mid-pack (the
+        pre-solve occupancy snapshot can't know it)."""
+        from factories import make_state_node
+        sn = make_state_node("live-big", cpu="32", memory="64Gi")
+        a = make_pod(cpu="100m", labels={"app": "a"}, name="exa",
+                     host_ports=[HostPort(port=9000)])
+        b = make_pod(cpu="200m", labels={"app": "b"}, name="exb",
+                     host_ports=[HostPort(port=9000)])
+        t = self._solve([a, b], state_nodes=[sn])
+        assert not t.pod_errors
+        ported_on_live = sum(
+            1 for en in t.existing_nodes for p in en.pods
+            if p.spec.host_ports)
+        assert ported_on_live <= 1, "port 9000 double-booked on live node"
