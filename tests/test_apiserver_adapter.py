@@ -132,6 +132,140 @@ class TestNodeAndClaimRoundTrip:
                                                      3600.0)
 
 
+class TestEnvtest:
+    """The adapter + codec + admission against a LIVE HTTP apiserver in the
+    default suite (kube/envtest.py — the reference's envtest strategy,
+    pkg/test/environment.go:41-49). No gate, no cluster."""
+
+    @pytest.fixture
+    def env_store(self):
+        from karpenter_tpu.kube.apiserver import KubeApiStore
+        from karpenter_tpu.kube.envtest import EnvtestServer
+        from karpenter_tpu.utils.clock import Clock
+        with EnvtestServer() as srv:
+            store = KubeApiStore(srv.url, clock=Clock())
+            store._envtest = srv
+            yield store
+            store.stop_watches()
+
+    def test_crud_round_trip_over_http(self, env_store):
+        pod = make_pod(cpu="250m", name="envtest-pod", labels={"app": "x"})
+        env_store.create(pod)
+        live = env_store.get(Pod, "envtest-pod", "default")
+        assert live is not None and live.labels == {"app": "x"}
+        assert live.metadata.uid and live.metadata.resource_version
+        live.spec.node_name = "some-node"
+        env_store.update(live)
+        again = env_store.get(Pod, "envtest-pod", "default")
+        assert again.spec.node_name == "some-node"
+        env_store.delete(again)
+        assert env_store.get(Pod, "envtest-pod", "default") is None
+
+    def test_stale_resource_version_conflicts(self, env_store):
+        from karpenter_tpu.kube.store import ConflictError
+        node = Node(metadata=ObjectMeta(name="rv-node", namespace=""),
+                    spec=NodeSpec(provider_id="t://rv"))
+        env_store.create(node)
+        first = env_store.get(Node, "rv-node")
+        env_store.update(env_store.get(Node, "rv-node"))  # bumps RV
+        first.metadata.labels["stale"] = "write"
+        with pytest.raises(ConflictError):
+            env_store.update(first)
+
+    def test_finalizer_gates_deletion(self, env_store):
+        node = Node(metadata=ObjectMeta(name="fin-node", namespace="",
+                                        finalizers=["karpenter.sh/test"]),
+                    spec=NodeSpec(provider_id="t://fin"))
+        env_store.create(node)
+        env_store.delete(env_store.get(Node, "fin-node"))
+        live = env_store.get(Node, "fin-node")
+        assert live is not None, "finalized object removed prematurely"
+        assert live.metadata.deletion_timestamp is not None
+        env_store.remove_finalizer(live, "karpenter.sh/test")
+        assert env_store.get(Node, "fin-node") is None
+
+    def test_admission_rejects_over_http(self, env_store):
+        from karpenter_tpu.kube.store import InvalidError
+        bad = make_nodepool(name="bad-pool")
+        bad.spec.disruption.budgets = [Budget(nodes="150%")]
+        with pytest.raises(InvalidError):
+            env_store.create(bad)
+        assert env_store.get(NodePool, "bad-pool") is None
+
+    def test_recorder_sink_posts_real_events(self, env_store):
+        from karpenter_tpu.events.catalog import evict_pod
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.utils.clock import FakeClock
+        rec = Recorder(FakeClock(), sink=env_store.post_event)
+        rec.publish(evict_pod(make_pod(name="evicted-pod")))
+        [ev] = env_store._envtest.state.events
+        assert ev["reason"] == "Evicted"
+        assert ev["involvedObject"]["name"] == "evicted-pod"
+        assert ev["source"] == {"component": "karpenter"}
+
+    def test_watch_streams_store_changes(self, env_store):
+        import time as _time
+        seen = []
+        env_store.watch(seen.append)
+        env_store.start_watches(kinds=(Pod,))
+        env_store.create(make_pod(cpu="100m", name="watched-pod"))
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            env_store.pump_events()
+            if any(e.obj.metadata.name == "watched-pod" for e in seen):
+                break
+            _time.sleep(0.05)
+        assert any(e.obj.metadata.name == "watched-pod" for e in seen), \
+            "watch stream never delivered the pod"
+
+    def test_operator_provision_loop_e2e(self, env_store):
+        """The full loop against the live wire: NodePool + pending Pod in,
+        NodeClaim launched, Node fabricated, pod bound — the gated
+        TestLiveApiserver scenario, un-gated (round 5 item 7)."""
+        import time as _time
+
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import Manager
+        from karpenter_tpu.controllers.nodeclaim_lifecycle import \
+            NodeClaimLifecycle
+        from karpenter_tpu.provisioning.provisioner import (Binder,
+                                                            PodTrigger,
+                                                            Provisioner)
+        from karpenter_tpu.state.cluster import Cluster
+        from karpenter_tpu.state.informers import wire_informers
+        from karpenter_tpu.utils.clock import Clock
+
+        store = env_store
+        clock = Clock()
+        cluster = Cluster(store, clock)
+        wire_informers(store, cluster)
+        provider = KwokCloudProvider(store=store)
+        mgr = Manager(store, clock)
+        provisioner = Provisioner(store, cluster, provider, clock)
+        mgr.register(provisioner, PodTrigger(provisioner),
+                     Binder(store, cluster, provisioner),
+                     NodeClaimLifecycle(store, cluster, provider, clock))
+        store.start_watches()
+        store.apply(make_nodepool(name="envtest-default"))
+        pod = make_pod(cpu="100m", name="envtest-e2e-pod")
+        store.apply(pod)
+        deadline = _time.time() + 60
+        bound = None
+        while _time.time() < deadline:
+            store.pump_events()
+            mgr.run_until_quiet()
+            live = store.get(Pod, pod.metadata.name, pod.metadata.namespace)
+            if live is not None and live.spec.node_name:
+                bound = live
+                break
+            _time.sleep(0.2)
+        assert bound is not None, "pod never bound through the apiserver"
+        claims = store.list(NodeClaim)
+        assert any(c.metadata.labels.get(api_labels.NODEPOOL_LABEL_KEY)
+                   == "envtest-default" for c in claims)
+        assert store.list(Node), "no node materialized through the wire"
+
+
 _E2E = os.environ.get("KARPENTER_TPU_KUBE_E2E", "")
 
 
