@@ -272,6 +272,45 @@ class TensorScheduler:
             final.pod_errors.setdefault(uid, err)
         return final
 
+    def _explain_errors(self, errors: Dict[str, str], groups, templates
+                        ) -> None:
+        """Error-message parity for the kernel's generic verdicts: when a
+        group failed because NO template's requirements admit it, rewrite
+        'no instance type satisfied the pod' into the host oracle's
+        per-nodepool incompatibility string (scheduler.py:600-621) —
+        including the near-miss label hints (requirements.go:189-251) that
+        operators debug typos with."""
+        explained: Dict[int, Optional[str]] = {}
+        uid_group = {p.uid: gi for gi, g in enumerate(groups)
+                     for p in g.pods}
+        for uid, msg in errors.items():
+            if msg != "no instance type satisfied the pod":
+                continue
+            gi = uid_group.get(uid)
+            if gi is None:
+                continue
+            if gi not in explained:
+                parts = []
+                for nct in templates:
+                    errs = nct.requirements.compatible(
+                        groups[gi].requirements, ALLOW_UNDEFINED_WELL_KNOWN)
+                    if errs:
+                        # byte-for-byte the host oracle's string:
+                        # scheduler.py:614 wraps scheduler.py:122's
+                        # "incompatible requirements, {first error}"
+                        # (nodeclaim.go:83 wraps the same way)
+                        parts.append(
+                            f'incompatible with nodepool '
+                            f'"{nct.nodepool_name}", incompatible '
+                            f'requirements, {errs[0]}')
+                # only a FULLY requirement-incompatible group gets the
+                # rewrite: with any compatible template the failure is
+                # resource-shaped and the generic message is the truth
+                explained[gi] = ("; ".join(parts)
+                                 if len(parts) == len(templates) else None)
+            if explained[gi]:
+                errors[uid] = explained[gi]
+
     def _host_solve(self, pods: List[Pod], reason: str) -> Results:
         self.fallback_reason = reason
         return self._make_host(pods).solve(pods)
@@ -908,6 +947,8 @@ class TensorScheduler:
                 pods.extend(take(g, fill))
             existing.append(TensorExistingNode(self.state_nodes[n], pods))
         errors = dict(pr.errors)
+        if errors:
+            self._explain_errors(errors, groups, templates)
         return Results(new_nodeclaims=new_claims, existing_nodes=existing,
                        pod_errors=errors,
                        limit_constrained=pr.limit_constrained)

@@ -93,7 +93,8 @@ class Requirements:
 
     def compatible(self, incoming: "Requirements",
                    allow_undefined: frozenset = frozenset()) -> "list[str]":
-        """requirements.go:175-187."""
+        """requirements.go:175-187; unknown keys carry a near-miss hint
+        (requirements.go:232-251)."""
         errs = []
         for key in incoming._map:
             if key in allow_undefined:
@@ -101,7 +102,8 @@ class Requirements:
             op = incoming.get(key).operator()
             if key in self._map or op in (NOT_IN, DOES_NOT_EXIST):
                 continue
-            errs.append(f'label "{key}" does not have known values')
+            errs.append(f'label "{key}" does not have known values'
+                        f'{label_hint(self, key, allow_undefined)}')
         errs.extend(self.intersects(incoming))
         return errs
 
@@ -128,6 +130,50 @@ class Requirements:
         parts = sorted(repr(r) for k, r in self._map.items()
                        if k not in api_labels.RESTRICTED_LABELS)
         return ", ".join(parts)
+
+
+def edit_distance(s: str, t: str) -> int:
+    """The reference's editDistance (requirements.go:190-226, a DPV-style
+    two-row DP) transcribed EXACTLY — including its quirks: iteration from
+    index 1 and a current-row first cell that is never set to i, so
+    deleting a prefix of `s` costs 0. Not true Levenshtein, deliberately:
+    the < len/5 hint threshold was tuned against this function's outputs,
+    and "fixing" it would change which labels get hints."""
+    m, n = len(s), len(t)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    prev = [0] * n
+    cur = [0] * n
+    for j in range(1, n):
+        prev[j] = j
+    for i in range(1, m):
+        for j in range(1, n):
+            diff = 0 if s[i] == t[j] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + diff)
+        prev, cur = cur, prev
+    return prev[n - 1]
+
+
+def _suffix(key: str) -> str:
+    """requirements.go:228-231 getSuffix: the part after the first '/'."""
+    before, sep, after = key.partition("/")
+    return after if sep else before
+
+
+def label_hint(r: "Requirements", key: str,
+               allowed_undefined=frozenset()) -> str:
+    """requirements.go:233-251 labelHint: suggest the well-known (or
+    already-required) key the user probably meant — substring containment,
+    edit distance under a fifth of the target length, or a shared suffix."""
+    for pool in (allowed_undefined, r._map):
+        for known in sorted(pool):  # deterministic (Go ranges a map)
+            if key in known or edit_distance(key, known) < len(known) // 5:
+                return f' (typo of "{known}"?)'
+            if known.endswith(_suffix(key)):
+                return f' (typo of "{known}"?)'
+    return ""
 
 
 ALLOW_UNDEFINED_WELL_KNOWN = api_labels.WELL_KNOWN_LABELS
