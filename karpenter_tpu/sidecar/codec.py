@@ -345,11 +345,36 @@ def encode_pod_rows(pods):
             id_memo[id(obj)] = t
         return t
 
+    # run-length fast path: deployment stamps arrive in contiguous runs of
+    # identical specs, so comparing against the PREVIOUS pod's sub-objects
+    # (id for interned members, C-level dict/list equality for per-pod
+    # stamped copies) resolves most rows without building the key tuple
+    prev = None
+    prev_t = 0
     for i, p in enumerate(pods):
         spec = p.spec
         meta = p.metadata
         labels = meta.labels
         reqs = p.container_requests
+        if prev is not None and (
+                spec.affinity is prev.spec.affinity
+                and spec.topology_spread_constraints
+                == prev.spec.topology_spread_constraints
+                and spec.tolerations == prev.spec.tolerations
+                and spec.node_selector == prev.spec.node_selector
+                and labels == prev.metadata.labels
+                and reqs == prev.container_requests
+                and p.init_container_requests
+                == prev.init_container_requests
+                and spec.host_ports == prev.spec.host_ports
+                and spec.volumes == prev.spec.volumes
+                and meta.namespace == prev.metadata.namespace
+                and spec.priority == prev.spec.priority
+                and p.is_daemonset_pod == prev.is_daemonset_pod
+                and meta.annotations == prev.metadata.annotations):
+            tmpl_idx[i] = prev_t
+            ts[i] = meta.creation_timestamp
+            continue
         key = (
             -1 if spec.affinity is None else id(spec.affinity),
             tuple(map(id, spec.topology_spread_constraints)),
@@ -381,6 +406,7 @@ def encode_pod_rows(pods):
             templates.append(d)
         tmpl_idx[i] = t
         ts[i] = p.metadata.creation_timestamp
+        prev, prev_t = p, t
     return templates, tmpl_idx, ts
 
 

@@ -378,3 +378,56 @@ class TestSessionProtocol:
         assert back[0].spec is back[1].spec  # fully shared spec per template
         assert back[0]._row == 0 and back[7]._row == 7
         assert back[0].requests() == a[0].requests()
+
+
+class TestEncodeRowsFastPath:
+    """encode_pod_rows' run-length fast path must stay exactly as
+    discriminating as the slow-path key: consecutive pods differing in ANY
+    keyed field must not merge, and a shuffled batch (no runs, pure slow
+    path) must produce content-identical per-pod templates."""
+
+    def _variants(self):
+        from karpenter_tpu.api.objects import HostPort, PVCRef, Toleration
+        from factories import (affinity_term, make_pod, spread_zone)
+        base = dict(cpu="100m", memory="128Mi")
+        return [
+            make_pod(**base),
+            make_pod(cpu="200m", memory="128Mi"),
+            make_pod(**base, labels={"app": "x"}),
+            make_pod(**base, node_selector={"k": "v"}),
+            make_pod(**base, tolerations=[Toleration(key="t",
+                                                     operator="Exists")]),
+            make_pod(**base, labels={"app": "s"},
+                     spread=[spread_zone(key="app", value="s")]),
+            make_pod(**base, labels={"app": "a"},
+                     pod_affinity=[affinity_term(
+                         "topology.kubernetes.io/zone", key="app",
+                         value="a")]),
+            make_pod(**base, host_ports=[HostPort(port=9000)]),
+            make_pod(**base, namespace="other"),
+        ]
+
+    def test_adjacent_differing_pods_never_merge(self):
+        from karpenter_tpu.sidecar.codec import encode_pod_rows
+        variants = self._variants()
+        templates, idx, _ts = encode_pod_rows(variants)
+        assert len(set(idx.tolist())) == len(variants), (
+            "fast path merged pods the slow-path key separates")
+
+    def test_shuffled_batch_agrees_with_run_ordered(self):
+        import random
+        from karpenter_tpu.sidecar.codec import encode_pod_rows
+        rng = random.Random(7)
+        runs = []
+        for v in self._variants():
+            runs.extend([v] * 5)  # contiguous runs: fast path exercised
+        shuffled = list(runs)
+        rng.shuffle(shuffled)  # no runs: slow path everywhere
+        t1, i1, _ = encode_pod_rows(runs)
+        t2, i2, _ = encode_pod_rows(shuffled)
+        by_pod_1 = {id(p): t1[t] for p, t in zip(runs, i1.tolist())}
+        by_pod_2 = {id(p): t2[t] for p, t in zip(shuffled, i2.tolist())}
+        for pid in by_pod_1:
+            assert by_pod_1[pid] == by_pod_2[pid], (
+                "fast path assigned different template CONTENT than the "
+                "slow path for the same pod")
