@@ -112,6 +112,39 @@ def _port_triples(pod: Pod) -> tuple:
                  for hp in pod.spec.host_ports)
 
 
+def _demotion_reason(pod: Pod, psig, specs) -> str:
+    """The ONE place tensor-ineligibility is decided for a bucket (both the
+    prebucket fast path and the per-pod loop call it — a rule added to only
+    one copy would silently split their verdicts). Ordered by precedence."""
+    if psig is None:
+        return "host ports require per-pod conflict tracking"
+    if not all(ref.ephemeral for ref in pod.spec.volumes):
+        # ephemeral volumes tensorize exactly: each pod brings its own
+        # per-pod claim, so a group's CSI attach consumption is a per-node
+        # linear cap (volumeusage.go:187-220). Shared PVCs / pre-bound PVs
+        # keep set-dedup + PV-affinity semantics only the host models.
+        return ("persistent volume claims shared across pods "
+                "require host-side limit tracking")
+    if specs is None:
+        return "unsupported topology constraint shape"
+    if psig and any(sp.kind == AFFINITY_HOST for sp in specs):
+        # co-location demanded, >1/node forbidden: host-path only
+        return ("host ports with hostname pod-affinity need "
+                "per-pod host tracking")
+    if any(sp.kind in ZONE_KINDS for sp in specs) \
+            and has_preferred_node_affinity(pod):
+        # kube keeps preferences OUT of spread-domain arithmetic
+        # (topology_test.go:1299-1322), but pod_requirements folds the
+        # heaviest preferred term — on ANY key, and any folded term can
+        # shrink the feasible zone set through pool interactions — into
+        # the group's requirement view. Zonal topology + any preference
+        # therefore rides the host relaxation ladder, whose strict
+        # requirements get this exactly right.
+        return ("node-affinity preferences with zonal topology need "
+                "the host relaxation ladder")
+    return ""
+
+
 def _selector_is_self(selector, labels: dict) -> bool:
     return selector is not None and selector.matches(labels)
 
@@ -342,20 +375,8 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
             g = groups.get(sig)
             if g is None:
                 psig = port_sig(probe)
-                reason = ""
-                if psig is None:
-                    reason = "host ports require per-pod conflict tracking"
-                elif not all(ref.ephemeral for ref in probe.spec.volumes):
-                    reason = ("persistent volume claims shared across pods "
-                              "require host-side limit tracking")
                 specs, relaxable = _classify_topology(probe)
-                if specs is None and not reason:
-                    reason = "unsupported topology constraint shape"
-                elif psig and specs and any(
-                        sp.kind == AFFINITY_HOST for sp in specs) and not reason:
-                    # co-location demanded, >1/node forbidden: host-path only
-                    reason = ("host ports with hostname pod-affinity need "
-                              "per-pod host tracking")
+                reason = _demotion_reason(probe, psig, specs)
                 g = PodGroup(pods=[], requirements=pod_requirements(probe),
                              requests=probe.requests(),
                              tolerations=tuple(probe.spec.tolerations),
@@ -406,24 +427,8 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
         g = groups.get(sig)
         if g is None:
             psig = port_sig(pod)
-            reason = ""
-            if psig is None:
-                reason = "host ports require per-pod conflict tracking"
-            elif not all(ref.ephemeral for ref in spec.volumes):
-                # ephemeral volumes tensorize exactly: each pod brings its
-                # own per-pod claim, so a group's CSI attach consumption is
-                # a per-node linear cap (volumeusage.go:187-220). Shared
-                # PVCs / pre-bound PVs keep set-dedup + PV-affinity
-                # semantics only the host oracle models.
-                reason = ("persistent volume claims shared across pods "
-                          "require host-side limit tracking")
             specs, relaxable = _classify_topology(pod)
-            if specs is None and not reason:
-                reason = "unsupported topology constraint shape"
-            elif psig and specs and any(
-                    sp.kind == AFFINITY_HOST for sp in specs) and not reason:
-                reason = ("host ports with hostname pod-affinity need "
-                          "per-pod host tracking")
+            reason = _demotion_reason(pod, psig, specs)
             g = PodGroup(pods=[], requirements=pod_requirements(pod),
                          requests=pod.requests(),
                          tolerations=tuple(pod.spec.tolerations),
