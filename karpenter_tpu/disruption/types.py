@@ -105,7 +105,18 @@ def new_candidate(now: float, node: StateNode, pods_on_node: List[Pod],
 
 
 def _validate_pods_disruptable(pods: List[Pod], pdb_limits: Limits):
-    """statenode.go:215-232: blocking PDBs and do-not-disrupt pods."""
+    """statenode.go:215-232: blocking do-not-disrupt pods, then PDBs.
+
+    The do-not-disrupt sweep covers EVERY active pod — the reference
+    explicitly lets mirror pods and daemonsets block disruption through
+    the annotation (statenode.go:221-223) while terminal/terminating pods
+    never do. The PDB sweep then covers only evictable pods (mirror pods
+    are exempt; daemonset pods are not)."""
+    for p in pods:
+        if pod_utils.is_active(p) and not pod_utils.is_disruptable(p):
+            return PodBlockEvictionError(
+                f"pod {p.namespace}/{p.name} has the "
+                f'"{api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY}" annotation')
     for p in pods:
         if not pod_utils.is_evictable(p):
             continue
@@ -113,10 +124,6 @@ def _validate_pods_disruptable(pods: List[Pod], pdb_limits: Limits):
         if not ok:
             return PodBlockEvictionError(
                 f'pdb "{pdb.namespace}/{pdb.name}" prevents pod evictions')
-        if not pod_utils.is_disruptable(p):
-            return PodBlockEvictionError(
-                f"pod {p.namespace}/{p.name} has the "
-                f'"{api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY}" annotation')
     return None
 
 

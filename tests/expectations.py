@@ -26,7 +26,7 @@ from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_DRIFTED,
                                          COND_REGISTERED, NodeClaim,
                                          NodeClaimSpec, NodeClaimStatus)
 from karpenter_tpu.api.nodepool import NODEPOOL_HASH_VERSION, Budget, NodePool
-from karpenter_tpu.api.objects import (LabelSelector, Node, NodeSpec,
+from karpenter_tpu.api.objects import (LabelSelector, Node, NodeSpec, Taint,
                                        NodeStatus, ObjectMeta, Pod)
 from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
 from karpenter_tpu.cloudprovider.kwok import (KwokCloudProvider,
@@ -238,10 +238,17 @@ def make_nodeclaim_and_node(
         nc_annotations.setdefault(
             api_labels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY,
             NODEPOOL_HASH_VERSION)
+    # initialized=False must SURVIVE the roster: the lifecycle controller
+    # would stamp the initialized label on the next reconcile, so an
+    # uncleared startup taint holds initialization off (initialization.go
+    # requires startup taints gone)
+    startup_taints = [] if initialized else [
+        Taint(key="fab.test/uninitialized", value="true")]
     nc = NodeClaim(
         metadata=ObjectMeta(name=name, labels=dict(labels),
                             annotations=nc_annotations),
-        spec=NodeClaimSpec(expire_after=expire_after),
+        spec=NodeClaimSpec(expire_after=expire_after,
+                           startup_taints=list(startup_taints)),
         status=NodeClaimStatus(provider_id=pid, node_name=name,
                                capacity=dict(alloc),
                                allocatable=dict(alloc)))
@@ -265,7 +272,7 @@ def make_nodeclaim_and_node(
                             # (lifecycle:173-174); without it a delete
                             # skips the drain entirely
                             finalizers=[api_labels.TERMINATION_FINALIZER]),
-        spec=NodeSpec(provider_id=pid),
+        spec=NodeSpec(provider_id=pid, taints=list(startup_taints)),
         status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)))
     env.provider.created[pid] = (nc, node)
     env.store.create(nc)

@@ -1,0 +1,332 @@
+"""Candidate-gating corpus, ported from
+/root/reference/pkg/controllers/disruption/suite_test.go:635-1660 — the
+NewCandidate eligibility tables (do-not-disrupt across pod classes, PDB
+exemptions, TerminationGracePeriod x disruption-class interplay, budget
+counting) plus the disruption-cost ordering rules (:781-852). Go ranges
+cited per test; candidates come from the expectations harness and are
+probed through disruption.helpers.get_candidates directly.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import COND_INSTANCE_TERMINATING
+from karpenter_tpu.api.objects import OwnerReference
+from karpenter_tpu.disruption.helpers import (build_disruption_budget_mapping,
+                                              get_candidates)
+from karpenter_tpu.utils import disruption as disruption_utils
+
+from expectations import (OD, SPOT, bind_pod, cheapest_instance,
+                          consolidation_nodepool, make_env,
+                          make_nodeclaim_and_node, make_pdb)
+from factories import make_nodepool, make_pod
+
+DND = api_labels.DO_NOT_DISRUPT_ANNOTATION_KEY
+
+
+def candidates(env, disruption_class="graceful"):
+    return get_candidates(env.cluster, env.provisioner, lambda c: True,
+                          disruption_class=disruption_class,
+                          recorder=env.recorder)
+
+
+def _owned_by(kind, name="owner"):
+    return [OwnerReference(kind=kind, name=name, uid=f"{kind}-{name}",
+                           controller=True)]
+
+
+class TestDoNotDisruptPodClasses:
+    """suite_test.go:853-1214."""
+
+    def _node_with(self, env, pod):
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD))
+        bind_pod(env, node, pod)
+        env.clock.step(60)
+        return nc, node
+
+    def test_plain_dnd_pod_blocks_graceful(self):
+        """:853-880."""
+        env = make_env()
+        p = make_pod(cpu="100m")
+        p.metadata.annotations[DND] = "true"
+        self._node_with(env, p)
+        assert not candidates(env)
+
+    def test_dnd_mirror_pod_blocks(self):
+        """:881-918: 'We will allow Mirror Pods ... to block disruption
+        using this annotation' (statenode.go:221-223)."""
+        env = make_env()
+        p = make_pod(cpu="100m")
+        p.metadata.annotations[DND] = "true"
+        p.metadata.owner_refs = _owned_by("Node")
+        self._node_with(env, p)
+        assert not candidates(env)
+
+    def test_dnd_daemonset_pod_blocks(self):
+        """:919-957."""
+        env = make_env()
+        p = make_pod(cpu="100m")
+        p.metadata.annotations[DND] = "true"
+        p.metadata.owner_refs = _owned_by("DaemonSet")
+        self._node_with(env, p)
+        assert not candidates(env)
+
+    def test_dnd_terminating_pod_does_not_block(self):
+        """:1147-1176: a pod already terminating isn't active — its
+        annotation is moot."""
+        env = make_env()
+        p = make_pod(cpu="100m")
+        p.metadata.annotations[DND] = "true"
+        nc, node = self._node_with(env, p)
+        live = env.store.get(type(p), p.metadata.name, p.metadata.namespace)
+        live.metadata.deletion_timestamp = env.clock.now()
+        env.store.update(live)
+        assert len(candidates(env)) == 1
+
+    @pytest.mark.parametrize("phase", ["Succeeded", "Failed"])
+    def test_dnd_terminal_pod_does_not_block(self, phase):
+        """:1177-1214."""
+        env = make_env()
+        p = make_pod(cpu="100m")
+        p.metadata.annotations[DND] = "true"
+        nc, node = self._node_with(env, p)
+        live = env.store.get(type(p), p.metadata.name, p.metadata.namespace)
+        live.status.phase = phase
+        env.store.update(live)
+        assert len(candidates(env)) == 1
+
+    def test_dnd_node_annotation_blocks(self):
+        """:1215-1237 (validate_node_disruptable)."""
+        env = make_env()
+        make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD),
+            annotations={DND: "true"})
+        env.clock.step(60)
+        assert not candidates(env)
+
+
+class TestPDBPodClasses:
+    """suite_test.go:1238-1513."""
+
+    def _guarded_node(self, env, owner_kind=None, phase=None,
+                      terminating=False):
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD))
+        p = make_pod(cpu="100m", labels={"app": "pdb-guard"})
+        if owner_kind:
+            p.metadata.owner_refs = _owned_by(owner_kind)
+        bind_pod(env, node, p)
+        make_pdb(env, {"app": "pdb-guard"}, max_unavailable="0")
+        if phase or terminating:
+            live = env.store.get(type(p), p.metadata.name,
+                                 p.metadata.namespace)
+            if phase:
+                live.status.phase = phase
+            if terminating:
+                live.metadata.deletion_timestamp = env.clock.now()
+            env.store.update(live)
+        env.clock.step(60)
+        return nc, node
+
+    def test_blocking_pdb_blocks(self):
+        """:1238-1273."""
+        env = make_env()
+        self._guarded_node(env)
+        assert not candidates(env)
+
+    def test_blocking_pdb_on_daemonset_pod_blocks(self):
+        """:1274-1320: daemonset pods are NOT PDB-exempt."""
+        env = make_env()
+        self._guarded_node(env, owner_kind="DaemonSet")
+        assert not candidates(env)
+
+    def test_blocking_pdb_on_mirror_pod_does_not_block(self):
+        """:1321-1366: mirror pods are exempt from PDB gating."""
+        env = make_env()
+        self._guarded_node(env, owner_kind="Node")
+        assert len(candidates(env)) == 1
+
+    def test_blocking_pdb_on_terminal_pod_does_not_block(self):
+        """:1432-1475."""
+        env = make_env()
+        self._guarded_node(env, phase="Succeeded")
+        assert len(candidates(env)) == 1
+
+    def test_blocking_pdb_on_terminating_pod_does_not_block(self):
+        """:1476-1513."""
+        env = make_env()
+        self._guarded_node(env, terminating=True)
+        assert len(candidates(env)) == 1
+
+
+class TestTGPClassInterplay:
+    """suite_test.go:958-1146: TerminationGracePeriod flips do-not-disrupt
+    and PDB blockers ONLY for the eventual class."""
+
+    def _tgp_node(self, env, tgp, blocker):
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD))
+        if tgp is not None:
+            nc.spec.termination_grace_period = tgp
+            env.store.update(nc)
+        p = make_pod(cpu="100m", labels={"app": "tgp"})
+        if blocker == "dnd":
+            p.metadata.annotations[DND] = "true"
+        bind_pod(env, node, p)
+        if blocker == "pdb":
+            make_pdb(env, {"app": "tgp"}, max_unavailable="0")
+        env.clock.step(60)
+
+    @pytest.mark.parametrize("blocker", ["dnd", "pdb"])
+    def test_tgp_unblocks_eventual(self, blocker):
+        """:958-1018: TGP set -> eventual-class candidates form despite
+        the blocker."""
+        env = make_env()
+        self._tgp_node(env, 300.0, blocker)
+        assert len(candidates(env, disruption_class="eventual")) == 1
+
+    @pytest.mark.parametrize("blocker", ["dnd", "pdb"])
+    def test_tgp_does_not_unblock_graceful(self, blocker):
+        """:1019-1083."""
+        env = make_env()
+        self._tgp_node(env, 300.0, blocker)
+        assert not candidates(env, disruption_class="graceful")
+
+    @pytest.mark.parametrize("blocker", ["dnd", "pdb"])
+    def test_no_tgp_blocks_eventual_too(self, blocker):
+        """:1084-1146."""
+        env = make_env()
+        self._tgp_node(env, None, blocker)
+        assert not candidates(env, disruption_class="eventual")
+
+
+class TestCandidateEligibility:
+    """suite_test.go:1514-1660."""
+
+    def test_node_only_representation_excluded(self):
+        """:1514-1532: a bare Node (no claim) is unmanaged."""
+        from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                               ObjectMeta)
+        from karpenter_tpu.utils import resources as res
+        env = make_env()
+        alloc = res.parse_list({"cpu": "4", "memory": "8Gi", "pods": "100"})
+        env.store.create(Node(
+            metadata=ObjectMeta(name="bare", labels={
+                api_labels.LABEL_HOSTNAME: "bare"}),
+            spec=NodeSpec(provider_id="bare://1"),
+            status=NodeStatus(capacity=dict(alloc), allocatable=alloc)))
+        env.settle()
+        env.clock.step(60)
+        assert not candidates(env)
+
+    def test_nominated_candidate_excluded(self):
+        """:1552-1572."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD))
+        env.clock.step(60)
+        env.cluster.nominate_node_for_pod(node.name, make_pod(cpu="100m"))
+        assert not candidates(env)
+
+    def test_uninitialized_candidate_excluded(self):
+        """:1616-1635."""
+        env = make_env()
+        make_nodeclaim_and_node(env, instance_type=cheapest_instance(OD),
+                                initialized=False)
+        env.clock.step(60)
+        assert not candidates(env)
+
+    def test_deleting_candidate_excluded(self):
+        """:1573-1594."""
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(
+            env, instance_type=cheapest_instance(OD))
+        bind_pod(env, node, cpu="100m")
+        env.store.delete(node)
+        env.clock.step(60)
+        assert not candidates(env)
+
+
+class TestBudgetCounting:
+    """suite_test.go:635-780: which nodes count toward the per-pool
+    disruption budget denominator and the disrupting numerator."""
+
+    def test_uninitialized_nodes_not_counted(self):
+        """:648-678: a 50% budget over {1 initialized, 1 uninitialized}
+        pool allows ceil(50% of 1) = 1, not ceil(50% of 2)."""
+        pool = consolidation_nodepool(budgets=("50%",))
+        env = make_env(pool)
+        make_nodeclaim_and_node(env, instance_type=cheapest_instance(OD))
+        make_nodeclaim_and_node(env, instance_type=cheapest_instance(OD),
+                                initialized=False)
+        env.clock.step(60)
+        allowed = build_disruption_budget_mapping(env.cluster,
+                                                  "Underutilized")
+        assert allowed["default"] == 1
+
+    def test_terminating_condition_claims_not_counted(self):
+        """:679-710."""
+        env = make_env(consolidation_nodepool(budgets=("100%",)))
+        nc0, _ = make_nodeclaim_and_node(env,
+                                         instance_type=cheapest_instance(OD))
+        nc1, _ = make_nodeclaim_and_node(env,
+                                         instance_type=cheapest_instance(OD))
+        live = env.store.get(type(nc1), nc1.name)
+        live.conditions.set_true(COND_INSTANCE_TERMINATING,
+                                 reason="Terminating", now=env.clock.now())
+        env.store.update(live)
+        env.clock.step(60)
+        allowed = build_disruption_budget_mapping(env.cluster,
+                                                  "Underutilized")
+        assert allowed["default"] == 1  # only nc0 counts
+
+    def test_never_negative(self):
+        """:711-731: more disrupting nodes than budget floors at 0."""
+        pool = consolidation_nodepool(budgets=("1",))
+        env = make_env(pool)
+        for _ in range(3):
+            nc, node = make_nodeclaim_and_node(
+                env, instance_type=cheapest_instance(OD))
+        # two nodes marked for deletion consume more than the budget of 1
+        sns = list(env.cluster.state_nodes(deep_copy=False))
+        env.cluster.mark_for_deletion(sns[0].provider_id, sns[1].provider_id)
+        env.clock.step(60)
+        allowed = build_disruption_budget_mapping(env.cluster,
+                                                  "Underutilized")
+        assert allowed["default"] == 0
+
+
+class TestDisruptionCost:
+    """suite_test.go:781-852 over utils/disruption.py eviction_cost."""
+
+    def test_standard_cost_baseline(self):
+        p = make_pod(cpu="100m")
+        assert disruption_utils.eviction_cost(p) == 1.0
+
+    def test_positive_deletion_cost_raises(self):
+        p = make_pod(cpu="100m")
+        p.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] \
+            = "100"
+        assert disruption_utils.eviction_cost(p) > 1.0
+
+    def test_negative_deletion_cost_lowers(self):
+        p = make_pod(cpu="100m")
+        p.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] \
+            = "-100"
+        assert disruption_utils.eviction_cost(p) < 1.0
+
+    def test_higher_deletion_cost_costs_more(self):
+        lo_, hi = make_pod(cpu="100m"), make_pod(cpu="100m")
+        lo_.metadata.annotations[
+            "controller.kubernetes.io/pod-deletion-cost"] = "100"
+        hi.metadata.annotations[
+            "controller.kubernetes.io/pod-deletion-cost"] = "10000"
+        assert disruption_utils.eviction_cost(hi) > \
+            disruption_utils.eviction_cost(lo_)
+
+    def test_priority_raises_cost(self):
+        normal, important = make_pod(cpu="100m"), make_pod(cpu="100m")
+        important.spec.priority = 1_000_000
+        assert disruption_utils.eviction_cost(important) > \
+            disruption_utils.eviction_cost(normal)

@@ -129,9 +129,11 @@ class TestCandidateFiltering:
         assert candidates(env, disruption_class="graceful") == []
         assert len(candidates(env, disruption_class="eventual")) == 1
 
-    def test_do_not_disrupt_mirror_pod_does_not_block(self, env):
-        """suite_test.go:881-918: node-owned (mirror) pods aren't evictable,
-        so their annotations don't gate disruption."""
+    def test_do_not_disrupt_mirror_pod_blocks(self, env):
+        """suite_test.go:881-918 + statenode.go:221-223: the do-not-disrupt
+        sweep covers every ACTIVE pod — mirror pods may deliberately block
+        disruption through the annotation (corrected round 5; PDBs on
+        mirror pods remain exempt, see test_candidate_gating_corpus)."""
         nc, node, pod = provision_node(env)
         mirror = make_pod(cpu="100m", name="mirror")
         mirror.metadata.owner_refs.append(OwnerReference(kind="Node",
@@ -141,7 +143,7 @@ class TestCandidateFiltering:
         mirror.spec.node_name = node.name
         env.store.create(mirror)
         settle(env)
-        assert len(candidates(env)) == 1
+        assert candidates(env) == []
 
     def test_do_not_disrupt_daemonset_pod_blocks(self, env):
         """suite_test.go:919-957."""
