@@ -40,6 +40,11 @@ N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 # provisioning|consolidation|spot|mesh|mesh-local|all
 MODE = os.environ.get("BENCH_MODE", "all")
+# minValues benchmark line (the reference benchmarks minValues explicitly,
+# scheduling_benchmark_test.go:97-101): opt-in via BENCH_MINVALUES=1 in the
+# default run, or BENCH_MODE=minvalues alone; requirement floor knob below
+MINVALUES = os.environ.get("BENCH_MINVALUES", "") not in ("", "0")
+MINVALUES_FLOOR = int(os.environ.get("BENCH_MINVALUES_FLOOR", "50"))
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
 # soft wall-clock budget for the default multi-line run: once exceeded,
@@ -203,6 +208,73 @@ def _scheduler(n_its=None):
         spec=NodePoolSpec(template=NodeClaimTemplate(
             spec=NodeClaimTemplateSpec())))
     return TensorScheduler([nodepool], {"default": _catalog(n_its)})
+
+
+class _MinValuesReq:
+    """NodeSelectorRequirementWithMinValues shape (v1.NodeSelectorRequirement
+    + MinValues), the nodepool-side knob the reference's minValues benchmark
+    turns (scheduling_benchmark_test.go:97-101)."""
+
+    def __init__(self, key, operator, values, min_values):
+        self.key = key
+        self.operator = operator
+        self.values = tuple(values)
+        self.min_values = min_values
+
+
+def _minvalues_scheduler(n_its):
+    nodepool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplate(
+            spec=NodeClaimTemplateSpec(requirements=[
+                _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (),
+                              MINVALUES_FLOOR)]))))
+    return TensorScheduler([nodepool], {"default": _catalog(n_its)})
+
+
+def bench_minvalues():
+    """The reference's explicit minValues benchmark variant
+    (scheduling_benchmark_test.go:97-101): the headline mix solved under a
+    nodepool requiring >= MINVALUES_FLOOR distinct instance types per claim.
+    Asserts the batch stays on the tensor path (no host fallback, no
+    partition) and every launch decision honors the floor — the evidence
+    that minValues batches ride the kernel at scale."""
+    n_its = N_ITS or 2000
+    pods = _pods()
+    ts = _minvalues_scheduler(n_its)
+    r = ts.solve(pods)  # warmup at the timed shapes
+    assert ts.fallback_reason == "", \
+        f"minValues batch fell off the tensor path: {ts.fallback_reason}"
+    assert ts.partition == (len(pods), 0), ts.partition
+    # hostname-pod-affinity deployments (kind 3) legitimately overflow under
+    # a minValues floor: everything must land on ONE node, whose fill is
+    # capped by the floor-th largest type capacity — the host oracle errors
+    # those pods too (its per-add SatisfiesMinValues gate). Any OTHER error
+    # means the floor enforcement broke placement it shouldn't have.
+    err_uids = set(r.pod_errors)
+    bad = [p.metadata.name for p in pods
+           if p.uid in err_uids
+           and int(p.metadata.name.split("-")[1]) % 9 != 3]
+    assert not bad, f"unexpected minValues errors: {bad[:5]}"
+    assert len(pods) - len(err_uids) > 0, "nothing scheduled"
+    assert all(len(nc.instance_type_options) >= MINVALUES_FLOOR
+               for nc in r.new_nodeclaims), "minValues floor violated"
+    best = float("inf")
+    for _ in range(max(1, REPEATS - 1)):
+        ts = _minvalues_scheduler(n_its)
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": (f"provisioning Solve() throughput, {len(pods)} pods x "
+                   f"{n_its} instance types, reference pod mix + nodepool "
+                   f"minValues floor {MINVALUES_FLOOR} (tensor path, no "
+                   "fallback)"),
+        "value": round(len(pods) / best, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(len(pods) / best / 100.0, 2),
+        "seconds": round(best, 3),
+    }), flush=True)
 
 
 def bench_consolidation():
@@ -737,11 +809,14 @@ def main():
     if MODE == "sidecar":
         bench_sidecar()
         return
+    if MODE == "minvalues":
+        bench_minvalues()
+        return
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|spot|mesh|mesh-local|"
-            "mesh-headroom|sidecar")
+            "mesh-headroom|sidecar|minvalues")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))
@@ -774,9 +849,13 @@ def main():
     bench_host_floor()
     if MODE == "all":
         # mesh first: the multichip-at-scale line is the one the budget
-        # gate must never sacrifice
-        for aux in (bench_mesh, bench_consolidation, bench_spot_repack,
-                    bench_mesh_headroom, bench_sidecar):
+        # gate must never sacrifice; the opt-in minValues line
+        # (BENCH_MINVALUES=1) slots in AFTER it and rides the same guard
+        aux_benches = (bench_mesh, bench_consolidation, bench_spot_repack,
+                       bench_mesh_headroom, bench_sidecar)
+        if MINVALUES:
+            aux_benches = (bench_mesh, bench_minvalues) + aux_benches[1:]
+        for aux in aux_benches:
             if time.perf_counter() - t0 > BUDGET_SECONDS:
                 print(f"auxiliary bench {aux.__name__} skipped: past the "
                       f"{BUDGET_SECONDS:.0f}s budget (headline must land)",

@@ -13,6 +13,10 @@ instance-types) with full refiltering per pod) by:
    makes per pod but in closed form per group: zone water-fill for topology
    spreads, per-node caps for hostname spread/anti-affinity, cohort tracking
    for cross-group node mixing, subtractMax limit pessimism per opened node.
+   Cohort state lives in a columnar ``CohortSet`` so the in-flight-node scan
+   (eligibility, prospective zone commits, capacity) is batched array math
+   per group instead of per-cohort Python — the round-6 recovery of the
+   sub-second 50k x 2k flagship solve.
 
 Node-count parity with the reference greedy is validated against the host
 oracle scheduler in tests/test_binpack_parity.py.
@@ -105,6 +109,13 @@ class PackProblem:
     tol_exist: Optional[np.ndarray] = None           # bool [G, N]
     allow_undefined: Optional[np.ndarray] = None     # bool [K] well-known keys
     off_price: Optional[np.ndarray] = None           # float32 [T, O] (inf absent)
+    # int32 [M, G]: minValues floor on DISTINCT INSTANCE TYPES for the
+    # combined (template, group) requirement set, 0 = none. The packer caps
+    # every fill so at least this many types survive each claim's it_set —
+    # the tensor twin of the per-add SatisfiesMinValues gate
+    # (scheduler.py:159-162, types.go:178-212). minValues on other keys
+    # stays on the host path (build_problem falls back).
+    min_its: Optional[np.ndarray] = None
     # shared mutable slot (from the catalog-encoding cache): device-resident
     # copies of the catalog-side arrays, so repeat solves against the same
     # instance-type catalog skip the host->device upload entirely
@@ -352,17 +363,146 @@ def unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm, exist_ok,
 # host greedy over groups
 # --------------------------------------------------------------------------
 
-@dataclass
-class Cohort:
-    """n identical in-flight nodes: same template, zone restriction, cumulative
-    requests and surviving instance-type set."""
-    m: int
-    zone: Optional[int]
-    it_set: np.ndarray               # bool [T]
-    requests: np.ndarray             # int64 [R] per node
-    n: int
-    enc: EncodedRequirements         # accumulated requirement row
-    pods_by_group: Dict[int, int] = field(default_factory=dict)  # per-node fill
+class CohortSet:
+    """Columnar store of in-flight cohorts (a cohort = n identical planned
+    nodes: same template, zone restriction, cumulative requests, surviving
+    instance-type set). Round 5's per-object ``Cohort`` list forced the
+    group packer into a Python ``for cohort in cohorts`` scan per group —
+    re-running the requirement-compat, zone-commit and capacity math one
+    cohort at a time — which cost the sub-second flagship Solve()
+    (BENCH_r05 1.197 s vs r4 0.499 s). Stacking every per-cohort quantity
+    row-wise lets ``Packer._fill_cohorts`` evaluate ALL candidate cohorts
+    for a group in a handful of vectorized passes with identical placement
+    semantics (the parity fuzzer pins them).
+
+    Incremental aggregates maintained per row, AND-folded as groups board
+    (order-independent, so equal to the scan the old code re-ran per probe):
+
+    - ``zadm[c, z]``  — every aboard group admits zone z
+      (``zone_adm[gp, m, z]`` reduced over the aboard set);
+    - ``okz[c, t, w]`` — bitpacked (encode.pack_bits layout) zone-
+      feasibility intersection ``AND_gp it_ok_z[gp, m, t, :]``, the
+      prospective zone-commit mask of the round-5 fix;
+    - ``aboard[c, g]`` — the aboard-group bitset (host-port conflict gate);
+    - ``enc_*``       — the accumulated requirement row, stacked so
+      requirement compatibility is one batched mask reduction.
+    """
+
+    _ROW_FIELDS = ("m", "zone", "n", "fill", "it_set", "requests", "aboard",
+                   "zadm", "okz", "enc_mask", "enc_defined", "enc_complement",
+                   "enc_exempt", "enc_gt", "enc_lt")
+
+    def __init__(self, p: PackProblem, t: PackTensors, G: int, cap: int = 64):
+        self.T = p.it_alloc.shape[0]
+        self.R = p.group_req.shape[1]
+        self.Z = p.zone_values.shape[0]
+        K, W = p.group_enc.mask.shape[1:]
+        self.C = 0
+        self._cap = cap
+        self._t = t
+        self.m = np.zeros(cap, np.int32)
+        self.zone = np.full(cap, -1, np.int32)          # -1 == zone-free
+        self.n = np.zeros(cap, np.int64)
+        self.fill = np.zeros(cap, np.int64)             # pods per node
+        self.it_set = np.zeros((cap, self.T), bool)
+        self.requests = np.zeros((cap, self.R), np.int64)
+        self.aboard = np.zeros((cap, G), bool)
+        self.zadm = np.zeros((cap, self.Z), bool)
+        self.okz = np.zeros((cap, self.T, (self.Z + 7) // 8), np.uint8)
+        self.enc_mask = np.zeros((cap, K, W), np.uint32)
+        self.enc_defined = np.zeros((cap, K), bool)
+        self.enc_complement = np.zeros((cap, K), bool)
+        self.enc_exempt = np.zeros((cap, K), bool)
+        self.enc_gt = np.zeros((cap, K), np.int64)
+        self.enc_lt = np.zeros((cap, K), np.int64)
+        self.pods_by_group: List[Dict[int, int]] = []   # per-node fill
+        self._okz_rows: Dict[tuple, np.ndarray] = {}
+
+    def _grow(self) -> None:
+        self._cap *= 2
+        for name in self._ROW_FIELDS:
+            a = getattr(self, name)
+            out = np.zeros((self._cap,) + a.shape[1:], a.dtype)
+            out[:self.C] = a[:self.C]
+            setattr(self, name, out)
+
+    def _okz_row(self, g: int, m: int) -> np.ndarray:
+        """[T, ceil(Z/8)] bitpacked ``it_ok_z[g, m]`` (memoized: boarding
+        the same group repeatedly must not re-pack)."""
+        key = (g, m)
+        row = self._okz_rows.get(key)
+        if row is None:
+            row = enc.pack_bits(self._t.it_ok_z[g, m])
+            self._okz_rows[key] = row
+        return row
+
+    def append(self, g: int, m: int, zone: Optional[int], it_set: np.ndarray,
+               requests: np.ndarray, n: int, enc_row: EncodedRequirements,
+               fill: int) -> int:
+        ci = self.C
+        if ci == self._cap:
+            self._grow()
+        self.m[ci] = m
+        self.zone[ci] = -1 if zone is None else zone
+        self.n[ci] = n
+        self.fill[ci] = fill
+        self.it_set[ci] = it_set
+        self.requests[ci] = requests
+        self.aboard[ci] = False
+        self.aboard[ci, g] = True
+        self.zadm[ci] = self._t.zone_adm[g, m]
+        self.okz[ci] = self._okz_row(g, m)
+        self.set_enc(ci, enc_row)
+        self.pods_by_group.append({g: fill})
+        self.C += 1
+        return ci
+
+    def split(self, ci: int, n_new: int) -> int:
+        """Copy row ci into a fresh row with node count ``n_new`` (the
+        caller shrinks ci's own count): remainder/last-node cohorts inherit
+        every aggregate, exactly like the old object copy did."""
+        cj = self.C
+        if cj == self._cap:
+            self._grow()
+        for name in self._ROW_FIELDS:
+            a = getattr(self, name)
+            a[cj] = a[ci]
+        self.n[cj] = n_new
+        self.pods_by_group.append(dict(self.pods_by_group[ci]))
+        self.C += 1
+        return cj
+
+    def enc_row(self, ci: int) -> EncodedRequirements:
+        """Row VIEWS — callers combine them into fresh arrays (np_combine
+        never mutates) and write back via set_enc."""
+        return EncodedRequirements(
+            mask=self.enc_mask[ci], defined=self.enc_defined[ci],
+            complement=self.enc_complement[ci], exempt=self.enc_exempt[ci],
+            gt=self.enc_gt[ci], lt=self.enc_lt[ci])
+
+    def set_enc(self, ci: int, e: EncodedRequirements) -> None:
+        self.enc_mask[ci] = e.mask
+        self.enc_defined[ci] = e.defined
+        self.enc_complement[ci] = e.complement
+        self.enc_exempt[ci] = e.exempt
+        self.enc_gt[ci] = e.gt
+        self.enc_lt[ci] = e.lt
+
+    def compatible_rows(self, b: EncodedRequirements,
+                        allow_undefined: np.ndarray) -> np.ndarray:
+        """[C] bool: np_compatible(row, b) for every cohort row at once —
+        the batched twin of the old per-cohort scan check."""
+        C = self.C
+        gt = np.maximum(self.enc_gt[:C], b.gt)
+        lt = np.minimum(self.enc_lt[:C], b.lt)
+        crossed = (gt > -2**31) & (lt < 2**31 - 1) & (gt >= lt)
+        nonempty = np.any(self.enc_mask[:C] & b.mask, axis=-1) & ~crossed
+        checked = self.enc_defined[:C] & b.defined
+        exempt = self.enc_exempt[:C] & b.exempt
+        bad = checked & ~nonempty & ~exempt
+        undef_bad = (b.defined & ~self.enc_defined[:C]
+                     & ~allow_undefined & ~b.exempt)
+        return ~np.any(bad | undef_bad, axis=-1)
 
 
 @dataclass
@@ -371,7 +511,7 @@ class PackResult:
     nodes: List[tuple] = field(default_factory=list)
     existing: Dict[int, list] = field(default_factory=dict)  # node idx -> pods
     errors: Dict[str, str] = field(default_factory=dict)     # pod uid -> error
-    cohorts: List[Cohort] = field(default_factory=list)
+    cohorts: Optional[CohortSet] = None
     # a nodepool limit excluded capacity during this pack: WHO gets the
     # scarce budget is order-dependent, so pack errors under limit pressure
     # are not oracle-final (the production scheduler re-solves on the host
@@ -508,24 +648,52 @@ class Packer:
         # domain-name tie-break order for zone selection (host parity)
         self._zone_names = np.array(p.vocab.values[p.zone_key], dtype=object)
         self.result = PackResult()
-        # per-group nonzero request columns + per-(m,g) daemon-adjusted
-        # allocatable slices, so the per-probe capacity math touches only the
-        # resources the group actually requests (hot path: _cohort_capacity)
+        self.cohorts = CohortSet(p, t, self.G)
+        # per-group nonzero request columns + request-restricted catalog
+        # slices, so the per-probe capacity math touches only the resources
+        # the group actually requests (hot path: _cohort_caps)
         self._req_nz = [np.nonzero(p.group_req[g])[0] for g in range(self.G)]
         self._req_vals = [p.group_req[g][self._req_nz[g]] for g in range(self.G)]
-        self._alloc_nz_cache: Dict[tuple, np.ndarray] = {}
+        # a group whose requirement row defines NO key is compatible with
+        # every accumulated cohort requirement set (np_compatible's bad /
+        # undef_bad terms both need b.defined) — the common case in large
+        # batches, so the whole batched compat pass is skipped for it
+        self._g_trivial = ~p.group_enc.defined.any(axis=1)
+        # minValues floor on distinct instance types per (template, group):
+        # every fill is capped so at least this many types survive the claim
+        # (the host oracle refuses per-pod adds that would drop below it,
+        # scheduler.py:159-162) — zero-cost when no floor is set
+        self._min_its = p.min_its
+        self._has_min_its = (p.min_its is not None
+                             and bool((p.min_its > 0).any()))
+        self._alloc_nz_cache: Dict[int, np.ndarray] = {}
+        self._adj_nz_cache: Dict[tuple, np.ndarray] = {}
         self._madj_cache: Dict[int, np.ndarray] = {}
+        self._dfits_cache: Dict[int, np.ndarray] = {}
+        self._gz_grid_cache: Dict[int, np.ndarray] = {}
+        self._node_enc_cache: Dict[tuple, EncodedRequirements] = {}
+        self._zone_enc_cache: Dict[int, EncodedRequirements] = {}
 
-    def _alloc_nz(self, m: int, g: int) -> np.ndarray:
-        """[T, nnz(g)] allocatable minus template daemon overhead, restricted
-        to group g's requested resources."""
-        key = (m, g)
-        out = self._alloc_nz_cache.get(key)
+    def _it_alloc_nz(self, g: int) -> np.ndarray:
+        """[T, nnz(g)] raw allocatable restricted to group g's requested
+        resources (daemon overhead enters per candidate template in
+        _cohort_caps)."""
+        out = self._alloc_nz_cache.get(g)
         if out is None:
-            nz = self._req_nz[g]
-            out = self.p.it_alloc[:, nz] - self.p.daemon_overhead[m][nz]
-            self._alloc_nz_cache[key] = out
+            out = self.p.it_alloc[:, self._req_nz[g]]
+            self._alloc_nz_cache[g] = out
         return out
+
+    def _gz_grid(self, g: int) -> np.ndarray:
+        """[M, T, Z+1] group-side feasibility with the any-zone plane
+        appended at index Z, so mixed zone-committed / zone-free candidate
+        cohorts gather their per-IT admission in ONE fancy index."""
+        grid = self._gz_grid_cache.get(g)
+        if grid is None:
+            grid = np.concatenate(
+                [self.t.it_ok_z[g], self.t.it_ok[g][:, :, None]], axis=2)
+            self._gz_grid_cache[g] = grid
+        return grid
 
     # -- helpers ------------------------------------------------------------
 
@@ -563,7 +731,8 @@ class Packer:
             # size the fill from the LIMIT-FILTERED set: per_node came from
             # the unfiltered max-capacity type, which limits may have
             # excluded — overfilling would prune the cohort's options empty
-            per_fit = min(per_node, int(self.t.ppn[g, m][it_fit].max()))
+            per_fit = min(per_node,
+                          self._fill_ceiling(g, m, self.t.ppn[g, m], it_fit))
             if per_fit <= 0:
                 break
             fill = min(per_fit, n_pods - placed)
@@ -599,12 +768,25 @@ class Packer:
             limits[rname] = limits[rname] - int(self.p.it_capacity[it_set, ridx].max())
 
     def _node_enc(self, g: int, m: int, zone: Optional[int]) -> EncodedRequirements:
-        e = np_combine(_row(self.p.template_enc, m), _row(self.p.group_enc, g))
-        if zone is not None:
-            e = np_combine(e, self._zone_enc(zone))
+        """Fresh-cohort requirement row; memoized (pure in (g, m, zone), and
+        append copies it into the cohort store so sharing is safe)."""
+        key = (g, m, zone)
+        e = self._node_enc_cache.get(key)
+        if e is None:
+            e = np_combine(_row(self.p.template_enc, m), _row(self.p.group_enc, g))
+            if zone is not None:
+                e = np_combine(e, self._zone_enc(zone))
+            self._node_enc_cache[key] = e
         return e
 
     def _zone_enc(self, zone: int) -> EncodedRequirements:
+        e = self._zone_enc_cache.get(zone)
+        if e is None:
+            e = self._build_zone_enc(zone)
+            self._zone_enc_cache[zone] = e
+        return e
+
+    def _build_zone_enc(self, zone: int) -> EncodedRequirements:
         K, W = self.p.group_enc.mask.shape[1:]
         mask = np.full((K, W), 0xFFFFFFFF, dtype=np.uint32)
         defined = np.zeros(K, dtype=bool)
@@ -632,14 +814,55 @@ class Packer:
             self._madj_cache[m] = out
         return out
 
+    def _fill_ceiling(self, g: int, m: int, vals: np.ndarray,
+                      mask: np.ndarray) -> int:
+        """Max per-node fill of group g on a fresh template-m node honoring
+        the minValues floor: the k-th largest masked per-IT capacity (plain
+        max when no floor — k ITs hold >= fill pods iff fill <= k-th
+        largest). Callers guarantee mask.any()."""
+        sel = vals[mask]
+        k = int(self._min_its[m, g]) if self._has_min_its else 0
+        if k <= 1:
+            return int(sel.max())
+        if sel.size < k:
+            return 0
+        return int(np.partition(sel, sel.size - k)[sel.size - k])
+
+    def _daemon_fits(self, m: int) -> np.ndarray:
+        """[T] bool: daemon-adjusted allocatable is nonnegative in EVERY
+        resource — the request-independent part of _fits_requests, memoized
+        so the hot fit check only touches the requested columns."""
+        out = self._dfits_cache.get(m)
+        if out is None:
+            out = (self._adjusted_alloc(m) >= 0).all(axis=1)
+            self._dfits_cache[m] = out
+        return out
+
+    def _adj_nz(self, m: int, nz: np.ndarray) -> np.ndarray:
+        """[T, len(nz)] daemon-adjusted allocatable restricted to columns
+        nz, memoized per (template, column-set)."""
+        key = (m, nz.tobytes())
+        out = self._adj_nz_cache.get(key)
+        if out is None:
+            out = self._adjusted_alloc(m)[:, nz]
+            self._adj_nz_cache[key] = out
+        return out
+
     def _fits_requests(self, m: int, requests: np.ndarray) -> np.ndarray:
         """[T] bool: instance types whose daemon-adjusted allocatable holds
         the cumulative request vector — the tensor twin of the per-pod
         instance-type refiltering (nodeclaim.go:108-117): an IT that fit the
         first pod must leave the set once the accumulated load outgrows it,
         or downstream consumers (price ordering, the consolidation price
-        filter, the provider's cheapest-offering pick) see phantom options."""
-        return (self._adjusted_alloc(m) >= requests).all(axis=1)
+        filter, the provider's cheapest-offering pick) see phantom options.
+        Split as (all columns >= 0) AND (requested columns hold the load):
+        equal to the full [T, R] compare because requests are nonnegative,
+        at a fraction of the width."""
+        nz = np.nonzero(requests)[0]
+        fit = self._daemon_fits(m)
+        if nz.size:
+            fit = fit & (self._adj_nz(m, nz) >= requests[nz]).all(axis=1)
+        return fit
 
     def _append_cohort(self, g: int, m: int, zone: Optional[int],
                        it_set: np.ndarray, fill: int,
@@ -654,144 +877,217 @@ class Packer:
         it_set = it_set & self._fits_requests(m, req)
         if not it_set.any():
             return False
-        self.result.cohorts.append(Cohort(
-            m=m, zone=zone, it_set=it_set.copy(), requests=req.copy(), n=n,
-            enc=cohort_enc, pods_by_group={g: fill}))
+        if self._has_min_its:
+            k = int(self._min_its[m, g])
+            if k > 1 and int(it_set.sum()) < k:
+                return False  # fresh claim can't keep the minValues floor
+        self.cohorts.append(g=g, m=m, zone=zone, it_set=it_set, requests=req,
+                            n=n, enc_row=cohort_enc, fill=fill)
         return True
 
-    def _cohort_capacity(self, g: int, cohort: Cohort,
-                         zone_override: Optional[int] = None,
-                         extra_mask: Optional[np.ndarray] = None
-                         ) -> Tuple[int, np.ndarray]:
-        """Max additional pods of group g per cohort node + surviving it set.
-        Negative free capacity floors the per-IT min below zero, which the
-        callers' cap<=0 check treats identically to the old clamp-to-zero.
-        zone_override/extra_mask evaluate a PROSPECTIVE zone commitment of a
-        zone-free cohort (see _fill_cohorts) without mutating it."""
-        zone = cohort.zone if zone_override is None else zone_override
-        it_ok = (self.t.it_ok_z[g, cohort.m, :, zone] if zone is not None
-                 else self.t.it_ok[g, cohort.m])
-        ts = cohort.it_set & it_ok
-        if extra_mask is not None:
-            ts = ts & extra_mask
-        if not ts.any():
-            return 0, ts
+    def _cohort_caps(self, g: int, cand: np.ndarray, zone: Optional[int],
+                     prospect: Optional[np.ndarray]
+                     ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """Batched cohort capacity: (caps [nc], surviving it-set ts [nc, T],
+        per-IT capacities per [nc, T] or None when g requests nothing) for
+        EVERY candidate row in ``cand`` at once (the round-5 code re-derived
+        this per cohort in Python). Negative free capacity floors the per-IT
+        min below zero, which the caller's cap<=0 gate treats identically to
+        the old clamp-to-zero; rows whose surviving set is empty report cap
+        0. ``prospect`` rows evaluate a PROSPECTIVE zone commitment of a
+        zone-free cohort (see _fill_cohorts) without mutating it: their
+        admission additionally intersects the cohort's accumulated
+        aboard-group zone-feasibility bitfield (CohortSet.okz). ``per`` rows
+        let commits derive the post-commit instance-type set as
+        ``ts & (per >= fill)`` — exactly the _fits_requests refiltering,
+        because ts only holds types that fit the PRE-commit load."""
+        cs = self.cohorts
+        m_c = cs.m[cand]
+        grid = self._gz_grid(g)                             # [M, T, Z+1]
+        if zone is not None:
+            ez = np.full(cand.size, zone, np.int64)
+        else:
+            cz = cs.zone[cand]
+            ez = np.where(cz < 0, self.Z, cz)               # Z == any-zone
+        ts = cs.it_set[cand] & grid[m_c, :, ez]             # [nc, T]
+        if prospect is not None:
+            pm = prospect[cand]
+            if pm.any():
+                ts[pm] = ts[pm] & enc.bit_column(cs.okz[cand[pm]], zone)
+        any_ts = ts.any(axis=1)
+        k_c = self._min_its[m_c, g] if self._has_min_its else None
         nz = self._req_nz[g]
         if nz.size == 0:
-            return INT32_MAX, ts
-        per = ((self._alloc_nz(cohort.m, g) - cohort.requests[nz])
-               // self._req_vals[g]).min(axis=1)
-        return int(per[ts].max()), ts
+            ok = (any_ts if k_c is None
+                  else ts.sum(axis=1) >= np.maximum(k_c, 1))
+            return np.where(ok, np.int64(INT32_MAX), np.int64(0)), ts, None
+        need = (self.p.daemon_overhead[m_c][:, nz]
+                + cs.requests[cand][:, nz])                 # [nc, nnz]
+        alloc = self._it_alloc_nz(g)
+        rv = self._req_vals[g]
+        # per-resource [nc, T] floordivs + running min: same arithmetic as
+        # the 3-D broadcast, without materializing the [nc, T, nnz] temp
+        per = (alloc[None, :, 0] - need[:, 0:1]) // rv[0]
+        for r in range(1, nz.size):
+            per = np.minimum(per, (alloc[None, :, r] - need[:, r:r + 1])
+                             // rv[r])
+        masked = np.where(ts, per, np.iinfo(np.int64).min)
+        caps = masked.max(axis=1)
+        if k_c is not None and (k_c > 1).any():
+            # minValues floor: cap at the k-th largest surviving capacity so
+            # >= k instance types outlive the commit's it_set refiltering
+            count = ts.sum(axis=1)
+            T = masked.shape[1]
+            for j in np.nonzero(k_c > 1)[0]:
+                k = int(k_c[j])
+                caps[j] = (np.partition(masked[j], T - k)[T - k]
+                           if count[j] >= k else 0)
+        return np.where(any_ts, caps, 0), ts, per
 
     def _fill_cohorts(self, g: int, remaining: int, zone: Optional[int],
                       per_node_cap: int) -> int:
         """Mix pods of g into compatible existing cohorts (the reference's
-        fewest-pods-first in-flight node pass, scheduler.go:276-283)."""
+        fewest-pods-first in-flight node pass, scheduler.go:276-283).
+
+        One vectorized eligibility pass over the whole cohort matrix —
+        zone admission (incl. the prospective zone-commit gate via the
+        incrementally AND-folded zadm/okz aggregates), template compat +
+        toleration, accumulated-requirement compatibility, host-port
+        exclusion — then capacities in geometrically growing fill-order
+        chunks so the common few-cohorts fill never pays for the full
+        matrix while an exhausting scan stays one batched pass. Placement
+        semantics are unchanged: eligibility and capacity of a cohort are
+        independent of commits to OTHER cohorts within one call, and
+        split-off rows land past the scan snapshot exactly like the old
+        list appends, so precomputing matches the sequential scan
+        decision-for-decision."""
         if remaining <= 0:
             return 0
-        allow = self.p.allow_undefined
-        cohorts = self.result.cohorts
-        fills = [sum(c.pods_by_group.values()) for c in cohorts]
-        order = sorted(range(len(cohorts)), key=fills.__getitem__)
+        cs = self.cohorts
+        C = cs.C
+        if C == 0:
+            return 0
+        m_all = cs.m[:C]
+        elig = self.t.compat_tm[m_all, g] & self.p.tol_template[g, m_all]
+        prospect = None
+        if zone is not None:
+            czone = cs.zone[:C]
+            # a zone-free cohort may take zonal pods only by COMMITTING to
+            # the zone (nodeclaim.go Add intersects requirements): allowed
+            # iff every group already aboard admits the zone (zadm)
+            prospect = (czone < 0) & cs.zadm[:C, zone]
+            elig &= (czone == zone) | prospect
+        # a cohort committed to SOME zone takes zone-free pods whenever the
+        # group's requirements admit that zone — the enc-compat pass below
+        # (or triviality) covers it, as before
+        if not self._g_trivial[g] and elig.any():
+            elig &= cs.compatible_rows(_row(self.p.group_enc, g),
+                                       self.p.allow_undefined)
+        if self._port_conflict is not None:
+            conf = self._port_conflict[g]
+            if conf.any():
+                # a conflicting host port is already bound aboard
+                elig &= ~(cs.aboard[:C] & conf).any(axis=1)
+        if not elig.any():
+            return 0
+        order = np.argsort(cs.fill[:C], kind="stable")
+        cand = order[elig[order]]
         placed_total = 0
-        for ci in order:
-            if remaining <= 0:
-                break
-            cohort = self.result.cohorts[ci]
-            commit_zone = False
-            extra_mask = None
-            if zone is not None and cohort.zone != zone:
-                if cohort.zone is not None:
-                    continue
-                # zone-free cohort: a zonal pod joining an in-flight claim
-                # NARROWS the claim to its zone in the host scheduler
-                # (nodeclaim.go Add intersects requirements) — mirror that
-                # by committing the cohort to this zone, provided every
-                # group already aboard stays feasible there
-                extra_mask = np.ones_like(cohort.it_set)
-                ok = True
-                for gp in cohort.pods_by_group:
-                    if not self.t.zone_adm[gp, cohort.m, zone]:
-                        ok = False
-                        break
-                    extra_mask = extra_mask & \
-                        self.t.it_ok_z[gp, cohort.m, :, zone]
-                if not ok:
-                    continue
-                commit_zone = True
-            if zone is None and cohort.zone is not None:
-                # group must admit the cohort's zone; np_compatible handles it
-                pass
-            if not self.t.compat_tm[cohort.m, g] or not self.p.tol_template[g, cohort.m]:
-                continue
-            if not np_compatible(cohort.enc, _row(self.p.group_enc, g), allow):
-                continue
-            if self._port_conflict is not None and any(
-                    self._port_conflict[g, gp]
-                    for gp in cohort.pods_by_group):
-                continue  # a conflicting host port is already bound aboard
-            cap, ts = self._cohort_capacity(
-                g, cohort, zone_override=zone if commit_zone else None,
-                extra_mask=extra_mask)
+        pos = 0
+        chunk = 8
+        while remaining > 0 and pos < cand.size:
+            ch = cand[pos:pos + chunk]
+            pos += ch.size
+            chunk = min(chunk * 4, 512)
+            caps, ts, per = self._cohort_caps(g, ch, zone, prospect)
             if per_node_cap:
-                existing_fill = cohort.pods_by_group.get(g, 0)
-                cap = min(cap, max(0, per_node_cap - existing_fill))
-            if cap <= 0:
-                continue
-            # fill each node of the cohort up to cap; split if not all consumed
-            fill_nodes = min(cohort.n, -(-remaining // cap))
-            if fill_nodes < cohort.n:
-                # the UNFILLED nodes keep the cohort's original zone state:
-                # only nodes actually receiving zonal pods narrow their zone
-                rest = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
-                              requests=cohort.requests.copy(), n=cohort.n - fill_nodes,
-                              enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
-                cohort.n = fill_nodes
-                self.result.cohorts.append(rest)
-            # take at most cap per node: when demand exceeds the cohort's
-            # total capacity (remaining > cap * n), every node takes exactly
-            # cap and the leftover moves on — per_last derived from the raw
-            # remaining overfilled the last node past the per-node cap
-            # (e.g. 14 hostname-spread pods on one node at maxSkew=1)
-            take = min(remaining, cap * fill_nodes)
-            per_last = take - cap * (fill_nodes - 1)
-            if per_last != cap and fill_nodes > 1:
-                # last node takes the remainder; split it off
-                last = Cohort(m=cohort.m, zone=cohort.zone, it_set=cohort.it_set.copy(),
-                              requests=cohort.requests.copy(), n=1,
-                              enc=cohort.enc, pods_by_group=dict(cohort.pods_by_group))
-                cohort.n = fill_nodes - 1
-                self.result.cohorts.append(last)
-                if commit_zone:
-                    self._commit_cohort_zone(cohort, zone)
-                    self._commit_cohort_zone(last, zone)
-                self._commit_to_cohort(last, g, per_last, ts)
-                self._commit_to_cohort(cohort, g, cap, ts)
-                placed = take
-            else:
-                fill = per_last if fill_nodes == 1 else cap
-                if commit_zone:
-                    self._commit_cohort_zone(cohort, zone)
-                self._commit_to_cohort(cohort, g, fill, ts)
-                placed = fill * fill_nodes
-            placed_total += placed
-            remaining -= placed
+                base = np.fromiter(
+                    (cs.pods_by_group[ci].get(g, 0) for ci in ch),
+                    dtype=np.int64, count=ch.size)
+                caps = np.minimum(caps, np.maximum(0, per_node_cap - base))
+            for j in np.nonzero(caps > 0)[0]:
+                if remaining <= 0:
+                    break
+                ci = int(ch[j])
+                cap = int(caps[j])
+                commit_zone = prospect is not None and bool(prospect[ci])
+                ts_row = ts[j]
+                per_row = per[j] if per is not None else None
+                # fill each node of the cohort up to cap; split if not all
+                # consumed
+                n_ci = int(cs.n[ci])
+                fill_nodes = min(n_ci, -(-remaining // cap))
+                if fill_nodes < n_ci:
+                    # the UNFILLED nodes keep the cohort's original zone
+                    # state: only nodes actually receiving zonal pods
+                    # narrow their zone
+                    cs.split(ci, n_ci - fill_nodes)
+                    cs.n[ci] = fill_nodes
+                # take at most cap per node: when demand exceeds the
+                # cohort's total capacity (remaining > cap * n), every node
+                # takes exactly cap and the leftover moves on — per_last
+                # derived from the raw remaining overfilled the last node
+                # past the per-node cap (e.g. 14 hostname-spread pods on
+                # one node at maxSkew=1)
+                take = min(remaining, cap * fill_nodes)
+                per_last = take - cap * (fill_nodes - 1)
+                if per_last != cap and fill_nodes > 1:
+                    # last node takes the remainder; split it off
+                    last = cs.split(ci, 1)
+                    cs.n[ci] = fill_nodes - 1
+                    if commit_zone:
+                        self._commit_cohort_zone(ci, zone)
+                        self._commit_cohort_zone(last, zone)
+                    self._commit_to_cohort(last, g, per_last, ts_row, per_row)
+                    self._commit_to_cohort(ci, g, cap, ts_row, per_row)
+                    placed = take
+                else:
+                    fill = per_last if fill_nodes == 1 else cap
+                    if commit_zone:
+                        self._commit_cohort_zone(ci, zone)
+                    self._commit_to_cohort(ci, g, fill, ts_row, per_row)
+                    placed = fill * fill_nodes
+                placed_total += placed
+                remaining -= placed
         return placed_total
 
-    def _commit_cohort_zone(self, cohort: Cohort, zone: int) -> None:
+    def _commit_cohort_zone(self, ci: int, zone: int) -> None:
         """Pin a zone-free cohort to a zone: both the zone field AND the
         encoded requirements narrow (the enc drives offering admission in
         price ordering and keys the materialize order-cache — a stale
         all-zones enc would rank unreachable offerings and collide cache
         entries across differently-pinned cohorts)."""
-        cohort.zone = zone
-        cohort.enc = np_combine(cohort.enc, self._zone_enc(zone))
+        cs = self.cohorts
+        cs.zone[ci] = zone
+        cs.set_enc(ci, np_combine(cs.enc_row(ci), self._zone_enc(zone)))
 
-    def _commit_to_cohort(self, cohort: Cohort, g: int, fill: int, ts: np.ndarray):
-        cohort.requests = cohort.requests + self.p.group_req[g] * fill
-        cohort.it_set = ts & self._fits_requests(cohort.m, cohort.requests)
-        cohort.pods_by_group[g] = cohort.pods_by_group.get(g, 0) + fill
-        cohort.enc = np_combine(cohort.enc, _row(self.p.group_enc, g))
+    def _commit_to_cohort(self, ci: int, g: int, fill: int, ts: np.ndarray,
+                          per: Optional[np.ndarray] = None):
+        cs = self.cohorts
+        cs.requests[ci] += self.p.group_req[g] * fill
+        m = int(cs.m[ci])
+        if per is not None:
+            # ts only holds types fitting the pre-commit load, so the
+            # _fits_requests refiltering against the grown request vector
+            # reduces to the per-IT capacity bound (see _cohort_caps)
+            cs.it_set[ci] = ts & (per >= fill)
+        else:
+            cs.it_set[ci] = ts & self._fits_requests(m, cs.requests[ci])
+        pbg = cs.pods_by_group[ci]
+        pbg[g] = pbg.get(g, 0) + fill
+        cs.fill[ci] += fill
+        if not cs.aboard[ci, g]:
+            # first boarding of g: fold its planes into the aggregates.
+            # Re-boarding is a no-op for all three — requirement combine
+            # and the AND-folds are idempotent — which the old code paid
+            # for anyway on every repeat commit.
+            cs.aboard[ci, g] = True
+            cs.zadm[ci] &= self.t.zone_adm[g, m]
+            cs.okz[ci] &= cs._okz_row(g, m)
+            if not self._g_trivial[g]:
+                # combining with a no-requirements row is the identity
+                cs.set_enc(ci, np_combine(cs.enc_row(ci),
+                                          _row(self.p.group_enc, g)))
 
     def _fill_existing(self, g: int, remaining: int, zone: Optional[int],
                        per_node_cap: int,
@@ -856,6 +1152,7 @@ class Packer:
             -self.p.group_req[g][cpu_idx], -self.p.group_req[g][mem_idx]))
         for g in order:
             self._pack_group(g)
+        self.result.cohorts = self.cohorts
         return self.result
 
     def _error_group(self, g: int, count: int, msg: str) -> None:
@@ -970,7 +1267,7 @@ class Packer:
                      else self.t.it_ok[g, m])
             if not it_ok.any():
                 continue
-            per = int(ppn_all[it_ok].max())
+            per = self._fill_ceiling(g, m, ppn_all, it_ok)
             if per_node_cap:
                 per = min(per, per_node_cap)
             placed += self._open_nodes(g, m, zone, remaining - placed, per)
@@ -991,7 +1288,7 @@ class Packer:
                 limit_pruned = bool((it_fit != it_ok).any())
                 it_ok = it_fit
             # fill sized from the (limit-filtered) surviving set
-            per = int(self.t.ppn[g, m][it_ok].max())
+            per = self._fill_ceiling(g, m, self.t.ppn[g, m], it_ok)
             fill = min(per, c)
             if fill <= 0:
                 if limit_pruned:

@@ -258,6 +258,21 @@ def stack_encoded(items: Sequence[EncodedRequirements]) -> EncodedRequirements:
         lt=np.stack([e.lt for e in items]))
 
 
+def pack_bits(a: np.ndarray) -> np.ndarray:
+    """Little-endian bitpack of a bool array along its LAST axis:
+    [..., Z] bool -> [..., ceil(Z/8)] uint8 with bit i of word w standing
+    for position w*8+i. The packer's per-cohort zone-feasibility bitfield
+    (ops/binpack.py CohortSet.okz) uses this layout; read single positions
+    back with bit_column()."""
+    return np.packbits(np.asarray(a, dtype=bool), axis=-1, bitorder="little")
+
+
+def bit_column(packed: np.ndarray, i: int) -> np.ndarray:
+    """Extract logical position ``i`` from a pack_bits() array -> bool
+    with the last (word) axis dropped."""
+    return (packed[..., i >> 3] >> (i & 7)) & 1 == 1
+
+
 def encode_resource_vector(vocab: Vocab, rl: dict, *, capacity: bool) -> np.ndarray:
     out = np.zeros(vocab.R, dtype=np.int64)
     for name, milli in rl.items():

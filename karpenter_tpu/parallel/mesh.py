@@ -118,7 +118,9 @@ def pad_problem(p: binpack.PackProblem, g_mult: int, t_mult: int
         exist_zone=p.exist_zone,
         tol_exist=(_pad_to(p.tol_exist, 0, Gp)
                    if p.tol_exist is not None else None),
-        allow_undefined=p.allow_undefined)
+        allow_undefined=p.allow_undefined,
+        min_its=(_pad_to(p.min_its, 1, Gp)
+                 if p.min_its is not None else None))
     return q, G, T
 
 

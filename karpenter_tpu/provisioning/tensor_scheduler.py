@@ -471,6 +471,8 @@ class TensorScheduler:
             for m, nct in enumerate(templates):
                 tol_template[gi, m] = not scheduling_taints.tolerates(nct.taints, probe)
 
+        min_its = self._min_its_floor(templates, groups)
+
         exist_enc = exist_avail = exist_zone = tol_exist = None
         if self.state_nodes:
             encs, avails, zones = [], [], []
@@ -521,8 +523,35 @@ class TensorScheduler:
             off_price=off_price,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined,
-            device_cache=ce.device_cache)
+            device_cache=ce.device_cache, min_its=min_its)
         return problem, templates, catalog
+
+    @staticmethod
+    def _min_its_floor(templates, groups) -> Optional[np.ndarray]:
+        """[M, G] int32 minValues floor on distinct instance types for each
+        combined (template, group) requirement set (intersection takes the
+        max of both sides' minValues, requirement.py:86), or None when no
+        floor exists anywhere. The packer enforces it per fill — the tensor
+        twin of the per-add SatisfiesMinValues gate. minValues on any OTHER
+        key needs per-key distinct-value counting over the surviving set;
+        that stays on the host oracle."""
+        def floor_of(reqs) -> int:
+            mv = 0
+            for r in reqs.values():
+                if r.min_values:
+                    if r.key != api_labels.LABEL_INSTANCE_TYPE:
+                        raise _FallbackError(
+                            f"minValues on {r.key} needs host-side "
+                            "distinct-value tracking")
+                    mv = max(mv, r.min_values)
+            return mv
+
+        mv_t = [floor_of(nct.requirements) for nct in templates]
+        mv_g = [floor_of(g.requirements) for g in groups]
+        if not any(mv_t) and not any(mv_g):
+            return None
+        return np.maximum(np.array(mv_t, dtype=np.int32)[:, None],
+                          np.array(mv_g, dtype=np.int32)[None, :])
 
     def _fits_vocab(self, vocab, templates, groups) -> bool:
         """True when this solve introduces NO new vocabulary entry — the
@@ -859,18 +888,19 @@ class TensorScheduler:
         return group_counts, remaining
 
     @staticmethod
-    def _cohort_price_order(problem, cohort, it_names: np.ndarray) -> np.ndarray:
+    def _cohort_price_order(problem, it_set: np.ndarray, enc_mask: np.ndarray,
+                            it_names: np.ndarray) -> np.ndarray:
         """Surviving instance types of a cohort ordered by cheapest admitted
         offering with name tiebreak — the vectorized OrderByPrice
         (types.go:117-134): an offering counts when available and its
         zone/captype value is admitted by the cohort's accumulated
-        requirement mask."""
-        t_idx = np.where(cohort.it_set)[0]
+        requirement mask (a [K, W] row of the pack's CohortSet)."""
+        t_idx = np.where(it_set)[0]
         if t_idx.size == 0:
             return t_idx
 
         def admits(key: int, vals: np.ndarray) -> np.ndarray:
-            mask = cohort.enc.mask[key]                    # [W] uint32
+            mask = enc_mask[key]                           # [W] uint32
             word = np.where(vals >= 0, vals // 32, 0)
             bit = np.where(vals >= 0, vals % 32, 0).astype(np.uint32)
             has = (mask[word] >> bit) & np.uint32(1)
@@ -900,21 +930,27 @@ class TensorScheduler:
         # cohorts from one solve overwhelmingly share (it_set, zone/captype
         # admission) — memoize the ordering per distinct key
         order_cache: dict = {}
-        for ci, cohort in enumerate(pr.cohorts):
-            okey = (cohort.it_set.tobytes(),
-                    cohort.enc.mask[problem.zone_key].tobytes(),
-                    cohort.enc.mask[problem.captype_key].tobytes())
+        cs = pr.cohorts  # the packer's columnar CohortSet
+        for ci in range(cs.C if cs is not None else 0):
+            it_set = cs.it_set[ci]
+            enc_mask = cs.enc_mask[ci]
+            okey = (it_set.tobytes(),
+                    enc_mask[problem.zone_key].tobytes(),
+                    enc_mask[problem.captype_key].tobytes())
             ordered = order_cache.get(okey)
             if ordered is None:
                 ordered = [catalog[t]
-                           for t in self._cohort_price_order(problem, cohort,
-                                                             it_names)]
+                           for t in self._cohort_price_order(
+                               problem, it_set, enc_mask, it_names)]
                 order_cache[okey] = ordered
-            base_reqs = templates[cohort.m].requirements.copy()
-            for g in cohort.pods_by_group:
+            m = int(cs.m[ci])
+            pods_by_group = cs.pods_by_group[ci]
+            base_reqs = templates[m].requirements.copy()
+            for g in pods_by_group:
                 base_reqs.add(*groups[g].requirements.values())
-            if cohort.zone is not None:
-                zone_name = vocab.values[zone_key][cohort.zone]
+            zi = int(cs.zone[ci])
+            if zi >= 0:
+                zone_name = vocab.values[zone_key][zi]
                 base_reqs.add(Requirement(api_labels.LABEL_TOPOLOGY_ZONE, IN,
                                           [zone_name]))
             # all pods of a group are identical: node requests = per-pod
@@ -923,17 +959,17 @@ class TensorScheduler:
             # must match what the node will actually host
             # (scheduler.go:356-382; the packer already budgeted for it)
             requests: dict = dict(
-                _daemon_overhead(templates[cohort.m], self.daemonset_pods))
-            for g, fill in cohort.pods_by_group.items():
+                _daemon_overhead(templates[m], self.daemonset_pods))
+            for g, fill in pods_by_group.items():
                 for rname, v in groups[g].requests.items():
                     requests[rname] = requests.get(rname, 0) + v * fill
-            for _ in range(cohort.n):
+            for _ in range(int(cs.n[ci])):
                 reqs = base_reqs.copy()
                 pods: List[Pod] = []
-                for g, fill in cohort.pods_by_group.items():
+                for g, fill in pods_by_group.items():
                     pods.extend(take(g, fill))
                 tnc = TensorNodeClaim(
-                    templates[cohort.m], reqs, ordered, pods, dict(requests))
+                    templates[m], reqs, ordered, pods, dict(requests))
                 # sibling claims of one cohort differ only in their pods —
                 # the sidecar result codec interns the claim shape by this
                 # id so n identical nodes encode once (codec.py

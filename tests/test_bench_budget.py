@@ -1,0 +1,118 @@
+"""Regression guard for the flagship Solve() tentpole (round 6).
+
+Round 5 proved the failure mode this file exists for: a correctness fix
+moved the cohort scan into per-cohort host Python and the headline
+benchmark regressed 0.499 s -> 1.197 s, discovered only at the NEXT
+benchmark capture. This guard runs a scaled-down headline mix (the bench
+deployment kinds at 2,000 pods x the kwok 144-type catalog) inside the
+normal test suite and pins everything that regression would have tripped:
+
+- the whole batch stays ON the vectorized tensor path (no host fallback,
+  no partition) — a "fix" that silently demotes mix shapes to the host
+  oracle fails here instead of a benchmark round later;
+- a generous wall-clock budget per solve — pure-Python cohort scans at
+  O(groups x cohorts) blow it even at this scale;
+- byte-identical placements across independent solves of the same batch
+  (the packer is deterministic; vectorization must keep it so);
+- pod-error identity with the host oracle, and exact node-count parity
+  per constraint kind everywhere it structurally holds (hostname pod
+  affinity is a documented deviation: the tensor path keeps those groups
+  alone, DEVIATIONS.md).
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+
+import bench
+
+N_PODS = 2000
+N_DEPLOYS = 36
+# generous: the solve runs ~0.2 s on CPU jax; a return of the round-5
+# per-cohort Python scan costs >5x at 50k pods and measurably here too
+BUDGET_SECONDS = 10.0
+
+
+def _mix():
+    saved = (bench.N_PODS, bench.N_DEPLOYS)
+    bench.N_PODS, bench.N_DEPLOYS = N_PODS, N_DEPLOYS
+    try:
+        return bench._pods()
+    finally:
+        bench.N_PODS, bench.N_DEPLOYS = saved
+
+
+def _claim_key(nc):
+    return (nc.template.nodepool_name,
+            tuple(sorted(nc.requirements.get(
+                api_labels.LABEL_TOPOLOGY_ZONE).values)),
+            tuple(it.name for it in nc.instance_type_options),
+            len(nc.pods))
+
+
+@pytest.fixture(scope="module")
+def solved():
+    pods = _mix()
+    ts = bench._scheduler(0)
+    ts.solve(pods)  # warm the jit cache: the budget times the solve, not XLA
+    ts = bench._scheduler(0)
+    t0 = time.perf_counter()
+    results = ts.solve(pods)
+    elapsed = time.perf_counter() - t0
+    return pods, ts, results, elapsed
+
+
+def test_headline_mix_stays_on_tensor_path(solved):
+    pods, ts, results, _ = solved
+    assert ts.fallback_reason == "", \
+        f"headline mix fell off the tensor path: {ts.fallback_reason}"
+    assert ts.partition == (len(pods), 0), ts.partition
+    assert not results.pod_errors
+
+
+def test_headline_mix_within_wall_clock_budget(solved):
+    _, _, _, elapsed = solved
+    assert elapsed < BUDGET_SECONDS, \
+        (f"scaled headline solve took {elapsed:.2f}s (budget "
+         f"{BUDGET_SECONDS}s) — the cohort scan likely fell off the "
+         "vectorized path")
+
+
+def test_solve_is_byte_identical_across_runs(solved):
+    pods, _, results, _ = solved
+    ts2 = bench._scheduler(0)
+    r2 = ts2.solve(pods)
+    assert ts2.fallback_reason == ""
+    assert sorted(map(_claim_key, r2.new_nodeclaims)) == \
+        sorted(map(_claim_key, results.new_nodeclaims))
+    assert r2.pod_errors == results.pod_errors
+
+
+def test_error_identity_vs_host_oracle(solved):
+    pods, _, results, _ = solved
+    host = bench._scheduler(0)
+    rh = host._host_solve(pods, "forced oracle comparison")
+    assert set(results.pod_errors) == set(rh.pod_errors)
+
+
+# hostname pod affinity (kind 3) is excluded: the tensor path packs each
+# affinity group on its own node while the oracle may co-locate distinct
+# groups (documented deviation) — count parity doesn't apply there
+@pytest.mark.parametrize("kind", [0, 1, 2, 4, 5, 6, 7, 8])
+def test_node_count_parity_vs_host_oracle_per_kind(kind):
+    pods = [p for p in _mix()
+            if int(p.metadata.name.split("-")[1]) % 9 == kind]
+    assert pods
+    ts = bench._scheduler(0)
+    r = ts.solve(pods)
+    assert ts.fallback_reason == ""
+    assert ts.partition == (len(pods), 0)
+    host = bench._scheduler(0)
+    rh = host._host_solve(pods, "forced oracle comparison")
+    assert len(r.new_nodeclaims) == len(rh.new_nodeclaims), \
+        (f"node count diverged from the host oracle for constraint kind "
+         f"{kind}: tensor={len(r.new_nodeclaims)} "
+         f"oracle={len(rh.new_nodeclaims)}")
+    assert set(r.pod_errors) == set(rh.pod_errors)

@@ -1,11 +1,17 @@
 """Instance selection, minValues, Gt/Lt, and relaxation behaviors
-(reference shapes: instance_selection_test.go + suite_test.go scenarios)."""
+(reference shapes: instance_selection_test.go + suite_test.go scenarios).
+
+The vector battery at the bottom runs each selection scenario against BOTH
+solvers — the host oracle and the tensor path — since instance selection
+is the component where the two are most likely to drift (price ordering,
+offering admission, minValues floors)."""
 
 import pytest
 
 from karpenter_tpu.api import labels as api_labels
 from karpenter_tpu.api.objects import NodeSelectorRequirement
-from karpenter_tpu.cloudprovider.kwok import (construct_instance_types,
+from karpenter_tpu.cloudprovider.kwok import (GROUP_INSTANCE_FAMILY,
+                                              construct_instance_types,
                                               make_instance_type, price_for)
 from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
 from karpenter_tpu.scheduling.requirement import GT, IN, LT, Requirement
@@ -13,6 +19,16 @@ from karpenter_tpu.scheduling.requirements import Requirements
 
 from factories import (make_nodepool, make_pod, make_pods, make_scheduler,
                        spread_zone)
+
+PATHS = ("host", "tensor")
+
+
+def solve_on(path, pools, its, pods, **kw):
+    """Solve on the named path; returns (results, tensor_scheduler_or_None)."""
+    if path == "host":
+        return make_scheduler(pools, its, pods, **kw).solve(pods), None
+    ts = TensorScheduler(pools, {p.name: list(its) for p in pools}, **kw)
+    return ts.solve(pods), ts
 
 
 class _MinValuesReq:
@@ -280,3 +296,247 @@ class TestCheapestCompatibleMatrix:
         [nc] = r.new_nodeclaims
         for it in nc.instance_type_options:
             assert it.allocatable()["cpu"] >= 7000, it.name
+
+
+def _best_price(it, captype=None, zone=None):
+    return min((o.price for o in it.offerings
+                if o.available
+                and (captype is None or o.capacity_type == captype)
+                and (zone is None or o.zone == zone)), default=float("inf"))
+
+
+def _gen_catalog(gens):
+    """One 4-cpu amd64/linux type per generation value, distinguishable by a
+    numeric company.io/generation label (the reference's Gt/Lt vectors)."""
+    its = []
+    for gen in gens:
+        it = make_instance_type(4, 2, api_labels.ARCHITECTURE_AMD64, "linux")
+        it.name = f"gen{gen}-4x"
+        it.requirements.add(Requirement(api_labels.LABEL_INSTANCE_TYPE,
+                                        IN, [it.name]))
+        it.requirements.add(Requirement("company.io/generation", IN,
+                                        [str(gen)]))
+        its.append(it)
+    return its
+
+
+@pytest.mark.parametrize("path", PATHS)
+class TestInstanceSelectionVectors:
+    """instance_selection_test.go vector battery, both solve paths."""
+
+    def test_spot_offering_heads_unrestricted_price_order(self, path):
+        """Spot is priced at 0.7x on-demand in the kwok catalog; with no
+        capacity-type constraint the launch head must be cheapest by its
+        spot offering (instance_selection_test.go capacity-type ordering)."""
+        its = construct_instance_types()[:48]
+        r, _ = solve_on(path, [make_nodepool()], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        opts = r.new_nodeclaims[0].instance_type_options
+        prices = [_best_price(it) for it in opts]
+        assert prices[0] == min(prices)
+        head = opts[0]
+        cheapest = min(head.offerings, key=lambda o: o.price)
+        assert cheapest.capacity_type == api_labels.CAPACITY_TYPE_SPOT
+
+    def test_on_demand_pool_prices_by_on_demand_offerings(self, path):
+        its = construct_instance_types()[:48]
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            api_labels.CAPACITY_TYPE_LABEL_KEY, "In",
+            (api_labels.CAPACITY_TYPE_ON_DEMAND,))])
+        r, _ = solve_on(path, [pool], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        [nc] = r.new_nodeclaims
+        ct = nc.requirements.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        assert ct.values_list() == [api_labels.CAPACITY_TYPE_ON_DEMAND]
+        opts = nc.instance_type_options
+        prices = [_best_price(it, captype=api_labels.CAPACITY_TYPE_ON_DEMAND)
+                  for it in opts]
+        assert prices[0] == min(prices)
+
+    def test_spot_unavailable_falls_back_to_on_demand(self, path):
+        """Capacity-type fallback: with every spot offering unavailable the
+        launch list orders (and launches) by on-demand offerings."""
+        its = construct_instance_types()[:24]
+        for it in its:
+            for o in it.offerings:
+                if o.capacity_type == api_labels.CAPACITY_TYPE_SPOT:
+                    o.available = False
+        r, _ = solve_on(path, [make_nodepool()], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        opts = r.new_nodeclaims[0].instance_type_options
+        assert opts
+        for it in opts:
+            avail = [o for o in it.offerings if o.available]
+            assert avail and all(
+                o.capacity_type == api_labels.CAPACITY_TYPE_ON_DEMAND
+                for o in avail)
+
+    def test_zone_pinned_pod_prices_by_that_zone(self, path):
+        """Zone x price: the order must rank by offerings IN the admitted
+        zone, not by a cheaper offering elsewhere."""
+        its = construct_instance_types()[:48]
+        # make zone-b artificially cheap for half the catalog: a zone-a pod
+        # must not be ranked by those zone-b prices
+        for it in its[::2]:
+            for o in it.offerings:
+                if o.zone == "test-zone-b":
+                    o.price *= 0.1
+        r, _ = solve_on(path, [make_nodepool()], its, [make_pod(
+            cpu="500m",
+            node_selector={api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a"})])
+        assert not r.pod_errors
+        opts = r.new_nodeclaims[0].instance_type_options
+        prices = [_best_price(it, zone="test-zone-a") for it in opts]
+        assert prices[0] == min(prices)
+
+    def test_arch_partition_splits_claims(self, path):
+        its = construct_instance_types()[:64]
+        pods = (make_pods(3, cpu="500m", node_selector={
+                    api_labels.LABEL_ARCH: api_labels.ARCHITECTURE_AMD64})
+                + make_pods(3, cpu="500m", node_selector={
+                    api_labels.LABEL_ARCH: api_labels.ARCHITECTURE_ARM64}))
+        r, _ = solve_on(path, [make_nodepool()], its, pods)
+        assert not r.pod_errors
+        archs = set()
+        for nc in r.new_nodeclaims:
+            its_archs = {it.requirements.get(api_labels.LABEL_ARCH)
+                         .values_list()[0] for it in nc.instance_type_options}
+            assert len(its_archs) == 1, "claim mixes architectures"
+            archs |= its_archs
+        assert archs == {api_labels.ARCHITECTURE_AMD64,
+                         api_labels.ARCHITECTURE_ARM64}
+
+    def test_os_partition_splits_claims(self, path):
+        its = construct_instance_types()[:64]
+        pods = (make_pods(3, cpu="500m",
+                          node_selector={api_labels.LABEL_OS: "linux"})
+                + make_pods(3, cpu="500m",
+                            node_selector={api_labels.LABEL_OS: "windows"}))
+        r, _ = solve_on(path, [make_nodepool()], its, pods)
+        assert not r.pod_errors
+        oses = set()
+        for nc in r.new_nodeclaims:
+            its_os = {it.requirements.get(api_labels.LABEL_OS)
+                      .values_list()[0] for it in nc.instance_type_options}
+            assert len(its_os) == 1, "claim mixes operating systems"
+            oses |= its_os
+        assert oses == {"linux", "windows"}
+
+    def test_not_in_zone_pool_excludes_zone(self, path):
+        its = construct_instance_types()[:24]
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            api_labels.LABEL_TOPOLOGY_ZONE, "NotIn", ("test-zone-b",))])
+        r, _ = solve_on(path, [pool], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        zone = r.new_nodeclaims[0].requirements.get(
+            api_labels.LABEL_TOPOLOGY_ZONE)
+        assert not zone.has("test-zone-b")
+        assert zone.has("test-zone-a")
+
+    def test_gt_lt_window_selects_interior_generations(self, path):
+        its = _gen_catalog((1, 2, 3, 4, 5))
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement("company.io/generation", "Gt", ("1",)),
+            NodeSelectorRequirement("company.io/generation", "Lt", ("5",))])
+        r, _ = solve_on(path, [pool], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        names = {it.name for it in r.new_nodeclaims[0].instance_type_options}
+        assert names == {"gen2-4x", "gen3-4x", "gen4-4x"}
+
+    def test_instance_type_selector_pins_single_type(self, path):
+        its = construct_instance_types()[:24]
+        target = its[7].name
+        r, _ = solve_on(path, [make_nodepool()], its, [make_pod(
+            cpu="500m",
+            node_selector={api_labels.LABEL_INSTANCE_TYPE: target})])
+        assert not r.pod_errors
+        assert [it.name for it in
+                r.new_nodeclaims[0].instance_type_options] == [target]
+
+    def test_not_in_instance_type_excludes_it(self, path):
+        its = construct_instance_types()[:24]
+        excluded = {its[0].name, its[1].name}
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            api_labels.LABEL_INSTANCE_TYPE, "NotIn", tuple(excluded))])
+        r, _ = solve_on(path, [pool], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        names = {it.name for it in r.new_nodeclaims[0].instance_type_options}
+        assert not (names & excluded)
+        assert names
+
+    def test_fully_unavailable_type_never_selected(self, path):
+        its = construct_instance_types()[:24]
+        dead = its[0]
+        for o in dead.offerings:
+            o.available = False
+        r, _ = solve_on(path, [make_nodepool()], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        assert dead.name not in {
+            it.name for it in r.new_nodeclaims[0].instance_type_options}
+
+    def test_oversized_pod_fails_everywhere(self, path):
+        its = construct_instance_types()[:24]
+        r, _ = solve_on(path, [make_nodepool()], its,
+                        [make_pod(cpu="9999", memory="9999Gi")])
+        assert r.pod_errors and not r.new_nodeclaims
+
+    def test_min_values_with_truncation_keeps_floor(self, path):
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (), 30)])
+        its = construct_instance_types()[:64]
+        r, _ = solve_on(path, [pool], its, [make_pod(cpu="500m")])
+        assert not r.pod_errors
+        assert len(r.new_nodeclaims[0].instance_type_options) >= 30
+        r.truncate_instance_types(35)
+        opts = r.new_nodeclaims[0].instance_type_options
+        assert 30 <= len(opts) <= 35
+
+
+class TestMinValuesPackingPressure:
+    """The round-6 packer enforces the minValues floor DURING packing: the
+    host oracle refuses per-pod adds that would drop a claim below the
+    floor (scheduler.py:159-162), so accumulated load must never narrow a
+    tensor claim's launch list under it either."""
+
+    def test_tensor_claims_keep_floor_under_load(self):
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (), 20)])
+        its = construct_instance_types()[:48]
+        pods = make_pods(400, cpu="500m", memory="512Mi",
+                         labels={"app": "mv"})
+        r, ts = solve_on("tensor", [pool], its, pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        assert not r.pod_errors
+        assert len(r.new_nodeclaims) > 1, "load should need several nodes"
+        for nc in r.new_nodeclaims:
+            assert len(nc.instance_type_options) >= 20, \
+                (f"claim narrowed below the minValues floor: "
+                 f"{len(nc.instance_type_options)}")
+
+    def test_host_oracle_agrees_on_floor_under_load(self):
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(api_labels.LABEL_INSTANCE_TYPE, "Exists", (), 20)])
+        its = construct_instance_types()[:48]
+        pods = make_pods(400, cpu="500m", memory="512Mi",
+                         labels={"app": "mv"})
+        r, _ = solve_on("host", [pool], its, pods)
+        assert not r.pod_errors
+        for nc in r.new_nodeclaims:
+            assert len(nc.instance_type_options) >= 20
+
+    def test_min_values_on_other_key_demotes_to_host_path(self):
+        """Distinct-value floors on non-instance-type keys need per-key
+        value counting; the tensor front end hands those to the oracle
+        rather than silently ignoring the floor."""
+        pool = make_nodepool(requirements=[
+            _MinValuesReq(GROUP_INSTANCE_FAMILY, "Exists", (), 2)])
+        its = construct_instance_types()[:48]
+        r, ts = solve_on("tensor", [pool], its, [make_pod(cpu="500m")])
+        assert ts.fallback_reason != "", \
+            "expected a host fallback for non-instance-type minValues"
+        assert not r.pod_errors
+        families = set()
+        for it in r.new_nodeclaims[0].instance_type_options:
+            families |= set(it.requirements.get(
+                GROUP_INSTANCE_FAMILY).values_list())
+        assert len(families) >= 2

@@ -136,3 +136,20 @@ def test_pods_per_node():
     assert got[0, 0].tolist() == [3, 15]
     # group 1 never fits
     assert got[1, 0].tolist() == [0, 0]
+
+
+def test_pack_bits_bit_column_round_trip():
+    """encode.pack_bits/bit_column carry the packer's per-cohort
+    zone-feasibility bitfield (binpack.CohortSet.okz): every position must
+    survive the pack, and bitwise AND of packed rows must equal the AND of
+    the bool planes."""
+    rng = np.random.default_rng(7)
+    for z in (1, 3, 6, 8, 9, 17):
+        a = rng.random((5, 11, z)) < 0.5
+        b = rng.random((5, 11, z)) < 0.5
+        pa, pb = enc.pack_bits(a), enc.pack_bits(b)
+        assert pa.shape == (5, 11, -(-z // 8))
+        for i in range(z):
+            np.testing.assert_array_equal(enc.bit_column(pa, i), a[..., i])
+            np.testing.assert_array_equal(
+                enc.bit_column(pa & pb, i), (a & b)[..., i])

@@ -5,7 +5,20 @@ including the complement/NotIn corner cases at requirements.go:283-304.
 """
 
 import pytest
-from hypothesis import given, strategies as st
+
+try:
+    from hypothesis import given, strategies as st
+except ImportError:  # pragma: no cover - environment-dependent
+    # hypothesis is optional: only the property-based tests skip, the rest
+    # of this module must stay collectible (`pytest tests/` collects clean)
+    class _MissingStrategies:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _MissingStrategies()
+
+    def given(*_args, **_kwargs):
+        return pytest.mark.skip(reason="hypothesis not installed")
 
 from karpenter_tpu.scheduling.requirement import (
     DOES_NOT_EXIST, EXISTS, GT, IN, INF, LT, NOT_IN, Requirement)
