@@ -73,6 +73,15 @@ class FakeCloudProvider(CloudProvider):
         self._repair_policies: list = []
         self.created: dict = {}
         self._seq = itertools.count(1)
+        # seeded fault hook (utils/chaos.FaultInjector): when set, each SPI
+        # call below consults it FIRST and raises injected transient or
+        # terminal errors at the injector's rate — the fake's analog of the
+        # one-shot next_*_err knobs, but schedule-driven for chaos tests
+        self.chaos = None
+
+    def _chaos(self, method: str, name: str = "") -> None:
+        if self.chaos is not None:
+            self.chaos.maybe_raise(f"fake.{method}", name)
 
     @property
     def name(self) -> str:
@@ -82,6 +91,7 @@ class FakeCloudProvider(CloudProvider):
         self.__init__(self.instance_types)
 
     def create(self, nodeclaim: NodeClaim) -> NodeClaim:
+        self._chaos("create", nodeclaim.name)
         self.create_calls.append(nodeclaim)
         if self.next_create_err is not None:
             err, self.next_create_err = self.next_create_err, None
@@ -108,6 +118,7 @@ class FakeCloudProvider(CloudProvider):
         return nodeclaim
 
     def delete(self, nodeclaim: NodeClaim) -> None:
+        self._chaos("delete", nodeclaim.name)
         self.delete_calls.append(nodeclaim)
         if self.next_delete_err is not None:
             err, self.next_delete_err = self.next_delete_err, None
@@ -117,6 +128,7 @@ class FakeCloudProvider(CloudProvider):
         del self.created[nodeclaim.status.provider_id]
 
     def get(self, provider_id: str) -> NodeClaim:
+        self._chaos("get", provider_id)
         if self.next_get_err is not None:
             err, self.next_get_err = self.next_get_err, None
             raise err
@@ -128,6 +140,8 @@ class FakeCloudProvider(CloudProvider):
         return list(self.created.values())
 
     def get_instance_types(self, nodepool) -> "list[InstanceType]":
+        self._chaos("get_instance_types",
+                    getattr(nodepool, "name", "") or "")
         return list(self.instance_types)
 
     def is_drifted(self, nodeclaim) -> str:

@@ -225,6 +225,21 @@ def insufficient_capacity(nodeclaim, err: str) -> Event:
         dedupe_values=(nodeclaim.name,))
 
 
+# -- fault-tolerant runtime --------------------------------------------------
+
+def reconcile_quarantined(kind: str, name: str, namespace: str,
+                          controller: str, err: str) -> Event:
+    """Warning published when the manager dead-letters a work item after
+    exhausting its retry budget (no reference analog: controller-runtime
+    retries forever; see DEVIATIONS.md)."""
+    return Event(
+        object_kind=kind, object_name=name, namespace=namespace,
+        type=WARNING, reason="ReconcileQuarantined",
+        message=(f"Quarantined after repeated reconcile failures in "
+                 f"{controller}: {_truncate(err)}"),
+        dedupe_values=(controller, name))
+
+
 # -- node health (health/events.go) ------------------------------------------
 
 def node_repair_blocked(node_name: str, nodeclaim_name: str,

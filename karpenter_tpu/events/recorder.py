@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..metrics.registry import EVENTS_DROPPED
 from ..utils.clock import Clock
 
 DEDUPE_TTL_SECONDS = 120.0   # recorder.go dedupeTimeout
@@ -76,8 +77,10 @@ class Recorder:
             if self.sink is not None:
                 try:
                     self.sink(ev)
-                except Exception:  # noqa: BLE001 — best-effort delivery
-                    pass
+                except Exception:  # noqa: BLE001 — best-effort delivery,
+                    # but every drop is counted: silent loss is the one
+                    # thing best-effort must not be
+                    EVENTS_DROPPED.inc({"reason": "sink_error"})
 
     def for_object(self, name: str) -> List[Event]:
         return [e for e in self.events if e.object_name == name]
@@ -112,6 +115,7 @@ class AsyncSink:
             self._q.put_nowait(ev)
         except queue.Full:
             self.dropped += 1
+            EVENTS_DROPPED.inc({"reason": "queue_full"})
 
     def _run(self) -> None:
         while True:
@@ -122,7 +126,7 @@ class AsyncSink:
                 try:
                     self._deliver(item)
                 except Exception:  # noqa: BLE001 — best-effort delivery
-                    pass
+                    EVENTS_DROPPED.inc({"reason": "deliver_error"})
             finally:
                 self._q.task_done()
 

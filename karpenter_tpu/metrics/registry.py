@@ -224,3 +224,21 @@ NODEPOOL_USAGE = REGISTRY.gauge(
 NODEPOOL_LIMIT = REGISTRY.gauge(
     "karpenter_nodepools_limit", "Resource limits per nodepool",
     ("nodepool", "resource_type"))
+
+# -- fault-tolerant runtime (controller-runtime's
+# controller_runtime_reconcile_errors_total analog plus the quarantine /
+# circuit-breaker state this runtime adds on top) -------------------------
+
+RECONCILE_ERRORS = REGISTRY.counter(
+    "karpenter_reconcile_errors_total",
+    "Reconcile invocations that raised, per controller", ("controller",))
+RECONCILE_QUARANTINED = REGISTRY.gauge(
+    "karpenter_reconcile_quarantined",
+    "Work items quarantined in the dead-letter set after exhausting "
+    "retries", ("controller",))
+EVENTS_DROPPED = REGISTRY.counter(
+    "karpenter_events_dropped_total",
+    "Events dropped by best-effort delivery", ("reason",))
+SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
+    "karpenter_solver_circuit_state",
+    "Tensor-solver circuit breaker state (0=closed, 1=open, 2=half-open)")

@@ -63,7 +63,8 @@ class Operator:
             # a slow apiserver must never stall the reconcile loop
             from ..events.recorder import AsyncSink
             self.recorder.sink = AsyncSink(self.store.post_event)
-        self.manager = Manager(self.store, self.clock)
+        self.manager = Manager(self.store, self.clock,
+                               recorder=self.recorder)
         self.serving: Optional[ServingGroup] = None
 
         gates = self.options.gates
@@ -196,9 +197,10 @@ class Operator:
 
     # -- drive --------------------------------------------------------------
 
-    def step(self) -> None:
-        """One full pass: watch fallout + singleton loops (tests/sim)."""
-        self.manager.run_until_quiet()
+    def step(self) -> bool:
+        """One full pass: watch fallout + singleton loops (tests/sim).
+        Returns whether the manager quiesced (run_until_quiet)."""
+        return self.manager.run_until_quiet()
 
     def _lease(self):
         """Leader-election lease when enabled (operator.go:137-141)."""

@@ -127,6 +127,26 @@ def _debug_profile(query: dict):
     return 200, "text/plain", "\n".join(lines) + "\n"
 
 
+def _debug_deadletter_factory(manager):
+    """Quarantined work items (the manager's dead-letter set): what gave
+    up retrying, why, and when — the first stop when reconcile_quarantined
+    is non-zero. Served unconditionally (unlike the profiling routes):
+    quarantine is an operational surface, not a diagnostic one."""
+    def fn():
+        if manager is None:
+            return 404, "text/plain", "no manager attached"
+        items = dict(manager.deadletter)  # snapshot (GIL-atomic copy)
+        lines = [f"quarantined {len(items)}"]
+        for key, info in sorted(items.items()):
+            lines.append(
+                f"{info['controller']} {info['kind']}/"
+                f"{(info['namespace'] + '/') if info['namespace'] else ''}"
+                f"{info['name']} failures={info['failures']} "
+                f"at={info['at']:.3f} error={info['error']}")
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
 def _debug_timers_factory(manager):
     def fn():
         if manager is None:
@@ -166,6 +186,9 @@ class ServingGroup:
             "/metrics": lambda: (200, "text/plain; version=0.0.4",
                                  registry.expose()),
         }
+        if manager is not None:
+            metrics_routes["/debug/deadletter"] = \
+                _debug_deadletter_factory(manager)
         if profiling:
             metrics_routes["/debug/stacks"] = _debug_stacks
             metrics_routes["/debug/timers"] = _debug_timers_factory(manager)

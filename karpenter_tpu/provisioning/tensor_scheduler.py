@@ -70,7 +70,100 @@ class _CatalogEncoding:
 
 
 import threading
+import time
 from collections import OrderedDict
+
+
+class SolverCircuitBreaker:
+    """Device-failure circuit breaker on the tensor solve path.
+
+    The host oracle is always a correct (slower) fallback, so a *crashing*
+    tensor path — device OOM, runtime wedged, kernel bug on an unforeseen
+    shape — must degrade the solver to the oracle instead of failing every
+    provisioning pass through its retry budget. Classic three-state
+    breaker: CLOSED counts consecutive tensor-path exceptions; at
+    `threshold` it OPENs (every solve goes straight to the host with
+    fallback_reason="circuit_open", no tensor attempt, no device touch);
+    after `cooldown` seconds the next solve HALF-OPENs as a probe — one
+    success re-closes, one failure re-opens for another cooldown.
+
+    The closed-state hot path is a single attribute compare — zero
+    measurable overhead on the headline solve (BENCH_MODE=faults pins
+    this). State transitions publish the solver_circuit_state gauge
+    (0=closed, 1=open, 2=half-open) — only when constructed with
+    `publish=True`: the gauge is a single series, so exactly one breaker
+    (the process-wide SOLVER_CIRCUIT) owns it; ad-hoc breakers (bench,
+    tests, experiments) must not stomp the production export. `now` is
+    injectable for fake-clock tests; the default is monotonic wall time.
+    Thread-safe: the sidecar serves solves from a thread pool, so failure
+    counting and transitions take a lock (concurrent half-open probes are
+    allowed — worst case a few extra probes race, all of which must
+    succeed to matter)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+    def __init__(self, threshold: int = 5, cooldown: float = 30.0,
+                 now=None, publish: bool = False):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self._now = now or time.monotonic
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at = 0.0
+        self._publish_metric = publish
+        self.state = self.CLOSED
+        self._publish()
+
+    def _publish(self) -> None:
+        if not self._publish_metric:
+            return
+        from ..metrics.registry import SOLVER_CIRCUIT_STATE
+        SOLVER_CIRCUIT_STATE.set(self._GAUGE[self.state])
+
+    def allow(self) -> bool:
+        """May this solve attempt the tensor path?"""
+        if self.state == self.CLOSED:
+            return True
+        with self._lock:
+            if self.state == self.OPEN \
+                    and self._now() - self._opened_at >= self.cooldown:
+                self.state = self.HALF_OPEN
+                self._publish()
+            return self.state != self.OPEN
+
+    def record_success(self) -> None:
+        if self._failures == 0 and self.state == self.CLOSED:
+            return  # hot path: nothing to reset, skip the lock
+        with self._lock:
+            self._failures = 0
+            if self.state != self.CLOSED:
+                self.state = self.CLOSED
+                self._publish()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self.state == self.HALF_OPEN \
+                    or self._failures >= self.threshold:
+                self._opened_at = self._now()
+                if self.state != self.OPEN:
+                    self.state = self.OPEN
+                    self._publish()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = 0.0
+            if self.state != self.CLOSED:
+                self.state = self.CLOSED
+                self._publish()
+
+
+# Process-wide breaker: TensorScheduler instances are constructed per solve
+# (provisioner scheduler_factory), so breaker state MUST outlive them. Sole
+# owner of the solver_circuit_state gauge.
+SOLVER_CIRCUIT = SolverCircuitBreaker(publish=True)
 
 _CATALOG_CACHE: "OrderedDict[tuple, _CatalogEncoding]" = OrderedDict()
 _CATALOG_CACHE_MAX = 4
@@ -187,7 +280,8 @@ class TensorScheduler:
                  state_nodes=(), daemonset_pods: List[Pod] = (),
                  cluster: Optional[ClusterView] = None,
                  initial_zone_counts=None, force_tensor: bool = False,
-                 mesh=None, catalog_token: Optional[tuple] = None):
+                 mesh=None, catalog_token: Optional[tuple] = None,
+                 circuit: Optional[SolverCircuitBreaker] = None):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -201,6 +295,8 @@ class TensorScheduler:
         # precomputed catalog cache key (catalog_cache_token): ONLY valid
         # when the caller guarantees the catalog is never mutated in place
         self.catalog_token = catalog_token
+        # shared breaker by default: schedulers are per-solve, trips aren't
+        self.circuit = circuit if circuit is not None else SOLVER_CIRCUIT
         self.fallback_reason: str = ""
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
@@ -228,11 +324,24 @@ class TensorScheduler:
         self.partition = (sum(g.count for g in groups), len(leftover))
         if not groups:
             return self._host_solve(pods, reason)
+        if not self.force_tensor and not self.circuit.allow():
+            # breaker open: the device path crashed repeatedly — serve
+            # from the host oracle without touching the device until the
+            # cooldown's half-open probe
+            return self._host_solve(pods, "circuit_open")
         eligible = [p for g in groups for p in g.pods]
         try:
             results = self._tensor_solve(groups, eligible)
         except _FallbackError as e:
+            # expected expressibility fallback: the kernel worked as
+            # designed, so the breaker doesn't count it either way
             return self._host_solve(pods, str(e))
+        except Exception as e:  # noqa: BLE001 — device-failure degradation
+            self.circuit.record_failure()
+            if self.force_tensor:
+                raise
+            return self._host_solve(pods, f"tensor solve failed: {e!r}")
+        self.circuit.record_success()
         # the host pass only adds value over the packer for pods whose group
         # carries relaxable preferences (the relaxation ladder,
         # preferences.go:38-57) — for everything else it re-derives the same

@@ -10,6 +10,14 @@ from __future__ import annotations
 from typing import Dict, Hashable
 
 
+class TerminalError(Exception):
+    """Non-retryable failure (reconcile.TerminalError mirror): the retry
+    machinery must not re-attempt it — retrying cannot help (bad spec,
+    permanent rejection). Lives here with the retry policy so leaf modules
+    (utils/chaos.py) can raise it without importing the controller runtime;
+    controllers.manager re-exports it as its public home."""
+
+
 class ItemBackoff:
     def __init__(self, base: float, cap: float):
         self.base = base

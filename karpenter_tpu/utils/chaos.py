@@ -1,0 +1,100 @@
+"""Seeded fault injection: the chaos-engineering substrate.
+
+The reference operator's resilience is exercised by real clusters being
+flaky at it; the standalone framework needs the flakiness injected. One
+`FaultInjector` is shared by every chaos surface — the store wrapper
+(kube/chaos.py), the cloudprovider wrapper (cloudprovider/chaos.py), and
+the FakeCloudProvider hooks — so a single seeded RNG drives the whole
+fault schedule deterministically (Basiri et al., "Chaos Engineering":
+reproducible experiments, not random vandalism).
+
+Faults fire only while a controller is reconciling (utils/injection.py
+contextvar set by the Manager dispatch): test setup and assertions talk to
+the store/provider unperturbed, exactly like a chaos experiment that spares
+the control plane's own tooling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+from collections import Counter
+from typing import Optional
+
+from .backoff import TerminalError
+from .injection import controller_name
+
+
+class InjectedFault(Exception):
+    """Transient injected failure (apiserver 500 / provider throttle
+    analog): reconcilers are expected to surface it and the manager to
+    retry it through the item backoff."""
+
+
+class InjectedTerminalFault(TerminalError):
+    """Terminal injected failure: the manager must NOT retry it."""
+
+
+class FaultInjector:
+    """Seeded fault schedule shared across chaos surfaces.
+
+    - ``rate``: probability that any gated operation raises.
+    - ``terminal_rate``: fraction of fired faults that are terminal
+      (InjectedTerminalFault) instead of transient.
+    - ``poison(name)``: operations touching that object name ALWAYS raise
+      transiently — the deliberately-unreconcilable object whose landing
+      in the dead-letter set the soak test asserts.
+    - ``reconcile_only`` (default True): faults fire only inside a
+      reconcile (controller-name contextvar set), so harness setup code
+      is never perturbed.
+
+    ``counts`` records fired faults per operation label for assertions
+    ("faults actually fired") and experiment reports.
+    """
+
+    def __init__(self, seed: int = 0, rate: float = 0.05,
+                 terminal_rate: float = 0.0, reconcile_only: bool = True):
+        self.rng = random.Random(seed)
+        self.rate = rate
+        self.terminal_rate = terminal_rate
+        self.reconcile_only = reconcile_only
+        self.enabled = True
+        self.poisoned: set = set()
+        self.counts: Counter = Counter()
+
+    def poison(self, name: str) -> None:
+        self.poisoned.add(name)
+
+    def maybe_raise(self, op: str, name: str = "") -> None:
+        """Fault gate: called at the top of every wrapped operation."""
+        if not self.enabled:
+            return
+        if self.reconcile_only and not controller_name():
+            return
+        if name and name in self.poisoned:
+            self.counts[op] += 1
+            raise InjectedFault(f"poisoned object {name!r} in {op}")
+        if self.rate and self.rng.random() < self.rate:
+            self.counts[op] += 1
+            if self.terminal_rate \
+                    and self.rng.random() < self.terminal_rate:
+                raise InjectedTerminalFault(f"injected terminal fault "
+                                            f"in {op} ({name or 'op'})")
+            raise InjectedFault(f"injected fault in {op} "
+                                f"({name or 'op'})")
+
+    def fired(self) -> int:
+        return sum(self.counts.values())
+
+
+@contextlib.contextmanager
+def chaos_pause(injector: Optional[FaultInjector]):
+    """Context manager: suspend fault injection (convergence checks)."""
+    if injector is None:
+        yield
+        return
+    prev, injector.enabled = injector.enabled, False
+    try:
+        yield
+    finally:
+        injector.enabled = prev

@@ -16,3 +16,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (deterministic: fixed seed, "
+        "fake clock, no sleeps — tier-1 eligible by construction)")

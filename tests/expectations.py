@@ -71,14 +71,26 @@ class MinValuesReq:
 class Env:
     """Everything a scenario needs, wired like the operator."""
 
-    def __init__(self, spot_to_spot: bool = False):
-        self.clock = FakeClock()
-        self.store = Store(self.clock)
+    def __init__(self, spot_to_spot: bool = False, clock=None, store=None,
+                 provider=None):
+        """`store`/`provider` are injectable so chaos scenarios can swap in
+        the fault-injecting variants (kube/chaos.ChaosStore,
+        cloudprovider/chaos.ChaosCloudProvider) without re-wiring the
+        roster; a custom provider may be a factory taking the store."""
+        self.clock = clock or FakeClock()
+        self.store = store if store is not None else Store(self.clock)
         self.cluster = Cluster(self.store, self.clock)
         wire_informers(self.store, self.cluster)
-        self.provider = KwokCloudProvider(store=self.store)
+        self.provider = (provider(self.store) if callable(provider)
+                         else provider) if provider is not None \
+            else KwokCloudProvider(store=self.store)
         self.recorder = Recorder(self.clock)
-        self.mgr = Manager(self.store, self.clock)
+        self.mgr = Manager(self.store, self.clock, recorder=self.recorder)
+        # crash isolation would silently absorb a regressed reconciler that
+        # raises (pre-isolation it crashed the test); settle() compensates
+        # by asserting no reconcile errors fired unless a scenario opts in
+        self.allow_reconcile_errors = False
+        self._reconcile_errors_mark = self._reconcile_errors_total()
         self.provisioner = Provisioner(self.store, self.cluster,
                                        self.provider, self.clock,
                                        recorder=self.recorder)
@@ -101,11 +113,26 @@ class Env:
 
     # -- drive helpers ------------------------------------------------------
 
+    @staticmethod
+    def _reconcile_errors_total() -> float:
+        from karpenter_tpu.metrics.registry import RECONCILE_ERRORS
+        return sum(RECONCILE_ERRORS._values.values())
+
+    def _assert_no_reconcile_errors(self) -> None:
+        if self.allow_reconcile_errors:
+            return
+        total = self._reconcile_errors_total()
+        assert total == self._reconcile_errors_mark, (
+            "a reconciler raised during the scenario (crash isolation "
+            "absorbed it — set env.allow_reconcile_errors = True if "
+            "injected faults are the point of the test)")
+
     def settle(self, rounds: int = 4) -> None:
         for _ in range(rounds):
             self.mgr.run_until_quiet()
             self.clock.step(1.1)
-        self.mgr.run_until_quiet()
+        assert self.mgr.run_until_quiet(), "manager did not quiesce"
+        self._assert_no_reconcile_errors()
 
     def reconcile_disruption(self) -> None:
         """One full disruption decision: the compute pass, the
@@ -116,7 +143,8 @@ class Env:
             self.clock.step(CONSOLIDATION_TTL_SECONDS + 0.1)
             self.disruption.reconcile()
         self.queue.reconcile()
-        self.mgr.run_until_quiet()
+        assert self.mgr.run_until_quiet(), "manager did not quiesce"
+        self._assert_no_reconcile_errors()
 
     def run_disruption(self, rounds: int = 4) -> None:
         for _ in range(rounds):

@@ -111,6 +111,332 @@ class TestConcurrentSolves:
             server.stop(0)
 
 
+class TestCrashIsolation:
+    """Fault-tolerant reconcile runtime (controller-runtime recovers
+    reconcile panics and retries through a rate-limited workqueue;
+    controller.go:105-117 + ItemExponentialFailureRateLimiter): a raising
+    reconciler must never crash the dispatch loop or lose its item."""
+
+    def _env(self):
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.api.storage import StorageClass
+        from karpenter_tpu.controllers.manager import Controller, Manager
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        store = Store(clock)
+        recorder = Recorder(clock)
+        mgr = Manager(store, clock, recorder=recorder)
+        return clock, store, recorder, mgr, Controller, StorageClass, \
+            ObjectMeta
+
+    def _flush(self, mgr, clock, rounds=40, step=301.0):
+        """Advance past every backoff delay (cap 300s) until quiet."""
+        for _ in range(rounds):
+            clock.step(step)
+            mgr.advance(0)
+            if not mgr._timers and not mgr._queue:
+                return
+        raise AssertionError("retry timers never drained")
+
+    def test_raise_once_then_succeed_retries_and_forgets(self):
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class Flaky(Controller):
+            name = "flaky"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(clock.now())
+                if len(calls) == 1:
+                    raise RuntimeError("transient")
+
+        mgr.register(Flaky())
+        store.create(SC(metadata=OM(name="a")))
+        assert mgr.run_until_quiet()     # failure isolated, loop survives
+        assert len(calls) == 1
+        clock.step(1.0)                  # base backoff delay
+        mgr.advance(0)
+        assert len(calls) == 2           # retried and succeeded
+        key = ("flaky", "StorageClass", "default", "a")
+        assert mgr.backoff.failures(key) == 0   # forgotten on success
+        assert key not in mgr.deadletter
+
+    def test_raise_forever_quarantines_with_metric_and_event(self):
+        from karpenter_tpu.metrics.registry import (RECONCILE_ERRORS,
+                                                    RECONCILE_QUARANTINED)
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class Crash(Controller):
+            name = "crash-forever"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                raise RuntimeError("hopeless")
+
+        mgr.register(Crash())
+        errs0 = RECONCILE_ERRORS.value({"controller": "crash-forever"})
+        store.create(SC(metadata=OM(name="b")))
+        assert mgr.run_until_quiet()
+        self._flush(mgr, clock)
+        # exactly max_retries attempts, then the dead-letter set
+        assert len(calls) == mgr.max_retries
+        key = ("crash-forever", "StorageClass", "default", "b")
+        assert key in mgr.deadletter
+        assert mgr.deadletter[key]["failures"] == mgr.max_retries
+        assert RECONCILE_ERRORS.value(
+            {"controller": "crash-forever"}) - errs0 == mgr.max_retries
+        assert RECONCILE_QUARANTINED.value(
+            {"controller": "crash-forever"}) == 1
+        assert recorder.reasons_for("b") == ["ReconcileQuarantined"]
+        # a fresh watch event releases the quarantine for another budget
+        store.update(store.get(SC, "b", "default"))
+        assert key not in mgr.deadletter
+        assert RECONCILE_QUARANTINED.value(
+            {"controller": "crash-forever"}) == 0
+        mgr.drain()
+        assert len(calls) == mgr.max_retries + 1
+
+    def test_terminal_error_is_not_retried(self):
+        from karpenter_tpu.controllers.manager import TerminalError
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class Term(Controller):
+            name = "terminal"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                raise TerminalError("bad spec")
+
+        mgr.register(Term())
+        store.create(SC(metadata=OM(name="c")))
+        assert mgr.run_until_quiet()
+        self._flush(mgr, clock)
+        assert len(calls) == 1           # no retry, ever
+        key = ("terminal", "StorageClass", "default", "c")
+        assert key not in mgr.deadletter  # and no quarantine
+        assert not mgr._timers
+
+    def test_insufficient_capacity_backs_off_but_never_quarantines(self):
+        from karpenter_tpu.cloudprovider.types import \
+            InsufficientCapacityError
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class Capacity(Controller):
+            name = "capacity"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                raise InsufficientCapacityError("no capacity anywhere")
+
+        mgr.register(Capacity())
+        store.create(SC(metadata=OM(name="d")))
+        assert mgr.run_until_quiet()
+        for _ in range(3 * mgr.max_retries):
+            clock.step(301.0)
+            mgr.advance(0)
+        # far past the quarantine threshold and still retrying
+        assert len(calls) > mgr.max_retries + 2
+        assert ("capacity", "StorageClass", "default", "d") \
+            not in mgr.deadletter
+
+    def test_exempt_failures_reset_the_quarantine_budget(self):
+        """A long insufficient-capacity streak must not pre-spend the
+        quarantine budget: the first transient failure after it gets the
+        full max_retries budget, not instant dead-lettering."""
+        from karpenter_tpu.cloudprovider.types import \
+            InsufficientCapacityError
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class CapacityThenFlaky(Controller):
+            name = "mixed"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                if len(calls) <= 12:
+                    raise InsufficientCapacityError("no capacity")
+                raise RuntimeError("transient flake")
+
+        mgr.register(CapacityThenFlaky())
+        store.create(SC(metadata=OM(name="m")))
+        assert mgr.run_until_quiet()
+        key = ("mixed", "StorageClass", "default", "m")
+        # drive through the capacity streak and into the transient phase
+        while len(calls) < 13:
+            clock.step(301.0)
+            mgr.advance(0)
+        assert key not in mgr.deadletter   # 13th failure != instant death
+        self._flush(mgr, clock)
+        # quarantine only after max_retries CONSECUTIVE transient failures,
+        # and the recorded count is the budget consumed, not the raw
+        # backoff count inflated by the exempt capacity streak
+        assert len(calls) == 12 + mgr.max_retries
+        assert key in mgr.deadletter
+        assert mgr.deadletter[key]["failures"] == mgr.max_retries
+
+    def test_singleton_crash_is_isolated_and_backed_off(self):
+        from karpenter_tpu.controllers.manager import SingletonController
+        from karpenter_tpu.metrics.registry import RECONCILE_ERRORS
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class Engine(SingletonController):
+            name = "engine"
+
+            def reconcile(self):
+                calls.append(clock.now())
+                raise RuntimeError("engine stalled")
+
+        mgr.register(Engine())
+        errs0 = RECONCILE_ERRORS.value({"controller": "engine"})
+        mgr.tick()                       # survives the raise
+        assert len(calls) == 1
+        mgr.tick()                       # inside the backoff window: skipped
+        assert len(calls) == 1
+        clock.step(1.1)
+        mgr.tick()                       # window elapsed: retried
+        assert len(calls) == 2
+        assert RECONCILE_ERRORS.value({"controller": "engine"}) - errs0 == 2
+
+    def test_exactly_once_requeue_under_concurrent_event_during_failure(self):
+        """The drain() race the refactor closed: the _queued key used to be
+        discarded before reconcile ran, so a store event arriving WHILE the
+        reconcile was failing double-queued the item — one entry from the
+        event, one from the failure-path retry. The dirty-set fold must
+        leave exactly one retry."""
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class UpdatesThenFails(Controller):
+            name = "racy"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(clock.now())
+                if len(calls) == 1:
+                    # concurrent event for the SAME item mid-reconcile
+                    # (watch fan-out is synchronous in this store)
+                    store.update(obj)
+                    raise RuntimeError("failed after mutating")
+
+        mgr.register(UpdatesThenFails())
+        store.create(SC(metadata=OM(name="r")))
+        assert mgr.run_until_quiet()
+        # the concurrent event was folded into the failure retry: nothing
+        # queued now, exactly one retry timer armed
+        assert len(calls) == 1
+        assert not mgr._queue
+        assert len(mgr._timer_pending) == 1
+        clock.step(1.0)
+        mgr.advance(0)
+        assert len(calls) == 2           # exactly one retry ran
+        self._flush(mgr, clock)
+        assert len(calls) == 2           # and no ghost duplicate later
+
+    def test_event_during_terminal_failure_is_not_lost(self):
+        """A concurrent watch event arriving while the reconcile ends in
+        TerminalError must still re-reconcile the item — 'no retry' means
+        the FAILURE isn't retried, not that fresh input is dropped."""
+        from karpenter_tpu.controllers.manager import TerminalError
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class UpdatesThenTerminal(Controller):
+            name = "term-racy"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                if len(calls) == 1:
+                    store.update(obj)
+                    raise TerminalError("rejected")
+
+        mgr.register(UpdatesThenTerminal())
+        store.create(SC(metadata=OM(name="t")))
+        assert mgr.run_until_quiet()
+        assert len(calls) == 2  # the mid-reconcile event was re-dispatched
+
+    def test_stale_requeue_timer_does_not_release_quarantine(self):
+        """A periodic recheck armed by an earlier SUCCESS must not lift a
+        later quarantine: only a fresh watch event releases it."""
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        from karpenter_tpu.controllers.manager import Result
+        calls = []
+
+        class SucceedsThenCrashes(Controller):
+            name = "periodic"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                if len(calls) == 1:
+                    return Result(requeue_after=6000.0)  # periodic recheck
+                raise RuntimeError("broke after the first pass")
+
+        mgr.register(SucceedsThenCrashes())
+        sc = SC(metadata=OM(name="p"))
+        store.create(sc)
+        assert mgr.run_until_quiet()       # success: timer armed at +6000
+        store.update(sc)                   # trigger the failure chain
+        assert mgr.run_until_quiet()
+        self._flush(mgr, clock)            # steps far past +6000
+        key = ("periodic", "StorageClass", "default", "p")
+        assert key in mgr.deadletter       # the stale timer did NOT release
+        assert len(calls) == 1 + mgr.max_retries
+
+    def test_singleton_terminal_error_backs_off_at_the_cap(self):
+        from karpenter_tpu.controllers.manager import (RETRY_CAP_SECONDS,
+                                                       SingletonController,
+                                                       TerminalError)
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class TermEngine(SingletonController):
+            name = "term-engine"
+
+            def reconcile(self):
+                calls.append(clock.now())
+                raise TerminalError("config rejected")
+
+        mgr.register(TermEngine())
+        mgr.tick()
+        assert len(calls) == 1
+        clock.step(RETRY_CAP_SECONDS - 1)
+        mgr.tick()
+        assert len(calls) == 1             # slower than any transient retry
+        clock.step(1.0)
+        mgr.tick()
+        assert len(calls) == 2
+
+    def test_event_during_successful_reconcile_requeues_once(self):
+        clock, store, recorder, mgr, Controller, SC, OM = self._env()
+        calls = []
+
+        class UpdatesOnce(Controller):
+            name = "self-update"
+            kinds = (SC,)
+
+            def reconcile(self, obj):
+                calls.append(1)
+                if len(calls) == 1:
+                    store.update(obj)    # dirty mark, no double-queue
+
+        mgr.register(UpdatesOnce())
+        store.create(SC(metadata=OM(name="s")))
+        assert mgr.run_until_quiet()
+        assert len(calls) == 2           # initial + exactly one requeue
+
+
 class TestNoGcGuard:
     def test_nested_and_threaded_sections_restore_gc(self):
         """no_gc() must be reentrant and thread-safe: the collector resumes

@@ -69,7 +69,7 @@ def settle(env, rounds=6):
     for _ in range(rounds):
         env.mgr.run_until_quiet()
         env.clock.step(1.1)
-    env.mgr.run_until_quiet()
+    assert env.mgr.run_until_quiet(), "manager did not quiesce"
 
 
 def provision_node(env, pool_name="default", cpu="2500m", name=None, tgp=None):

@@ -53,7 +53,7 @@ def settle(env, rounds=5):
     for _ in range(rounds):
         env.mgr.run_until_quiet()
         env.clock.step(1.1)
-    env.mgr.run_until_quiet()
+    assert env.mgr.run_until_quiet(), "manager did not quiesce"
 
 
 def provision_one(env, pool=None, **pod_kw):

@@ -1,0 +1,414 @@
+"""Fault-tolerant runtime under seeded chaos.
+
+Three layers of evidence that the system degrades instead of dying
+(Candea & Fox crash-only software; Basiri et al. chaos engineering):
+
+- the solver circuit breaker's open/half-open/close transitions, unit and
+  integrated (a crashing device path trips to the host oracle with
+  fallback_reason="circuit_open" and recovers via a cooldown probe);
+- observability of best-effort surfaces (events_dropped_total) and of the
+  dead-letter set (/debug/deadletter);
+- the seeded soak: the full operator loop (provision -> disrupt ->
+  terminate) under ~5% injected store+cloudprovider faults for thousands
+  of fake-clock seconds converges, loses no work item, and quarantines
+  exactly the deliberately-poisoned object.
+
+Everything is deterministic: fixed seeds, FakeClock, no sleeps, single
+thread — chaos as a reproducible experiment, not flakiness.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.objects import Node, ObjectMeta, Pod
+from karpenter_tpu.api.storage import StorageClass
+from karpenter_tpu.cloudprovider.chaos import ChaosCloudProvider
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import Controller, Manager
+from karpenter_tpu.kube.chaos import ChaosStore
+from karpenter_tpu.metrics.registry import (EVENTS_DROPPED, RECONCILE_ERRORS,
+                                            RECONCILE_QUARANTINED,
+                                            SOLVER_CIRCUIT_STATE)
+from karpenter_tpu.provisioning.tensor_scheduler import (SOLVER_CIRCUIT,
+                                                         SolverCircuitBreaker,
+                                                         TensorScheduler)
+from karpenter_tpu.utils.chaos import (FaultInjector, InjectedFault,
+                                       InjectedTerminalFault, chaos_pause)
+from karpenter_tpu.utils.clock import FakeClock
+
+from expectations import Env
+from factories import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture(autouse=True)
+def clean_breaker():
+    """The module-level breaker is process-global state; tests here trip
+    breakers on purpose, so reset around each."""
+    SOLVER_CIRCUIT.reset()
+    yield
+    SOLVER_CIRCUIT.reset()
+
+
+class TestCircuitBreakerUnit:
+    def test_open_half_open_close_transitions(self):
+        t = [0.0]
+        b = SolverCircuitBreaker(threshold=3, cooldown=30.0,
+                                 now=lambda: t[0], publish=True)
+        assert b.state == b.CLOSED and b.allow()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == b.CLOSED and b.allow()  # under threshold
+        b.record_failure()
+        assert b.state == b.OPEN
+        assert SOLVER_CIRCUIT_STATE.value() == 1
+        assert not b.allow()                       # cooling down
+        t[0] = 29.9
+        assert not b.allow()
+        t[0] = 30.0
+        assert b.allow()                           # half-open probe
+        assert b.state == b.HALF_OPEN
+        assert SOLVER_CIRCUIT_STATE.value() == 2
+        b.record_failure()                         # probe fails: re-open
+        assert b.state == b.OPEN and not b.allow()
+        t[0] = 60.0
+        assert b.allow() and b.state == b.HALF_OPEN
+        b.record_success()                         # probe succeeds: close
+        assert b.state == b.CLOSED and b.allow()
+        assert SOLVER_CIRCUIT_STATE.value() == 0
+
+    def test_success_resets_consecutive_count(self):
+        b = SolverCircuitBreaker(threshold=3, now=lambda: 0.0)
+        for _ in range(5):
+            b.record_failure()
+            b.record_success()
+        assert b.state == b.CLOSED  # never 3 CONSECUTIVE failures
+
+    def test_ad_hoc_breaker_does_not_stomp_the_gauge(self):
+        """Exactly one breaker (SOLVER_CIRCUIT, publish=True) owns the
+        solver_circuit_state series; a bench/test breaker tripping must
+        not overwrite the production export."""
+        before = SOLVER_CIRCUIT_STATE.value()
+        b = SolverCircuitBreaker(threshold=1, now=lambda: 0.0)
+        b.record_failure()
+        assert b.state == b.OPEN
+        assert SOLVER_CIRCUIT_STATE.value() == before
+
+
+class TestCircuitBreakerIntegration:
+    """The breaker wired into the solve path: a crashing device path trips
+    to the host oracle; the cooldown probe restores tensor service."""
+
+    def _scheduler(self, breaker, crash=False):
+        ts = TensorScheduler([make_nodepool()], {"default": _kwok_catalog()},
+                             circuit=breaker)
+        if crash:
+            def boom(problem):
+                raise RuntimeError("device wedged")
+            ts.precompute = boom
+        return ts
+
+    def test_trips_to_host_oracle_and_recovers(self):
+        t = [0.0]
+        breaker = SolverCircuitBreaker(threshold=3, cooldown=60.0,
+                                       now=lambda: t[0])
+        pods = make_pods(6, cpu="500m")
+        # individual crashes: host fallback with the crash reason
+        for i in range(3):
+            ts = self._scheduler(breaker, crash=True)
+            r = ts.solve(pods)
+            assert not r.pod_errors          # the oracle still served
+            assert "tensor solve failed" in ts.fallback_reason
+        assert breaker.state == breaker.OPEN
+        # open: straight to the oracle, the device path is NOT attempted
+        ts = self._scheduler(breaker, crash=True)
+        ts.precompute = None  # would TypeError if touched
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "circuit_open"
+        assert not r.pod_errors
+        # cooldown elapses: half-open probe crashes -> re-open
+        t[0] = 60.0
+        ts = self._scheduler(breaker, crash=True)
+        ts.solve(pods)
+        assert "tensor solve failed" in ts.fallback_reason
+        assert breaker.state == breaker.OPEN
+        # next cooldown: healthy probe closes the breaker for good
+        t[0] = 120.0
+        ts = self._scheduler(breaker)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == ""
+        assert breaker.state == breaker.CLOSED
+        assert not r.pod_errors
+
+    def test_force_tensor_bypasses_gate_and_propagates(self):
+        """force_tensor (bench/conformance) must see the real crash, not a
+        silent fallback."""
+        t = [0.0]
+        breaker = SolverCircuitBreaker(threshold=1, cooldown=60.0,
+                                       now=lambda: t[0])
+        ts = self._scheduler(breaker, crash=True)
+        ts.force_tensor = True
+        with pytest.raises(RuntimeError, match="device wedged"):
+            ts.solve(make_pods(2, cpu="250m"))
+        assert breaker.state == breaker.OPEN  # still counted
+
+
+def _kwok_catalog():
+    from karpenter_tpu.cloudprovider import kwok
+    return kwok.construct_instance_types()[:24]
+
+
+class TestEventsDropped:
+    def test_sink_error_is_counted(self):
+        from karpenter_tpu.events.catalog import nodepool_blocked
+        from karpenter_tpu.events.recorder import Recorder
+        clock = FakeClock()
+        dropped0 = EVENTS_DROPPED.value({"reason": "sink_error"})
+
+        def bad_sink(ev):
+            raise OSError("apiserver gone")
+
+        rec = Recorder(clock, sink=bad_sink)
+        rec.publish(nodepool_blocked("np-1"))
+        assert rec.events, "event must still be recorded locally"
+        assert EVENTS_DROPPED.value(
+            {"reason": "sink_error"}) == dropped0 + 1
+
+    def test_async_sink_delivery_error_is_counted(self):
+        from karpenter_tpu.events.catalog import nodepool_blocked
+        from karpenter_tpu.events.recorder import AsyncSink, Recorder
+        clock = FakeClock()
+        dropped0 = EVENTS_DROPPED.value({"reason": "deliver_error"})
+
+        def bad_deliver(ev):
+            raise OSError("connection reset")
+
+        sink = AsyncSink(bad_deliver)
+        try:
+            rec = Recorder(clock, sink=sink)
+            rec.publish(nodepool_blocked("np-2"))
+            sink.flush()
+            assert EVENTS_DROPPED.value(
+                {"reason": "deliver_error"}) == dropped0 + 1
+        finally:
+            sink.close()
+
+
+class TestFakeProviderChaos:
+    def test_seeded_transient_faults_fire(self):
+        inj = FaultInjector(seed=3, rate=1.0, reconcile_only=False)
+        fake = FakeCloudProvider()
+        fake.chaos = inj
+        nc = NodeClaim(metadata=ObjectMeta(name="nc-1"))
+        with pytest.raises(InjectedFault):
+            fake.create(nc)
+        with pytest.raises(InjectedFault):
+            fake.get("fake://nope")
+        with pytest.raises(InjectedFault):
+            fake.get_instance_types(make_nodepool())
+        with pytest.raises(InjectedFault):
+            fake.delete(nc)
+        assert inj.fired() == 4
+        assert set(inj.counts) == {"fake.create", "fake.get",
+                                   "fake.get_instance_types", "fake.delete"}
+        # faults fire BEFORE the call is recorded: the request never
+        # reached the provider
+        assert fake.create_calls == [] and fake.delete_calls == []
+
+    def test_terminal_faults_are_terminal_errors(self):
+        from karpenter_tpu.controllers.manager import TerminalError
+        inj = FaultInjector(seed=3, rate=1.0, terminal_rate=1.0,
+                            reconcile_only=False)
+        fake = FakeCloudProvider()
+        fake.chaos = inj
+        with pytest.raises(TerminalError):
+            fake.create(NodeClaim(metadata=ObjectMeta(name="nc-t")))
+
+    def test_reconcile_only_gating(self):
+        from karpenter_tpu.utils.injection import with_controller
+        inj = FaultInjector(seed=1, rate=1.0)  # reconcile_only default
+        fake = FakeCloudProvider()
+        fake.chaos = inj
+        fake.get_instance_types(make_nodepool())  # setup path: unperturbed
+        assert inj.fired() == 0
+        with with_controller("provisioner"):
+            with pytest.raises(InjectedFault):
+                fake.get_instance_types(make_nodepool())
+        assert inj.fired() == 1
+
+
+class TestDeadletterEndpoint:
+    def test_debug_deadletter_serves_quarantine(self):
+        from urllib.request import urlopen
+
+        from karpenter_tpu.kube.store import Store
+        from karpenter_tpu.operator.server import ServingGroup
+        clock = FakeClock()
+        store = Store(clock)
+        mgr = Manager(store, clock, max_retries=2)
+
+        class Crash(Controller):
+            name = "crash"
+            kinds = (StorageClass,)
+
+            def reconcile(self, obj):
+                raise RuntimeError("hopeless")
+
+        mgr.register(Crash())
+        store.create(StorageClass(metadata=ObjectMeta(name="bad-sc")))
+        mgr.run_until_quiet()
+        for _ in range(6):
+            clock.step(301.0)
+            mgr.advance(0)
+        assert mgr.deadletter
+        grp = ServingGroup(0, 0, manager=mgr).start()
+        try:
+            body = urlopen(
+                f"http://127.0.0.1:{grp.metrics_port}/debug/deadletter",
+                timeout=5).read().decode()
+        finally:
+            grp.stop()
+        assert body.startswith("quarantined 1")
+        assert "crash StorageClass/default/bad-sc" in body
+        assert "RuntimeError: hopeless" in body
+
+
+class PoisonController(Controller):
+    """Deliberately unreconcilable: always raises for its object — the
+    item the soak asserts is the ONLY dead-letter occupant."""
+
+    name = "chaos.poison"
+    kinds = (StorageClass,)
+
+    def reconcile(self, obj):
+        raise RuntimeError("poison pill: unreconcilable by construction")
+
+
+@pytest.mark.chaos
+class TestChaosSoak:
+    """The headline experiment: the full operator loop under ~5% injected
+    store + cloudprovider faults for thousands of fake-clock seconds."""
+
+    SEED = 0xC0FFEE
+    RATE = 0.05
+
+    def _chaos_env(self):
+        inj = FaultInjector(seed=self.SEED, rate=self.RATE)
+        clock = FakeClock()
+        store = ChaosStore(clock, injector=inj)
+        env = Env(
+            clock=clock, store=store,
+            provider=lambda s: ChaosCloudProvider(
+                KwokCloudProvider(store=s), inj))
+        # full loop: the disruption engine + orchestration queue run under
+        # manager crash isolation like the operator wires them, plus the
+        # poison controller whose quarantine the experiment asserts
+        env.mgr.register(env.queue, env.disruption, PoisonController())
+        return env, inj
+
+    def _churn_round(self, env, rounds=6, step=7.0):
+        """One chaos window: drive the loop across batch windows and
+        backoff delays WITHOUT asserting quiescence (mid-storm the only
+        invariant is 'still running')."""
+        for _ in range(rounds):
+            env.mgr.run_until_quiet()
+            env.clock.step(step)
+
+    def _flush(self, env, max_rounds=60):
+        """Faults off: advance past every backoff/requeue delay until every
+        failure is resolved. Conservation check: no item may remain queued,
+        in failure backoff, or singleton-gated — every transient failure
+        retried to success (or quarantined, dropping its backoff state).
+        Periodic requeue timers (consolidation rechecks) are steady-state,
+        not residual work, so they're exempt."""
+        for _ in range(max_rounds):
+            assert env.mgr.run_until_quiet(), "livelock after faults off"
+            if not env.mgr._queue and not env.mgr.backoff._failures \
+                    and not env.mgr._singleton_next:
+                return
+            env.clock.step(301.0)
+        raise AssertionError(
+            f"work never drained: queue={len(env.mgr._queue)} "
+            f"backoff={dict(env.mgr.backoff._failures)} "
+            f"singletons={dict(env.mgr._singleton_next)}")
+
+    def test_soak_converges_with_zero_lost_items(self):
+        env, inj = self._chaos_env()
+        errs0 = sum(RECONCILE_ERRORS._values.values())
+        env.store.create(make_nodepool(name="default"))
+        # the poison pill rides along from the start
+        env.store.create(StorageClass(metadata=ObjectMeta(name="poison")))
+
+        # phase 1: provision a workload under faults
+        for p in make_pods(12, cpu="500m", memory="256Mi"):
+            env.store.create(p)
+        self._churn_round(env, rounds=24)
+
+        # phase 2: scale down (consolidation fodder) and keep churning
+        pods = env.store.list(Pod)
+        for p in pods[:5]:
+            with chaos_pause(inj):
+                env.store.delete(p)
+        self._churn_round(env, rounds=24)
+
+        # phase 3: scale back up + delete a node out from under its pods
+        for p in make_pods(8, cpu="250m", memory="128Mi",
+                           labels={"app": "wave2"}):
+            env.store.create(p)
+        self._churn_round(env, rounds=12)
+        nodes = [n for n in env.store.list(Node)
+                 if n.metadata.deletion_timestamp is None]
+        if nodes:
+            with chaos_pause(inj):
+                env.store.delete(nodes[0])
+        self._churn_round(env, rounds=36, step=11.0)
+
+        # the experiment only means something if faults actually fired
+        assert inj.fired() > 30, inj.counts
+        assert sum(RECONCILE_ERRORS._values.values()) > errs0
+
+        # convergence: faults off, flush every retry, then assert
+        inj.enabled = False
+        self._flush(env)
+
+        live_nodes = {n.name for n in env.store.list(Node)
+                      if n.metadata.deletion_timestamp is None}
+        for p in env.store.list(Pod):
+            assert p.spec.node_name in live_nodes, \
+                f"pod {p.name} lost (bound to {p.spec.node_name!r})"
+        claims = env.store.list(NodeClaim)
+        assert all(c.launched() and c.registered() and c.initialized()
+                   for c in claims if c.metadata.deletion_timestamp is None)
+        assert env.cluster.synced()
+
+        # quarantine contains EXACTLY the poison pill
+        assert list(env.mgr.deadletter) == [
+            ("chaos.poison", "StorageClass", "default", "poison")]
+        assert RECONCILE_QUARANTINED.value(
+            {"controller": "chaos.poison"}) == 1
+        assert env.recorder.reasons_for("poison") == ["ReconcileQuarantined"]
+        # nothing else ever gave up: every non-poison failure retried to
+        # success (no residual backoff state)
+        assert all(k[0] == "chaos.poison"
+                   for k in env.mgr.backoff._failures), \
+            env.mgr.backoff._failures
+
+    def test_soak_is_deterministic(self):
+        """Same seed -> byte-identical fault schedule and end state."""
+        def run():
+            env, inj = self._chaos_env()
+            env.store.create(make_nodepool(name="default"))
+            for p in make_pods(10, cpu="500m"):
+                env.store.create(p)
+            self._churn_round(env, rounds=20)
+            inj.enabled = False
+            self._flush(env)
+            # name-independent shape: pod names come from a process-global
+            # factory counter, so compare the fault schedule and the
+            # placement structure, not identifiers
+            return (dict(inj.counts),
+                    sorted(n.name for n in env.store.list(Node)),
+                    sorted(p.spec.node_name for p in env.store.list(Pod)))
+
+        assert run() == run()

@@ -49,7 +49,7 @@ def settle(env, rounds=6):
     for _ in range(rounds):
         env.mgr.run_until_quiet()
         env.clock.step(1.1)
-    env.mgr.run_until_quiet()
+    assert env.mgr.run_until_quiet(), "manager did not quiesce"
 
 
 def manual_claim(env, startup_taints=()):

@@ -26,7 +26,7 @@ def settle(op, rounds=6):
     for _ in range(rounds):
         op.step()
         op.clock.step(1.1)
-    op.step()
+    assert op.step(), "operator did not quiesce"
 
 
 class TestOperator:

@@ -47,7 +47,7 @@ def settle(env, rounds=6):
     for _ in range(rounds):
         env.mgr.run_until_quiet()
         env.clock.step(1.1)
-    env.mgr.run_until_quiet()
+    assert env.mgr.run_until_quiet(), "manager did not quiesce"
 
 
 def make_volume_pod(claim, cpu="500m", **kw):
