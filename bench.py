@@ -414,6 +414,142 @@ def bench_consolidation():
     }))
 
 
+def bench_single_consolidation():
+    """ISSUE 3 acceptance line (BENCH_MODE=single): ONE single-node
+    consolidation decision over N_NODES candidates x the kwok 144-type
+    catalog, in the reference's worst-case shape — every candidate but the
+    LAST in the fair order is provably unconsolidatable (its pod fits on no
+    other node and no strictly-cheaper replacement type exists), so the
+    reference's serial shape (singlenodeconsolidation.go:44-101) pays one
+    full scheduling simulation per candidate racing the 3-minute timeout.
+    The batched leave-one-out engine classifies every candidate from one
+    shared DisruptionSnapshot encode and runs exactly ONE materialization
+    probe (the winner). Asserts tensor-path residency: zero per-candidate
+    fallback sims."""
+    from karpenter_tpu.api import labels as api_labels
+    from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE, COND_INITIALIZED,
+                                             COND_LAUNCHED, COND_REGISTERED,
+                                             NodeClaim, NodeClaimSpec)
+    from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                           ObjectMeta, PodSpec)
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.cloudprovider.types import Offerings
+    from karpenter_tpu.disruption.helpers import get_candidates
+    from karpenter_tpu.disruption.methods import SingleNodeConsolidation
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.provisioning.provisioner import Provisioner
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    # on-demand-only catalog: spot pricing would hand every stuck candidate
+    # a cheaper replacement and short-circuit the scan at candidate #1
+    catalog = _catalog()
+    for it in catalog:
+        it.offerings = Offerings(
+            [o for o in it.offerings
+             if o.capacity_type == api_labels.CAPACITY_TYPE_ON_DEMAND])
+
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(instance_types=catalog, store=store)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    store.create(NodePool(metadata=ObjectMeta(name="default"),
+                          spec=NodePoolSpec(template=NodeClaimTemplate(
+                              spec=NodeClaimTemplateSpec()))))
+
+    def od_price(it):
+        offs = [o.price for o in it.offerings if o.available]
+        return min(offs) if offs else float("inf")
+
+    ref = next(it for it in catalog
+               if it.capacity.get("cpu") == 4000 and "amd64-linux" in it.name)
+    stuck_req = ref.allocatable()["cpu"] - 300  # 300m headroom per node
+    fits = [it for it in catalog if it.allocatable().get("cpu", 0) >= stuck_req]
+    big = min(fits, key=od_price)  # the candidate type IS the cheapest fit
+    free = big.allocatable()["cpu"] - stuck_req
+    assert free < stuck_req, "stuck pods must not fit each other's headroom"
+    small = min((it for it in catalog if it.capacity.get("cpu") == 1000),
+                key=od_price)
+
+    def fab_node(i, it, cpu_milli_pods):
+        name = f"single-node-{i:05d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: it.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a",
+            api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"single-nc-{i:05d}",
+                                           namespace="", labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"single://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED,
+                     COND_CONSOLIDATABLE):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"single://{i}"),
+            status=NodeStatus(capacity=dict(it.capacity),
+                              allocatable=it.allocatable())))
+        for j, cpu in enumerate(cpu_milli_pods):
+            store.create(Pod(
+                metadata=ObjectMeta(name=f"single-pod-{i}-{j}",
+                                    namespace="default"),
+                spec=PodSpec(node_name=name),
+                container_requests=[{"cpu": cpu, "memory": 128 * 1000}]))
+
+    # N-1 stuck candidates (one immovable, irreplaceable pod each) ...
+    for i in range(N_NODES - 1):
+        fab_node(i, big, [stuck_req])
+    # ... and ONE winner whose two small pods fit the stuck nodes' headroom.
+    # Two pods = rescheduling cost 2 > 1, so the fair order visits it LAST:
+    # the scan must reject all N-1 stuck candidates to find it.
+    fab_node(N_NODES - 1, small, [200, 200])
+
+    method = SingleNodeConsolidation(cluster, provisioner)
+
+    def one_pass():
+        method._last_state = None  # fresh decision per repeat
+        candidates = get_candidates(cluster, provisioner, method.should_disrupt)
+        cmd, _ = method.compute_command({"default": N_NODES}, candidates)
+        return candidates, cmd
+
+    candidates, cmd = one_pass()  # warmup: populate the compile cache
+    assert len(candidates) == N_NODES, len(candidates)
+    assert cmd.decision == "delete", cmd.decision
+    assert [c.name for c in cmd.candidates] == [f"single-node-{N_NODES-1:05d}"]
+    stats = method.last_engine_stats
+    assert stats is not None, "batched engine did not engage"
+    assert stats["needs_sim"] == 0, stats   # tensor-path residency
+    assert stats["probes"] == 1, stats      # only the winner materializes
+    best = float("inf")
+    for _ in range(max(1, REPEATS - 1)):
+        t0 = time.perf_counter()
+        _, cmd2 = one_pass()
+        best = min(best, time.perf_counter() - t0)
+        # decision determinism across passes
+        assert [c.name for c in cmd2.candidates] == \
+            [c.name for c in cmd.candidates]
+    print(json.dumps({
+        "metric": (f"single-node consolidation decision, {N_NODES} "
+                   f"candidates x {len(catalog)} instance types (batched "
+                   "leave-one-out, worst case: one win at the end of the "
+                   "fair order)"),
+        "value": round(best, 3),
+        "unit": "seconds",
+        # reference bound: the 180 s single-node consolidation timeout
+        "vs_baseline": round(180.0 / best, 2),
+    }), flush=True)
+
+
 def bench_spot_repack():
     """BASELINE config #5: spot repack — catalog x 6 zones with a shifted
     price vector; the consolidation search must find the cost-optimal
@@ -836,6 +972,9 @@ def main():
     if MODE == "consolidation":
         bench_consolidation()
         return
+    if MODE == "single":
+        bench_single_consolidation()
+        return
     if MODE == "spot":
         bench_spot_repack()
         return
@@ -860,7 +999,7 @@ def main():
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
-            "all|provisioning|consolidation|spot|mesh|mesh-local|"
+            "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
             "mesh-headroom|sidecar|minvalues|faults")
     pods = _pods()
     if N_ITS:
@@ -896,7 +1035,8 @@ def main():
         # mesh first: the multichip-at-scale line is the one the budget
         # gate must never sacrifice; the opt-in minValues line
         # (BENCH_MINVALUES=1) slots in AFTER it and rides the same guard
-        aux_benches = (bench_mesh, bench_consolidation, bench_spot_repack,
+        aux_benches = (bench_mesh, bench_consolidation,
+                       bench_single_consolidation, bench_spot_repack,
                        bench_mesh_headroom, bench_sidecar)
         if MINVALUES:
             aux_benches = (bench_mesh, bench_minvalues) + aux_benches[1:]

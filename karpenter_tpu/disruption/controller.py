@@ -170,6 +170,8 @@ class DisruptionController(SingletonController):
         # command awaiting the consolidation-TTL re-validation
         # (validation.go:83-215); (command, computed_at)
         self.pending: Optional[tuple] = None
+        # the per-pass shared DisruptionSnapshot (reconcile scope only)
+        self._snapshot = None
 
     def reconcile(self) -> Optional[Result]:
         if not self.cluster.synced():
@@ -177,15 +179,36 @@ class DisruptionController(SingletonController):
         self._cleanup_stale_taints()
         if self.pending is not None:
             return self._reconcile_pending()
-        for method in self.methods:
-            if getattr(method, "is_consolidated", None) and method.is_consolidated():
-                continue
-            # consolidation methods self-memoize inside compute_command
-            # (skipped when budget-constrained — consolidation.go:89-96)
-            executed = self._disrupt(method)
-            if executed:
-                return Result(requeue_after=POLL_INTERVAL_SECONDS)
-        return Result(requeue_after=POLL_INTERVAL_SECONDS)
+        # ONE DisruptionSnapshot per pass: every method's candidate
+        # collection and simulation shares the same encode. Built on the
+        # first _disrupt call — even an idle pass pays its store scans,
+        # but that replaces the per-METHOD context rebuild (4x nodepool +
+        # catalog + PDB + pod listings) the old get_candidates cost; the
+        # expensive tensor encode itself stays lazy inside the snapshot.
+        self._snapshot = None
+        try:
+            for method in self.methods:
+                if getattr(method, "is_consolidated", None) and \
+                        method.is_consolidated():
+                    continue
+                # consolidation methods self-memoize inside compute_command
+                # (skipped when budget-constrained — consolidation.go:89-96)
+                executed = self._disrupt(method)
+                if executed:
+                    return Result(requeue_after=POLL_INTERVAL_SECONDS)
+            return Result(requeue_after=POLL_INTERVAL_SECONDS)
+        finally:
+            self._snapshot = None
+            for method in self.methods:
+                if hasattr(method, "attach_snapshot"):
+                    method.attach_snapshot(None)
+
+    def _pass_snapshot(self):
+        if self._snapshot is None:
+            from .prefix import DisruptionSnapshot
+            self._snapshot = DisruptionSnapshot(self.cluster,
+                                                self.provisioner)
+        return self._snapshot
 
     def _cleanup_stale_taints(self) -> None:
         """controller.go:124-135: a crash mid-disruption can leave nodes
@@ -216,6 +239,8 @@ class DisruptionController(SingletonController):
                 requeue_after=CONSOLIDATION_TTL_SECONDS - elapsed)
         self.pending = None
         disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
+        # the validation pass gets its OWN snapshot: the cluster had a TTL's
+        # worth of time to move since the compute pass encoded it
         if validate_command(self.cluster, self.provisioner, cmd, cmd.reason,
                             disrupting_provider_ids=disrupting):
             self._execute(cmd)
@@ -225,11 +250,14 @@ class DisruptionController(SingletonController):
         """controller.go:155-190."""
         from ..metrics import registry as metrics
         disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
+        snapshot = self._pass_snapshot()
+        if hasattr(method, "attach_snapshot"):
+            method.attach_snapshot(snapshot)
         candidates = get_candidates(
             self.cluster, self.provisioner, method.should_disrupt,
             disrupting_provider_ids=disrupting,
             disruption_class=method.disruption_class,
-            recorder=self.recorder)
+            recorder=self.recorder, context=snapshot)
         metrics.DISRUPTION_ELIGIBLE_NODES.set(
             len(candidates), {"reason": method.reason})
         if not candidates:

@@ -50,19 +50,29 @@ def build_pdb_limits(cluster: Cluster) -> Limits:
 def get_candidates(cluster: Cluster, provisioner: Provisioner,
                    should_disrupt, disrupting_provider_ids=(),
                    disruption_class: str = "graceful",
-                   recorder=None) -> List[Candidate]:
+                   recorder=None, context=None) -> List[Candidate]:
     """helpers.go:144-161: candidates from disruptable cluster nodes that the
     method's ShouldDisrupt predicate accepts. Blocked candidates publish
     DisruptionBlocked for managed nodes (types.go:74-101: events only when
-    NodeClaim != nil, so unmanaged nodes stay silent)."""
+    NodeClaim != nil, so unmanaged nodes stay silent).
+
+    `context` (a disruption.prefix.DisruptionSnapshot) supplies the
+    pass-shared nodepool/instance-type/PDB/pod indexes so the four methods
+    of one pass don't each re-list the store and re-fetch the catalog."""
     now = cluster.clock.now()
-    nodepools = {np.name: np for np in cluster.store.list(NodePool)}
-    instance_types = {
-        name: {it.name: it
-               for it in provisioner.cloud_provider.get_instance_types(np)}
-        for name, np in nodepools.items()}
-    pdb_limits = build_pdb_limits(cluster)
-    by_node = pods_by_node(cluster)
+    if context is not None:
+        nodepools = context.all_nodepools
+        instance_types = context.it_maps
+        pdb_limits = context.pdb_limits
+        by_node = context.pods_by_node_map
+    else:
+        nodepools = {np.name: np for np in cluster.store.list(NodePool)}
+        instance_types = {
+            name: {it.name: it
+                   for it in provisioner.cloud_provider.get_instance_types(np)}
+            for name, np in nodepools.items()}
+        pdb_limits = build_pdb_limits(cluster)
+        by_node = pods_by_node(cluster)
     out: List[Candidate] = []
     # no deep copy here: new_candidate deep-copies the accepted nodes
     for sn in cluster.state_nodes(deep_copy=False):
@@ -120,11 +130,34 @@ def build_disruption_budget_mapping(cluster: Cluster, reason: str,
     return allowed
 
 
+def stamp_uninitialized_errors(results, exempt_uids) -> None:
+    """helpers.go:93-111: a scheduling decision must not rest on managed
+    nodes still mid-initialization — pods placed there become errors so the
+    command is rejected, EXCEPT exempt pods (from deleting nodes, whose
+    replacement node is assumed to come up). The ONE implementation of this
+    rule: both the host-path simulate_scheduling and the snapshot replay
+    (disruption/prefix.py) apply it, so they can never diverge."""
+    for en in results.existing_nodes:
+        sn = en.state_node if hasattr(en, "state_node") else None
+        if sn is None or not sn.managed() or sn.initialized():
+            continue
+        for p in en.pods:
+            if p.uid not in exempt_uids:
+                results.pod_errors[p.uid] = (
+                    f"would schedule against uninitialized node "
+                    f"{sn.name()}")
+
+
 def simulate_scheduling(cluster: Cluster, provisioner: Provisioner,
-                        candidates: List[Candidate]):
+                        candidates: List[Candidate],
+                        ride_along: Optional[List[Pod]] = None):
     """helpers.go:49-113: the bridge into the provisioning solver. Removes the
     candidates from the packable node set, marks their reschedulable pods
-    pending, and solves. deleted-candidate races surface as CandidateError."""
+    pending, and solves. deleted-candidate races surface as CandidateError.
+
+    `ride_along` is the deleting-node reschedulable-pod list when the caller
+    already scanned it (the shared DisruptionSnapshot computes it once per
+    disruption pass); None re-scans here for standalone callers."""
     candidate_ids = {c.provider_id for c in candidates}
     for c in candidates:
         sn = cluster.nodes.get(c.provider_id)
@@ -138,27 +171,17 @@ def simulate_scheduling(cluster: Cluster, provisioner: Provisioner,
                    if not sn.deleting() and sn.provider_id not in candidate_ids]
     pods = provisioner.get_pending_pods()
     # pods already being rescheduled from deleting nodes ride along
+    if ride_along is None:
+        ride_along = [p for sn in cluster.deleting_nodes()
+                      for p in pods_on_node(cluster, sn)
+                      if pod_utils.is_reschedulable(p)]
     deleting_pod_uids = set()
-    for sn in cluster.deleting_nodes():
-        for p in pods_on_node(cluster, sn):
-            if pod_utils.is_reschedulable(p):
-                pods.append(p)
-                deleting_pod_uids.add(p.uid)
+    for p in ride_along:
+        pods.append(p)
+        deleting_pod_uids.add(p.uid)
     reschedulable = [p for c in candidates for p in c.reschedulable_pods]
     results = provisioner.schedule_with(pods + reschedulable, state_nodes)
-    # a scheduling decision must not rest on managed nodes still mid-
-    # initialization: pods placed there become errors so the command is
-    # rejected — EXCEPT pods from deleting nodes, whose replacement node is
-    # assumed to come up (helpers.go:93-111)
-    for en in results.existing_nodes:
-        sn = en.state_node if hasattr(en, "state_node") else None
-        if sn is None or not sn.managed() or sn.initialized():
-            continue
-        for p in en.pods:
-            if p.uid not in deleting_pod_uids:
-                results.pod_errors[p.uid] = (
-                    f"would schedule against uninitialized node "
-                    f"{sn.name()}")
+    stamp_uninitialized_errors(results, deleting_pod_uids)
     # pods that only became pending for the simulation must all land
     # (AllNonPendingPodsScheduled)
     sim_uids = {p.uid for p in reschedulable}

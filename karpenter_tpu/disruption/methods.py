@@ -43,6 +43,9 @@ MULTI_NODE_CONSOLIDATION_CANDIDATES = 100   # multinodeconsolidation.go:35
 MIN_SPOT_TO_SPOT_INSTANCE_TYPES = 15        # consolidation.go:47
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0     # multinodeconsolidation.go:35
 SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0   # singlenodeconsolidation.go:30
+# below this many eligible candidates the batched leave-one-out engine's
+# device encode costs more than the handful of serial probes it replaces
+SINGLE_NODE_BATCH_MIN_CANDIDATES = 16
 
 
 class Method:
@@ -205,6 +208,13 @@ class consolidation(Method):
         # method tracks the last cluster state IT found nothing in, so one
         # method marking consolidated never suppresses the others
         self._last_state: Optional[float] = None
+        # the pass-shared DisruptionSnapshot, attached by the controller so
+        # all methods of one pass share a single encode; None for standalone
+        # callers (tests, direct use) — sims then build their own state
+        self._pass_snapshot = None
+
+    def attach_snapshot(self, snapshot) -> None:
+        self._pass_snapshot = snapshot
 
     def should_disrupt(self, c: Candidate) -> bool:
         """consolidation.go:85-117: the price-comparison prerequisites and
@@ -277,8 +287,13 @@ class consolidation(Method):
     def compute_consolidation(self, candidates: List[Candidate]
                               ) -> Tuple[Command, object]:
         try:
-            results, sim_errors = simulate_scheduling(
-                self.cluster, self.provisioner, candidates)
+            if self._pass_snapshot is not None:
+                # pass-shared encode (falls back to the host solver inside
+                # when the batch isn't expressible)
+                results, sim_errors = self._pass_snapshot.simulate(candidates)
+            else:
+                results, sim_errors = simulate_scheduling(
+                    self.cluster, self.provisioner, candidates)
         except CandidateError:
             return Command(reason=self.reason), None
         return self.decide(candidates, results, sim_errors)
@@ -437,7 +452,8 @@ class MultiNodeConsolidation(consolidation):
             return Command(reason=self.reason), None
         sim = None
         try:
-            sim = PrefixSimulator(self.cluster, self.provisioner, candidates)
+            sim = PrefixSimulator(self.cluster, self.provisioner, candidates,
+                                  snapshot=self._pass_snapshot)
         except PrefixFallback:
             pass
         except CandidateError:
@@ -501,23 +517,81 @@ class SingleNodeConsolidation(consolidation):
 
     def compute_command(self, budgets, candidates):
         from ..metrics import registry as metrics
-        remaining = dict(budgets)
         deadline = self.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        # budget gate UP FRONT over the full fair order: the `constrained`
+        # signal must cover pools the deadline would otherwise hide, so a
+        # timed-out pass can never read as an exhaustive "nothing to do".
+        # NOT _filter_disruptable: a single-node command disrupts exactly
+        # one node, so the reference only skips zero-budget pools and never
+        # decrements (singlenodeconsolidation.go:55-68) — decrementing
+        # would cap the scan at B candidates per pool and starve wins
+        # sitting past the cap
+        eligible: List[Candidate] = []
         constrained = False
         for c in self._fair_order(candidates):
-            if remaining.get(c.nodepool_name, 0) <= 0:
+            if budgets.get(c.nodepool_name, 0) <= 0:
                 constrained = True
                 continue
             if not c.reschedulable_pods:
                 # empty nodes are Emptiness' (budget-gated) job
                 continue
+            eligible.append(c)
+        engine = None
+        engine_tried = False
+        self.last_engine_stats = None
+        timed_out = False
+        for idx, c in enumerate(eligible):
             if self.clock.now() > deadline:
                 metrics.CONSOLIDATION_TIMEOUTS.inc(
                     {"consolidation_type": self.consolidation_type})
-                return Command(reason=self.reason), None
+                timed_out = True
+                break
+            if not engine_tried:
+                engine_tried = True
+                engine = self._build_engine(eligible)
+            if engine is not None:
+                verdict = engine.verdict(idx)
+                if verdict.kind == "reject":
+                    # provably unconsolidatable without a simulation; the
+                    # reason mirrors what decide() would have published
+                    if verdict.reason:
+                        self.recorder.publish(*events_catalog.unconsolidatable(
+                            c.name, _nodeclaim_name(c), verdict.reason))
+                    continue
+                try:
+                    results, sim_errors = engine.probe(idx)
+                except CandidateError:
+                    continue
+                cmd, results = self.decide([c], results, sim_errors)
+                self.last_engine_stats = dict(engine.stats)
+                if not cmd.is_empty():
+                    return cmd, results
+                continue
             cmd, results = self.compute_consolidation([c])
             if not cmd.is_empty():
                 return cmd, results
-        if not constrained:
-            self.mark_consolidated()
+        if engine is not None:
+            self.last_engine_stats = dict(engine.stats)
+        if timed_out or constrained:
+            # a timed-out or budget-constrained pass proved nothing about
+            # the unseen candidates: memoizing would suppress a later pass
+            # that could succeed against unchanged cluster state
+            return Command(reason=self.reason), None
+        self.mark_consolidated()
         return Command(reason=self.reason), None
+
+    def _build_engine(self, eligible: List[Candidate]):
+        """The batched leave-one-out classifier over the pass snapshot, or
+        None when the candidate set is too small to amortize the encode or
+        the batch isn't expressible (per-candidate sims take over)."""
+        if len(eligible) < SINGLE_NODE_BATCH_MIN_CANDIDATES:
+            return None
+        from .batch import LeaveOneOutEngine
+        from .prefix import DisruptionSnapshot, SnapshotFallback
+        try:
+            snapshot = self._pass_snapshot or DisruptionSnapshot(
+                self.cluster, self.provisioner)
+            return LeaveOneOutEngine(snapshot, eligible,
+                                     self.spot_to_spot_enabled)
+        except (SnapshotFallback, CandidateError):
+            return None

@@ -12,11 +12,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..api.nodepool import NodePool
 from ..provisioning.provisioner import Provisioner
 from ..state.cluster import Cluster
-from .helpers import (build_disruption_budget_mapping, build_pdb_limits,
-                      get_candidates, pods_on_node, simulate_scheduling)
+from .helpers import build_disruption_budget_mapping
 from .types import Candidate, CandidateError, Command, new_candidate
 
 CONSOLIDATION_TTL_SECONDS = 15.0  # consolidation.go:44
@@ -24,15 +22,18 @@ CONSOLIDATION_TTL_SECONDS = 15.0  # consolidation.go:44
 
 def validate_command(cluster: Cluster, provisioner: Provisioner,
                      command: Command, reason: str,
-                     disrupting_provider_ids=()) -> bool:
-    """validation.go ValidateCandidates + ValidateCommand."""
+                     disrupting_provider_ids=(), snapshot=None) -> bool:
+    """validation.go ValidateCandidates + ValidateCommand.
+
+    `snapshot` (disruption.prefix.DisruptionSnapshot) shares the validation
+    pass's encode: the fresh-candidate context comes from one store pass
+    and the re-check simulation replays over the shared tensors instead of
+    rebuilding the solver; None builds one here."""
+    from .prefix import DisruptionSnapshot
+
     now = cluster.clock.now()
-    nodepools = {np.name: np for np in cluster.store.list(NodePool)}
-    instance_types = {
-        name: {it.name: it
-               for it in provisioner.cloud_provider.get_instance_types(np)}
-        for name, np in nodepools.items()}
-    pdb_limits = build_pdb_limits(cluster)
+    if snapshot is None:
+        snapshot = DisruptionSnapshot(cluster, provisioner)
 
     fresh: List[Candidate] = []
     for c in command.candidates:
@@ -41,8 +42,9 @@ def validate_command(cluster: Cluster, provisioner: Provisioner,
             return False
         try:
             fresh.append(new_candidate(
-                now, sn, pods_on_node(cluster, sn), pdb_limits, nodepools,
-                instance_types, disrupting_provider_ids))
+                now, sn, snapshot.pods_by_node_map.get(sn.name(), []),
+                snapshot.pdb_limits, snapshot.all_nodepools,
+                snapshot.it_maps, disrupting_provider_ids))
         except CandidateError:
             return False
 
@@ -60,8 +62,7 @@ def validate_command(cluster: Cluster, provisioner: Provisioner,
         if all(not c.reschedulable_pods for c in fresh):
             return True
         try:
-            results, sim_errors = simulate_scheduling(cluster, provisioner,
-                                                      fresh)
+            results, sim_errors = snapshot.simulate(fresh)
         except CandidateError:
             return False
         return not sim_errors and not results.new_nodeclaims
@@ -71,7 +72,7 @@ def validate_command(cluster: Cluster, provisioner: Provisioner,
     # (unfiltered) options — otherwise the cluster moved and the launch could
     # be as or more expensive (validation.go:155-215)
     try:
-        results, sim_errors = simulate_scheduling(cluster, provisioner, fresh)
+        results, sim_errors = snapshot.simulate(fresh)
     except CandidateError:
         return False
     if sim_errors:

@@ -242,3 +242,11 @@ EVENTS_DROPPED = REGISTRY.counter(
 SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
     "karpenter_solver_circuit_state",
     "Tensor-solver circuit breaker state (0=closed, 1=open, 2=half-open)")
+SOLVER_COMPILE_CACHE_HITS = REGISTRY.counter(
+    "karpenter_solver_compile_cache_hits_total",
+    "Feasibility-precompute solves served by an already-compiled "
+    "executable for their padded shape bucket")
+SOLVER_COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "karpenter_solver_compile_cache_misses_total",
+    "Feasibility-precompute solves that had to compile a fresh executable "
+    "for a new padded shape bucket")

@@ -300,6 +300,13 @@ class TensorScheduler:
         self.fallback_reason: str = ""
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
+        # per-instance state-node encoding memo keyed by vocab identity:
+        # the disruption snapshot builds several problems against the SAME
+        # frozen node set + catalog vocab per pass, and re-encoding 5k node
+        # label sets per build was the dominant host cost (group-side work
+        # is tiny). Provisioning constructs a scheduler per solve, so the
+        # memo is exactly one-pass-scoped there too.
+        self._exist_memo: dict = {}
 
     # -- public -------------------------------------------------------------
 
@@ -584,26 +591,43 @@ class TensorScheduler:
 
         exist_enc = exist_avail = exist_zone = tol_exist = None
         if self.state_nodes:
-            encs, avails, zones = [], [], []
-            tol_exist = np.zeros((G, len(self.state_nodes)), dtype=bool)
-            for i, sn in enumerate(self.state_nodes):
-                reqs = label_requirements(sn.labels())
-                known = Requirements(
-                    r for r in reqs.values()
-                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
-                    in vocab.key_idx)
-                encs.append(enc.encode_requirements(vocab, known))
-                node_daemons = _node_remaining_daemons(sn, templates, self.daemonset_pods)
-                avail = res.subtract(sn.available(), node_daemons)
-                avails.append(enc.encode_resource_vector(vocab, avail, capacity=True))
-                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
-                zones.append(vocab.value_idx[zone_key].get(z, -1))
-                nt = sn.taints()
+            memo = self._exist_memo.get(id(vocab))
+            if memo is None:
+                encs, avails, zones, taint_lists = [], [], [], []
+                for sn in self.state_nodes:
+                    reqs = label_requirements(sn.labels())
+                    known = Requirements(
+                        r for r in reqs.values()
+                        if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
+                        in vocab.key_idx)
+                    encs.append(enc.encode_requirements(vocab, known))
+                    node_daemons = _node_remaining_daemons(
+                        sn, templates, self.daemonset_pods)
+                    avail = res.subtract(sn.available(), node_daemons)
+                    avails.append(enc.encode_resource_vector(vocab, avail,
+                                                             capacity=True))
+                    z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
+                    zones.append(vocab.value_idx[zone_key].get(z, -1))
+                    taint_lists.append(sn.taints())
+                # the memo holds the vocab itself so its id() can never be
+                # recycled by a new object while the entry is alive
+                memo = (vocab, encs, np.stack(avails),
+                        np.array(zones, dtype=np.int32), taint_lists)
+                self._exist_memo[id(vocab)] = memo
+            _, encs, avail_rows, zone_rows, taint_lists = memo
+            # group-side pieces are per-build: tol_exist pairs groups with
+            # the memoized node taints. True = tolerated (tolerates()
+            # returns the error list), so untainted nodes default True.
+            tol_exist = np.ones((G, len(self.state_nodes)), dtype=bool)
+            for i, nt in enumerate(taint_lists):
+                if not nt:
+                    continue
                 for gi, g in enumerate(groups):
-                    tol_exist[gi, i] = not scheduling_taints.tolerates(nt, g.pods[0])
+                    tol_exist[gi, i] = not scheduling_taints.tolerates(
+                        nt, g.pods[0])
             exist_enc = enc.stack_encoded(encs)
-            exist_avail = np.stack(avails)
-            exist_zone = np.array(zones, dtype=np.int32)
+            exist_avail = avail_rows.copy()
+            exist_zone = zone_rows.copy()
             # bucket the node-batch axis: padded rows have undefined masks and
             # zero capacity, so they are never packable (exist_cap < 1)
             N = len(self.state_nodes)

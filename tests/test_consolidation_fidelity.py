@@ -256,6 +256,57 @@ class TestFloorsAndTimeouts:
         assert after == before + 1
 
 
+class TestSingleNodeTimeoutPreservesConstrained:
+    """ISSUE 3 satellite regression: a timed-out (or budget-constrained)
+    single-node pass proved nothing about its unevaluated candidates, so it
+    must never mark_consolidated() — else a later pass against unchanged
+    cluster state is silently skipped (is_consolidated() short-circuits in
+    the controller) and the pools it never looked at stay unconsolidated
+    forever. Only a COMPLETED, unconstrained, decision-free scan memoizes."""
+
+    def _cands(self, n=4, pods=("p",)):
+        it = make_it("a", 0.1)
+        return [FakeCandidate(it, cost=float(i), pods=pods) for i in range(n)]
+
+    def test_timed_out_pass_never_memoizes(self):
+        cluster = _FakeCluster()
+        single = SingleNodeConsolidation(cluster, provisioner=None,
+                                         clock=_JumpClock(200.0))
+        cmd, _ = single.compute_command({"default": 10}, self._cands())
+        assert cmd.is_empty()
+        assert not single.is_consolidated()
+
+    def test_timed_out_pass_still_reports_budget_constraint(self):
+        # budgets admit ONE candidate; the deadline fires before evaluating
+        # it — the constrained signal computed up front must survive the
+        # early return (no memoization either way)
+        cluster = _FakeCluster()
+        single = SingleNodeConsolidation(cluster, provisioner=None,
+                                         clock=_JumpClock(200.0))
+        cmd, _ = single.compute_command({"default": 1}, self._cands())
+        assert cmd.is_empty()
+        assert not single.is_consolidated()
+
+    def test_budget_constrained_pass_never_memoizes(self):
+        cluster = _FakeCluster()
+        single = SingleNodeConsolidation(cluster, provisioner=None,
+                                         clock=_JumpClock(0.0))
+        cmd, _ = single.compute_command({"default": 0}, self._cands())
+        assert cmd.is_empty()
+        assert not single.is_consolidated()
+
+    def test_completed_unconstrained_empty_pass_memoizes(self):
+        # all candidates empty (Emptiness' job): the scan completes with
+        # nothing to do and no constraint — the one legal memoization
+        cluster = _FakeCluster()
+        single = SingleNodeConsolidation(cluster, provisioner=None,
+                                         clock=_JumpClock(0.0))
+        cmd, _ = single.compute_command({"default": 10},
+                                        self._cands(pods=()))
+        assert cmd.is_empty()
+        assert single.is_consolidated()
+
+
 class TestEmptyProbeGroup:
     def test_cluster_zone_counts_skips_empty_groups(self):
         """Prefix probes empty a group when all its pods belong to

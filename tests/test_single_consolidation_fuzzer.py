@@ -1,0 +1,293 @@
+"""Seeded parity fuzzer for the batched leave-one-out single-node engine.
+
+ISSUE 3's contract: `SingleNodeConsolidation.compute_command` through the
+batched `LeaveOneOutEngine` (shared DisruptionSnapshot encode + closed-form
+per-candidate classification) must return the SAME decision — same
+candidate, same replacement instance-type options, same pod errors — as the
+reference's serial shape (one full `simulate_scheduling` per candidate, the
+per-candidate host oracle). Every case is seed-pinned: a divergence
+reproduces by running its seed.
+
+The generator deliberately covers the cases the classifier special-cases:
+spot candidates under the spot-to-spot gate and its >= 15-cheaper-types cap
+(both enabled and disabled), minValues pools (which push the whole batch
+onto the needs_sim fallback rows), uninitialized managed nodes (whose
+placements must reject a candidate), multi-pod and multi-group candidates,
+and nodes too full to absorb anything.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.disruption import methods as methods_mod
+from karpenter_tpu.disruption.helpers import (build_disruption_budget_mapping,
+                                              get_candidates)
+from karpenter_tpu.disruption.methods import SingleNodeConsolidation
+
+from expectations import (OD, SPOT, MinValuesReq, bind_pod, catalog,
+                          consolidation_nodepool, make_env,
+                          make_nodeclaim_and_node)
+
+CPUS = ("100m", "250m", "500m", "1", "2")
+
+
+def build_cluster(seed: int):
+    rng = random.Random(seed)
+    spot_to_spot = rng.random() < 0.5
+    pool = consolidation_nodepool()
+    if rng.random() < 0.2:
+        # minValues gates the whole batch onto the needs_sim fallback rows:
+        # decisions must still match the oracle exactly
+        pool.spec.template.spec.requirements = [MinValuesReq(
+            api_labels.LABEL_INSTANCE_TYPE, "Exists", (),
+            rng.choice((5, 20)))]
+    env = make_env(pool, spot_to_spot=spot_to_spot)
+    its = sorted(catalog(), key=lambda it: it.name)
+    n_nodes = rng.randint(18, 26)  # above the engine's 16-candidate floor
+    for i in range(n_nodes):
+        ct = SPOT if rng.random() < 0.4 else OD
+        it = rng.choice(its)
+        # a slice of nodes stays uninitialized AND unconsolidatable: they
+        # are packing targets whose placements must reject a candidate
+        initialized = rng.random() > 0.15
+        cores = max(1, it.capacity.get("cpu", 4000) // 1000)
+        alloc = {"cpu": str(cores), "memory": "16Gi", "pods": "110"}
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=ct, instance_type=it, allocatable=alloc,
+            initialized=initialized, consolidatable=initialized)
+        shape = rng.random()
+        if shape < 0.45:
+            # mostly-full node: one pod at ~80% of allocatable — delete is
+            # infeasible unless a larger node has matching headroom, so the
+            # replace/price classification actually decides these rows
+            bind_pod(env, node, cpu=f"{cores * 800}m", memory="128Mi")
+        elif shape < 0.6 and cores >= 2:
+            # two same-shape pods (one group, k=2)
+            for _ in range(2):
+                bind_pod(env, node, cpu=f"{cores * 250}m", memory="128Mi")
+        else:
+            # lightly loaded: delete-shaped rows (multi-group when 2 pods)
+            for _ in range(rng.randint(0, 2)):
+                bind_pod(env, node, cpu=rng.choice(CPUS), memory="128Mi")
+    env.clock.step(600)
+    env.settle(rounds=1)
+    return env, spot_to_spot
+
+
+def run_single_node(env, spot_to_spot: bool, batched: bool):
+    """One compute_command pass; batched=False forces the reference's
+    serial shape (per-candidate simulate_scheduling, the parity oracle)."""
+    saved = methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES
+    methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = 1 if batched else 10**9
+    try:
+        m = SingleNodeConsolidation(env.cluster, env.provisioner,
+                                    spot_to_spot_enabled=spot_to_spot,
+                                    clock=env.clock)
+        cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt)
+        budgets = build_disruption_budget_mapping(env.cluster, m.reason)
+        cmd, results = m.compute_command(budgets, cands)
+        stats = m.last_engine_stats
+    finally:
+        methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = saved
+    return cands, cmd, results, stats
+
+
+def summarize(cmd, results):
+    return {
+        "decision": cmd.decision,
+        "candidates": [c.name for c in cmd.candidates],
+        "replacements": [[it.name for it in r.instance_type_options]
+                         for r in cmd.replacements],
+        "pod_errors": (sorted(results.pod_errors)
+                       if results is not None
+                       and getattr(results, "pod_errors", None) else []),
+    }
+
+
+# seed-pinned corpus: any failure names its seed for replay
+@pytest.mark.parametrize("seed", list(range(7000, 7024)))
+def test_leave_one_out_matches_per_candidate_oracle(seed):
+    env, spot_to_spot = build_cluster(seed)
+    cands_b, cmd_b, res_b, stats = run_single_node(env, spot_to_spot, True)
+    cands_o, cmd_o, res_o, _ = run_single_node(env, spot_to_spot, False)
+    assert [c.name for c in cands_b] == [c.name for c in cands_o]
+    got, want = summarize(cmd_b, res_b), summarize(cmd_o, res_o)
+    assert got == want, (seed, stats, got, want)
+    if cands_b:
+        assert stats is not None, (seed, "engine never engaged")
+
+
+def test_replace_win_classified_without_extra_probes():
+    """Directed scenario killing price-path misclassification: 17 identical
+    stuck nodes (most expensive type, immovable pod) where a cheaper
+    replacement exists. The engine must classify every row (no fallback
+    sims), probe ONLY the winner, and agree with the oracle's replace."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(OD)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=OD, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")  # > 400m headroom
+    env.clock.step(600)
+    env.settle(rounds=1)
+    cands, cmd, res, stats = run_single_node(env, False, True)
+    assert len(cands) == 17
+    assert cmd.decision == "replace", summarize(cmd, res)
+    assert stats["needs_sim"] == 0 and stats["probes"] == 1, stats
+    assert stats["classified"] == 17, stats
+    _, cmd_o, res_o, _ = run_single_node(env, False, False)
+    assert summarize(cmd, res) == summarize(cmd_o, res_o)
+
+
+def test_all_stuck_spot_rejects_without_any_probe():
+    """Directed scenario killing reject-path laxity: 17 stuck SPOT nodes
+    with spot-to-spot disabled must classify to rejection with ZERO probes
+    (an always-probe regression shows up as probes > 0), and the pass must
+    memoize (nothing to do, no budget constraint)."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(SPOT)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")
+    env.clock.step(600)
+    env.settle(rounds=1)
+    saved = methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES
+    methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = 1
+    try:
+        m = SingleNodeConsolidation(env.cluster, env.provisioner,
+                                    spot_to_spot_enabled=False,
+                                    clock=env.clock, recorder=env.recorder)
+        cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt)
+        budgets = build_disruption_budget_mapping(env.cluster, m.reason)
+        cmd, _ = m.compute_command(budgets, cands)
+    finally:
+        methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = saved
+    assert len(cands) == 17
+    assert cmd.is_empty()
+    assert m.last_engine_stats["probes"] == 0, m.last_engine_stats
+    assert m.last_engine_stats["classified"] == 17
+    assert m.is_consolidated()
+    msgs = [e.message for e in env.events("Unconsolidatable")]
+    assert any("SpotToSpotConsolidation is disabled" in msg for msg in msgs)
+
+
+def test_uninitialized_target_rejects_without_any_probe():
+    """Directed scenario for the uninitialized-node rejection
+    (helpers.go:93-111): every candidate's pod fits ONLY onto a managed
+    uninitialized node, which poisons the simulated placement — the
+    classifier must reject all rows with ZERO probes (a dropped rejection
+    self-heals through wasted probes, which this pins), and the oracle
+    agrees the pass is a no-op."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(OD)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=OD, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")
+    # the only node with headroom is managed but NOT initialized
+    make_nodeclaim_and_node(
+        env, capacity_type=OD, instance_type=it,
+        allocatable={"cpu": "32", "memory": "64Gi", "pods": "110"},
+        initialized=False, consolidatable=False)
+    env.clock.step(600)
+    env.settle(rounds=1)
+    saved = methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES
+    methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = 1
+    try:
+        m = SingleNodeConsolidation(env.cluster, env.provisioner,
+                                    clock=env.clock, recorder=env.recorder)
+        cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt)
+        budgets = build_disruption_budget_mapping(env.cluster, m.reason)
+        cmd, _ = m.compute_command(budgets, cands)
+        stats = m.last_engine_stats
+    finally:
+        methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = saved
+    assert len(cands) == 17
+    assert cmd.is_empty()
+    assert stats["needs_sim"] == 0 and stats["probes"] == 0, stats
+    # the rejection must be FOR the uninitialized placement, not an
+    # accidental arithmetic dead end
+    msgs = [e.message for e in env.events("Unconsolidatable")]
+    assert any("uninitialized" in msg for msg in msgs), msgs[:3]
+    _, cmd_o, res_o, _ = run_single_node(env, False, False)
+    assert cmd_o.is_empty()
+
+
+def test_budget_gates_pools_but_never_decrements():
+    """singlenodeconsolidation.go:55-68 regression pin: a single-node
+    command disrupts exactly ONE node, so the budget check only skips
+    zero-budget pools — it must NOT decrement per scanned candidate, or a
+    budget of 1 caps the scan at the single cheapest candidate and a win
+    sitting past the cap is starved forever. 17 stuck spot nodes (every
+    one rejected) followed by the one consolidatable node, all in one pool
+    with budget 1: the decision must still be found."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(SPOT)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")
+    # two pods => rescheduling cost 2 => LAST in the fair order; each fits
+    # the stuck nodes' 400m headroom, so deletion wins — while the winner's
+    # own 400m headroom stays too small to absorb any 600m stuck pod
+    _, winner = make_nodeclaim_and_node(
+        env, capacity_type=OD, instance_type=most_expensive_instance(OD),
+        allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+    for _ in range(2):
+        bind_pod(env, winner, cpu="300m", memory="128Mi")
+    env.clock.step(600)
+    env.settle(rounds=1)
+    saved = methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES
+    methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = 1
+    try:
+        m = SingleNodeConsolidation(env.cluster, env.provisioner,
+                                    clock=env.clock)
+        cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt)
+        assert len(cands) == 18
+        cmd, _ = m.compute_command({"default": 1}, cands)
+    finally:
+        methods_mod.SINGLE_NODE_BATCH_MIN_CANDIDATES = saved
+    assert cmd.decision == "delete", cmd.decision
+    assert [c.name for c in cmd.candidates] == [winner.name]
+
+
+def test_fuzz_covers_the_feature_space():
+    """Meta-check: across the pinned seeds the generator exercised spot
+    candidates, both spot-to-spot settings, minValues pools, uninitialized
+    nodes, and multi-pod nodes — and at least a few non-trivial decisions
+    and a few classified (non-fallback) batches actually happened."""
+    saw = {"spot": False, "spot_to_spot_on": False, "spot_to_spot_off": False,
+           "min_values": False, "uninitialized": False, "multi_pod": False,
+           "decision": False, "classified_rows": False}
+    for seed in range(7000, 7024):
+        rng = random.Random(seed)
+        saw["spot_to_spot_on"] |= rng.random() < 0.5
+        env, spot_to_spot = build_cluster(seed)
+        saw["spot_to_spot_off"] |= not spot_to_spot
+        pool = env.store.list(type(consolidation_nodepool()))[0]
+        saw["min_values"] |= bool(pool.spec.template.spec.requirements)
+        by_ct = [sn.labels().get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+                 for sn in env.cluster.state_nodes(deep_copy=False)]
+        saw["spot"] |= SPOT in by_ct
+        saw["uninitialized"] |= any(
+            sn.managed() and not sn.initialized()
+            for sn in env.cluster.state_nodes(deep_copy=False))
+        from karpenter_tpu.disruption.helpers import pods_by_node
+        counts = [len(v) for v in pods_by_node(env.cluster).values()]
+        saw["multi_pod"] |= any(c > 1 for c in counts)
+        _, cmd, _, stats = run_single_node(env, spot_to_spot, True)
+        saw["decision"] |= not cmd.is_empty()
+        saw["classified_rows"] |= bool(stats and stats["classified"] > 0)
+    missing = [k for k, v in saw.items() if not v]
+    assert not missing, f"fuzzer never generated: {missing}"
