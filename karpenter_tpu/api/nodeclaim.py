@@ -8,12 +8,28 @@ Consolidatable/Drifted.
 
 from __future__ import annotations
 
-import time as _time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.clock import Clock
 from .nodepool import NodeClassRef
 from .objects import ObjectMeta
+
+# condition transition times stamped WITHOUT an explicit `now` read this
+# process-wide clock — injectable (FakeClock) so replays and fake-clock
+# tests never leak wall time into transition timestamps. Controllers pass
+# now=clock.now() explicitly; this default covers factories and ad-hoc
+# setters.
+_condition_clock: Clock = Clock()
+
+
+def set_condition_clock(clock: Clock) -> Clock:
+    """Swap the default condition-timestamp clock; returns the previous one
+    so tests can restore it."""
+    global _condition_clock
+    prev = _condition_clock
+    _condition_clock = clock
+    return prev
 
 # Condition types (nodeclaim_status.go)
 COND_LAUNCHED = "Launched"
@@ -64,9 +80,11 @@ class ConditionSet:
     def _set(self, cond_type: str, status: str, reason: str, message: str, now):
         prev = self._conds.get(cond_type)
         changed = prev is None or prev.status != status
+        if now is None:
+            now = _condition_clock.now()
         self._conds[cond_type] = Condition(
             type=cond_type, status=status, reason=reason, message=message,
-            last_transition_time=(now if now is not None else _time.time()) if changed
+            last_transition_time=now if changed
             else prev.last_transition_time)
 
     def types(self):

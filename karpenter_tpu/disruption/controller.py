@@ -150,9 +150,13 @@ class DisruptionController(SingletonController):
 
     def __init__(self, store: Store, cluster: Cluster, provisioner: Provisioner,
                  queue: OrchestrationQueue, clock: Optional[Clock] = None,
-                 spot_to_spot_enabled: bool = False, recorder=None):
+                 spot_to_spot_enabled: bool = False, recorder=None,
+                 flight_recorder=None):
         from ..events.recorder import Recorder
         self.store = store
+        # optional flightrec.FlightRecorder: every non-empty disruption
+        # command is captured with its winner-simulation inputs for replay
+        self.flight_recorder = flight_recorder
         self.cluster = cluster
         self.provisioner = provisioner
         self.queue = queue
@@ -272,6 +276,12 @@ class DisruptionController(SingletonController):
              method.reason})
         if cmd.is_empty():
             return False
+        if self.flight_recorder is not None:
+            # capture at decision time (before the TTL validation pass): the
+            # record must hold the inputs the decision was COMPUTED from
+            self.flight_recorder.capture_disruption(
+                snapshot, method, budgets, candidates, cmd, results,
+                self.clock.now() - started)
         # graceful methods revalidate after the consolidation TTL; eventual
         # (drift) executes immediately (drift.go has no validation pass)
         if method.disruption_class == "graceful":

@@ -31,6 +31,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.clock import Clock
 from . import k8s_codec
 from .admission import validate as admission_validate
 
@@ -38,7 +39,11 @@ _CRD_PATH = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
 
 
 class _State:
-    def __init__(self):
+    def __init__(self, clock: Optional[Clock] = None):
+        # creation/deletion timestamps come from the injected clock, never
+        # time.time() directly: a FakeClock-driven suite (or a flight-record
+        # replay) must see deterministic object metadata
+        self.clock = clock or Clock()
         self.lock = threading.Condition()
         self.rv = 0
         # (prefix, plural) -> {(ns, name): k8s dict}
@@ -242,7 +247,7 @@ class _Handler(BaseHTTPRequestHandler):
             meta = body.setdefault("metadata", {})
             meta.setdefault("uid", str(uuid.uuid4()))
             meta.setdefault("creationTimestamp",
-                            k8s_codec.ts_to_k8s(time.time()))
+                            k8s_codec.ts_to_k8s(st.clock.now()))
             meta["resourceVersion"] = str(st.bump())
             if ns:
                 meta.setdefault("namespace", ns)
@@ -314,7 +319,7 @@ class _Handler(BaseHTTPRequestHandler):
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
                     meta["deletionTimestamp"] = k8s_codec.ts_to_k8s(
-                        time.time())
+                        st.clock.now())
                     meta["resourceVersion"] = str(st.bump())
                     st.emit(route, "MODIFIED", cur)
                 return self._send(200, cur)
@@ -327,8 +332,8 @@ class _Handler(BaseHTTPRequestHandler):
 class EnvtestServer:
     """Lifecycle wrapper: `with EnvtestServer() as srv: ... srv.url ...`."""
 
-    def __init__(self):
-        self.state = _State()
+    def __init__(self, clock: Optional[Clock] = None):
+        self.state = _State(clock)
         handler = type("Handler", (_Handler,), {"state": self.state})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self._httpd.daemon_threads = True

@@ -250,3 +250,10 @@ SOLVER_COMPILE_CACHE_MISSES = REGISTRY.counter(
     "karpenter_solver_compile_cache_misses_total",
     "Feasibility-precompute solves that had to compile a fresh executable "
     "for a new padded shape bucket")
+FLIGHTREC_RECORDS = REGISTRY.counter(
+    "karpenter_flightrecorder_records_total",
+    "Decision records captured by the flight recorder", ("kind",))
+FLIGHTREC_DROPPED = REGISTRY.counter(
+    "karpenter_flightrecorder_dropped_total",
+    "Decision records dropped (ring eviction or capture failure)",
+    ("reason",))

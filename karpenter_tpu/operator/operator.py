@@ -65,6 +65,14 @@ class Operator:
             self.recorder.sink = AsyncSink(self.store.post_event)
         self.manager = Manager(self.store, self.clock,
                                recorder=self.recorder)
+        # decision flight recorder: provisioning solves + disruption
+        # decisions land in one bounded ring, served at
+        # /debug/flightrecorder and replayable offline (flightrec/)
+        self.flightrec = None
+        if self.options.flightrec_ring > 0:
+            from ..flightrec import FlightRecorder
+            self.flightrec = FlightRecorder(
+                capacity=self.options.flightrec_ring, clock=self.clock)
         self.serving: Optional[ServingGroup] = None
 
         gates = self.options.gates
@@ -86,7 +94,8 @@ class Operator:
         self.provisioner = Provisioner(self.store, self.cluster,
                                        self.cloud_provider, self.clock,
                                        scheduler_factory=scheduler_factory,
-                                       recorder=self.recorder)
+                                       recorder=self.recorder,
+                                       flight_recorder=self.flightrec)
         self.provisioner.batcher.idle = self.options.batch_idle_duration
         self.provisioner.batcher.max_duration = self.options.batch_max_duration
         self.queue = OrchestrationQueue(self.store, self.cluster, self.clock,
@@ -94,7 +103,7 @@ class Operator:
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.queue, self.clock,
             spot_to_spot_enabled=gates.spot_to_spot_consolidation,
-            recorder=self.recorder)
+            recorder=self.recorder, flight_recorder=self.flightrec)
 
         controllers = [
             self.provisioner,
@@ -177,7 +186,7 @@ class Operator:
                 healthy=lambda: True,
                 ready=lambda: self.cluster.synced(),
                 profiling=self.options.enable_profiling,
-                manager=self.manager).start()
+                manager=self.manager, flightrec=self.flightrec).start()
             self.log.info("serving metrics and health probes",
                           metrics_port=self.serving.metrics_port,
                           health_port=self.serving.health_port)

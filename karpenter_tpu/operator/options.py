@@ -52,6 +52,11 @@ class Options:
     # durable state is the apiserver; standalone, the store checkpoints here
     # and restores on boot (restart = resync, state/cluster.go:96-150)
     state_file: str = ""
+    # decision flight recorder ring size (records kept in memory for
+    # /debug/flightrecorder and offline replay); 0 disables recording.
+    # Each record pins its full solver inputs until dumped — size for
+    # incident context, not history.
+    flightrec_ring: int = 32
     # TPU solver knobs (new surface: no reference analog)
     solver_backend: str = "tensor"   # tensor | sidecar
     solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint

@@ -147,6 +147,43 @@ def _debug_deadletter_factory(manager):
     return fn
 
 
+def _debug_flightrec_factory(flightrec):
+    """The decision flight recorder's operator surface: GET serves the
+    last-N record summaries (?n=, default 50; ?format=jsonl streams the
+    full records), and ?dump=1 materializes the ring to a JSONL trace file
+    for `python -m karpenter_tpu.flightrec replay`. Dumps land inside ONE
+    operator-configured directory ($KARPENTER_FLIGHTREC_DIR or the system
+    tempdir) with an optional ?name= basename — a debug port must not be a
+    write-anywhere primitive."""
+    def fn(query: dict):
+        import os
+        import tempfile
+        if flightrec is None:
+            return 404, "text/plain", "no flight recorder attached"
+        try:
+            n = max(1, int(query.get("n", ["50"])[0]))
+        except (TypeError, ValueError):
+            return 400, "text/plain", "n must be an integer"
+        if query.get("dump", [""])[0] in ("1", "true"):
+            base = os.path.basename(
+                query.get("name", ["flightrec.jsonl"])[0]) or \
+                "flightrec.jsonl"
+            out_dir = os.environ.get("KARPENTER_FLIGHTREC_DIR",
+                                     tempfile.gettempdir())
+            path = os.path.join(out_dir, base)
+            count = flightrec.dump(path)
+            return 200, "text/plain", f"dumped {count} records to {path}\n"
+        if query.get("format", [""])[0] == "jsonl":
+            return (200, "application/jsonl",
+                    "\n".join(flightrec.lines(n)) + "\n")
+        records = flightrec.records(n)
+        lines = [f"records {len(flightrec)} (showing {len(records)}, "
+                 f"capacity {flightrec.capacity})"]
+        lines += [r.summary() for r in records]
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
 def _debug_timers_factory(manager):
     def fn():
         if manager is None:
@@ -174,7 +211,8 @@ class ServingGroup:
     def __init__(self, metrics_port: int, health_probe_port: int,
                  healthy: Callable[[], bool] = lambda: True,
                  ready: Callable[[], bool] = lambda: True,
-                 registry=REGISTRY, profiling: bool = False, manager=None):
+                 registry=REGISTRY, profiling: bool = False, manager=None,
+                 flightrec=None):
         def probe(check: Callable[[], bool]):
             def fn():
                 if check():
@@ -189,6 +227,11 @@ class ServingGroup:
         if manager is not None:
             metrics_routes["/debug/deadletter"] = \
                 _debug_deadletter_factory(manager)
+        if flightrec is not None:
+            # operational surface like /debug/deadletter: served whenever a
+            # recorder exists, not gated behind profiling
+            metrics_routes["/debug/flightrecorder"] = \
+                _debug_flightrec_factory(flightrec)
         if profiling:
             metrics_routes["/debug/stacks"] = _debug_stacks
             metrics_routes["/debug/timers"] = _debug_timers_factory(manager)

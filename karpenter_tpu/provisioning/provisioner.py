@@ -146,9 +146,13 @@ class Provisioner(SingletonController):
 
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None, batcher: Optional[Batcher] = None,
-                 scheduler_factory=None, recorder=None):
+                 scheduler_factory=None, recorder=None, flight_recorder=None):
         from ..events.recorder import Recorder
         self.store = store
+        # optional flightrec.FlightRecorder: live provisioning solves (NOT
+        # disruption simulation probes — those would flood the ring) are
+        # captured as replayable DecisionRecords
+        self.flight_recorder = flight_recorder
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or store.clock
@@ -255,9 +259,9 @@ class Provisioner(SingletonController):
         # exclude deleting nodes from pack targets (NewScheduler filters them)
         state_nodes = [sn for sn in self.cluster.state_nodes()
                        if not sn.deleting()]
-        return self.schedule_with(pods, state_nodes)
+        return self.schedule_with(pods, state_nodes, record=True)
 
-    def schedule_with(self, pods: List[Pod], state_nodes):
+    def schedule_with(self, pods: List[Pod], state_nodes, record: bool = False):
         """Solve against an explicit packable-node set; the disruption
         solver's SimulateScheduling entry point (helpers.go:49-113)."""
         from .volumetopology import inject_volume_topology_requirements
@@ -275,6 +279,12 @@ class Provisioner(SingletonController):
             nodepools, instance_types, state_nodes,
             self.cluster.daemonset_pod_list(),
             StateClusterView(self.store, self.cluster))
+        if record and self.flight_recorder is not None \
+                and hasattr(ts, "flight_recorder"):
+            # the in-process TensorScheduler captures inside solve(); the
+            # gRPC RemoteScheduler has no recorder hook — its solves record
+            # on the sidecar server's side
+            ts.flight_recorder = self.flight_recorder
         self.last_scheduler = ts
         return ts.solve(pods)
 

@@ -297,6 +297,10 @@ class TensorScheduler:
         self.catalog_token = catalog_token
         # shared breaker by default: schedulers are per-solve, trips aren't
         self.circuit = circuit if circuit is not None else SOLVER_CIRCUIT
+        # optional flightrec.FlightRecorder: every solve() is captured as a
+        # replayable DecisionRecord. None (the default) costs one attribute
+        # compare per solve.
+        self.flight_recorder = None
         self.fallback_reason: str = ""
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
@@ -312,8 +316,14 @@ class TensorScheduler:
 
     def solve(self, pods: List[Pod], prebuckets=None) -> Results:
         from ..utils.gcpause import no_gc
+        rec = self.flight_recorder
+        started = time.perf_counter() if rec is not None else 0.0
         with no_gc():
-            return self._solve(pods, prebuckets)
+            results = self._solve(pods, prebuckets)
+        if rec is not None:
+            rec.capture_provisioning(self, pods, results,
+                                     time.perf_counter() - started)
+        return results
 
     def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
         # port eligibility needs existing-node usage: a port occupied on a

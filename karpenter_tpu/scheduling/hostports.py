@@ -79,6 +79,16 @@ class HostPortUsage:
         out._by_port = {k: list(v) for k, v in self._by_port.items()}
         return out
 
+    def entries(self) -> "list[_Entry]":
+        """Every tracked port entry — the serialization surface (sidecar
+        wire codec, flight recorder); keeps _by_port's layout private."""
+        return [e for es in self._by_port.values() for e in es]
+
+    def add_entries(self, entries) -> None:
+        """Rebuild-side twin of entries() for wire decoders."""
+        for e in entries:
+            self._by_port.setdefault((e.port, e.protocol), []).append(e)
+
     def conflicts_triples(self, triples) -> bool:
         """Conflict check for anonymous (ip, port, protocol) triples — the
         tensor packer's existing-node exclusion (no pod identity: a group's

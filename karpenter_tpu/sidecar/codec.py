@@ -666,6 +666,16 @@ def state_node_to_dict(sn, store=None) -> dict:
                                in sn.daemonset_pod_requests.items()},
         "initialized": sn.initialized(),
     }
+    managed = getattr(sn, "managed", None)
+    if managed is not None and not managed():
+        out["managed"] = False
+    # occupied host ports ride along so a remote/replayed solve sees the
+    # same port conflicts the in-process one did (hostportusage.go:34-90);
+    # pod identity is preserved for the oracle's own-port exemption
+    ports = [[e.pod_uid, e.ip, e.port, e.protocol]
+             for e in sn.host_port_usage().entries()]
+    if ports:
+        out["host_ports"] = ports
     # CSI attach-limit facts ride with the node: the server has no store to
     # resolve CSINode limits or current usage (volumeusage.go:187-220)
     vu = getattr(sn, "volume_usage", None)
@@ -687,11 +697,14 @@ class WireStateNode:
     daemonset_requests/hostname/host_port_usage/initialized)."""
 
     def __init__(self, d: dict):
-        from ..scheduling.hostports import HostPortUsage
+        from ..scheduling.hostports import HostPortUsage, _Entry
         from ..utils import resources as res
         self._d = d
         self._taints = [taint_from_dict(t) for t in d["taints"]]
         self._hpu = HostPortUsage()
+        self._hpu.add_entries(
+            _Entry(pod_uid=pod_uid, ip=ip, port=port, protocol=protocol)
+            for pod_uid, ip, port, protocol in d.get("host_ports", ()))
         self.pod_requests = dict(d["pod_requests"])
         self.daemonset_pod_requests = dict(d["daemonset_requests"])
         # attach-limit riders consumed by TensorScheduler._volume_limit_state
@@ -733,6 +746,9 @@ class WireStateNode:
 
     def initialized(self):
         return self._d["initialized"]
+
+    def managed(self):
+        return self._d.get("managed", True)
 
 
 # -- nodeclaims (results) ---------------------------------------------------

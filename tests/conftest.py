@@ -25,3 +25,7 @@ def pytest_configure(config):
         "markers",
         "chaos: seeded fault-injection tests (deterministic: fixed seed, "
         "fake clock, no sleeps — tier-1 eligible by construction)")
+    config.addinivalue_line(
+        "markers",
+        "replay: flight-recorder record/replay tests (deterministic "
+        "offline re-solves of captured traces — tier-1 eligible)")

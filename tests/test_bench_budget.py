@@ -147,6 +147,50 @@ class TestSingleNodeConsolidationBudget:
         assert line["value"] < self.BUDGET_SECONDS
 
 
+class TestFlightRecorderBudget:
+    """ISSUE 4 guard: the BENCH_MODE=replay budget at test scale. The 5%
+    recorder-on bound is asserted at 50k in bench_replay; at 2,000 pods the
+    absolute overhead budget is what a regression would trip — so this
+    pins the capture mechanism directly: the hot-path capture must stay
+    deferred (no payload/digest encode inside the solve) and cost
+    milliseconds, and the deferred materialization must still replay to a
+    byte-identical decision."""
+
+    CAPTURE_BUDGET_SECONDS = 0.020
+
+    def test_capture_is_deferred_and_cheap(self, solved):
+        from karpenter_tpu.flightrec import FlightRecorder
+        pods, ts, results, _ = solved
+        rec = FlightRecorder(capacity=4)
+        t0 = time.perf_counter()
+        rec.capture_provisioning(ts, pods, results, 0.0)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < self.CAPTURE_BUDGET_SECONDS, (
+            f"hot-path capture took {elapsed * 1000:.1f}ms at "
+            f"{len(pods)} pods — the deferred encode likely went eager")
+        r = rec.records()[-1]
+        assert r._refs is not None and r._digest_refs is not None, \
+            "capture materialized inside the solve path"
+        assert r.decision is None
+
+    def test_recorded_solve_replays_byte_identical(self, solved):
+        from karpenter_tpu.flightrec import (FlightRecorder, loads_record,
+                                             replay_record)
+        pods, ts, results, _ = solved
+        rec = FlightRecorder(capacity=4)
+        rec.capture_provisioning(ts, pods, results, 0.0)
+        report = replay_record(loads_record(rec.lines()[-1]))
+        assert report.deterministic is True, report.render()
+
+    def test_bench_mode_replay_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "replay" in m.group(0), \
+            "BENCH_MODE=replay missing from the unknown-mode error list"
+
+
 @pytest.mark.parametrize("kind", [0, 1, 2, 4, 5, 6, 7, 8])
 def test_node_count_parity_vs_host_oracle_per_kind(kind):
     pods = [p for p in _mix()
