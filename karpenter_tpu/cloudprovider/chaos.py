@@ -42,6 +42,25 @@ class ChaosCloudProvider(CloudProvider):
     def _gate(self, method: str, name: str = "") -> None:
         self.injector.maybe_raise(f"cloud.{method}", name)
 
+    def exhaust(self, instance_type: str = "*", zone: str = "*",
+                capacity_type: str = "*", duration=None, clock=None):
+        """Capacity-drought scenario: exhaust matching offerings on the
+        delegate (zone-wide with the defaults) for ``duration`` seconds —
+        the wrapped provider's creates fail with an offering-keyed
+        InsufficientCapacityError until the window lapses, then recover on
+        their own. Installs a CapacityDrought on the delegate if one isn't
+        wired yet; returns it so scenarios can assert on ``hits``."""
+        from ..utils.chaos import CapacityDrought
+        drought = getattr(self._delegate, "drought", None)
+        if drought is None:
+            drought = CapacityDrought(clock=clock)
+            self._delegate.drought = drought
+        if clock is not None and drought.clock is None:
+            drought.clock = clock
+        drought.exhaust(instance_type, zone, capacity_type,
+                        duration=duration)
+        return drought
+
     def create(self, nodeclaim):
         self._gate("create", nodeclaim.name)
         return self._delegate.create(nodeclaim)

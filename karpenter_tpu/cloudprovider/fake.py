@@ -16,7 +16,7 @@ from ..scheduling.requirements import Requirements, node_selector_requirements
 from ..utils import resources as res
 from .types import (CloudProvider, InsufficientCapacityError, InstanceType,
                     InstanceTypeOverhead, NodeClaimNotFoundError, Offering, Offerings,
-                    RepairPolicy, order_by_price)
+                    RepairPolicy, usable_offerings)
 
 FAKE_ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
 
@@ -78,6 +78,14 @@ class FakeCloudProvider(CloudProvider):
         # terminal errors at the injector's rate — the fake's analog of the
         # one-shot next_*_err knobs, but schedule-driven for chaos tests
         self.chaos = None
+        # capacity-drought schedule (utils/chaos.CapacityDrought): a create
+        # whose chosen offering matches a live window raises
+        # InsufficientCapacityError carrying the matched pattern
+        self.drought = None
+        # UnavailableOfferings registry: when wired, create() never targets
+        # an offering the registry has cached as dry (the AWS provider
+        # filters its CreateFleet launch templates the same way)
+        self.unavailable = None
 
     def _chaos(self, method: str, name: str = "") -> None:
         if self.chaos is not None:
@@ -99,14 +107,32 @@ class FakeCloudProvider(CloudProvider):
         if self.allowed_create_calls is not None and len(self.create_calls) > self.allowed_create_calls:
             raise InsufficientCapacityError("exceeded AllowedCreateCalls")
         reqs = node_selector_requirements(nodeclaim.spec.requirements)
-        compatible = [it for it in self.instance_types
-                      if not it.requirements.intersects(reqs)
-                      and res.fits(nodeclaim.spec.resources_requests, it.allocatable())
-                      and it.offerings.available().has_compatible(reqs)]
+        usable: dict = {}
+        compatible = []
+        for it in self.instance_types:
+            if it.requirements.intersects(reqs):
+                continue
+            if not res.fits(nodeclaim.spec.resources_requests, it.allocatable()):
+                continue
+            offs = usable_offerings(it, reqs, self.unavailable)
+            if offs:
+                compatible.append(it)
+                usable[it.name] = offs
         if not compatible:
             raise InsufficientCapacityError(f"no instance type satisfied {nodeclaim.name}")
-        it = order_by_price(compatible, reqs)[0]  # cheapest offering wins
-        offering = it.offerings.available().compatible(reqs).cheapest()
+        # cheapest usable offering wins, name tiebreak (order_by_price over
+        # the registry-filtered offering sets)
+        it = min(compatible,
+                 key=lambda t: (usable[t.name].cheapest().price, t.name))
+        offering = usable[it.name].cheapest()
+        if self.drought is not None:
+            hit = self.drought.match(it.name, offering.zone,
+                                     offering.capacity_type)
+            if hit is not None:
+                raise InsufficientCapacityError(
+                    f"capacity exhausted launching {nodeclaim.name}: "
+                    f"{it.name} in {offering.zone}/{offering.capacity_type}",
+                    offerings=(hit,))
         provider_id = f"fake://instance-{next(self._seq):05d}"
         nodeclaim.status.provider_id = provider_id
         nodeclaim.status.capacity = dict(it.capacity)

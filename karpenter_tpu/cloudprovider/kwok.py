@@ -19,8 +19,9 @@ from ..scheduling.requirement import IN, Requirement
 from ..scheduling.requirements import Requirements, node_selector_requirements
 from ..scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
 from ..utils import resources as res
-from .types import (CloudProvider, InstanceType, InstanceTypeOverhead, NodeClaimNotFoundError,
-                    Offering, Offerings, order_by_price)
+from .types import (CloudProvider, InsufficientCapacityError, InstanceType,
+                    InstanceTypeOverhead, NodeClaimNotFoundError,
+                    Offering, Offerings, usable_offerings)
 
 KWOK_ZONES = ["test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d"]
 KWOK_REGION = "test-region"
@@ -119,6 +120,13 @@ class KwokCloudProvider(CloudProvider):
         self._seq = itertools.count(1)
         self.store = store  # optional in-memory kube store
         self.created: dict = {}  # provider_id -> (NodeClaim, Node)
+        # capacity-drought schedule (utils/chaos.CapacityDrought): a create
+        # whose chosen offering matches a live window raises
+        # InsufficientCapacityError carrying the matched pattern
+        self.drought = None
+        # UnavailableOfferings registry: when wired, create() never targets
+        # an offering the registry has cached as dry
+        self.unavailable = None
 
     @property
     def name(self) -> str:
@@ -132,8 +140,28 @@ class KwokCloudProvider(CloudProvider):
                       and it.offerings.available().has_compatible(reqs)]
         if not compatible:
             raise NodeClaimNotFoundError(f"no instance type satisfied {nodeclaim.name}")
-        it = order_by_price(compatible, reqs)[0]
-        offering = it.offerings.available().compatible(reqs).cheapest()
+        usable = {it.name: usable_offerings(it, reqs, self.unavailable)
+                  for it in compatible}
+        launchable = [it for it in compatible if usable[it.name]]
+        if not launchable:
+            # every compatible offering is cached dry: nothing new to learn,
+            # the registry already covers them all
+            raise InsufficientCapacityError(
+                f"all compatible offerings for {nodeclaim.name} are marked "
+                "unavailable")
+        # cheapest usable offering wins, name tiebreak (order_by_price over
+        # the registry-filtered offering sets)
+        it = min(launchable,
+                 key=lambda t: (usable[t.name].cheapest().price, t.name))
+        offering = usable[it.name].cheapest()
+        if self.drought is not None:
+            hit = self.drought.match(it.name, offering.zone,
+                                     offering.capacity_type)
+            if hit is not None:
+                raise InsufficientCapacityError(
+                    f"capacity exhausted launching {nodeclaim.name}: "
+                    f"{it.name} in {offering.zone}/{offering.capacity_type}",
+                    offerings=(hit,))
         n = next(self._seq)
         provider_id = f"kwok://node-{n:05d}"
         node_name = f"kwok-node-{n:05d}"

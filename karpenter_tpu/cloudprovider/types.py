@@ -53,11 +53,14 @@ class Offerings(list):
     def has_compatible(self, reqs: Requirements) -> bool:
         return any(reqs.is_compatible(o.requirements, ALLOW_UNDEFINED_WELL_KNOWN) for o in self)
 
-    def cheapest(self) -> Offering:
-        return min(self, key=lambda o: o.price)
+    def cheapest(self) -> "Optional[Offering]":
+        """None when empty — reachable once unavailable-offerings masking
+        empties a type's offering list; callers treat it as price inf /
+        unavailable instead of eating a bare ValueError."""
+        return min(self, key=lambda o: o.price, default=None)
 
-    def most_expensive(self) -> Offering:
-        return max(self, key=lambda o: o.price)
+    def most_expensive(self) -> "Optional[Offering]":
+        return max(self, key=lambda o: o.price, default=None)
 
     def worst_launch_price(self, reqs: Requirements) -> float:
         """types.go:292-310 — spot preferred, else on-demand, else +inf."""
@@ -139,6 +142,20 @@ def truncate(its: List[InstanceType], reqs: Requirements, max_items: int):
     return truncated, None
 
 
+def usable_offerings(it: InstanceType, reqs: Requirements,
+                     unavailable=None) -> Offerings:
+    """Available offerings compatible with reqs, minus any covered by a
+    live unavailable-offerings registry entry — the provider-side filter
+    the AWS provider applies before CreateFleet so a launch never targets
+    an offering its own ICE cache already knows is dry."""
+    offs = it.offerings.available().compatible(reqs)
+    if unavailable is not None and len(unavailable):
+        offs = Offerings(o for o in offs
+                         if not unavailable.is_unavailable(
+                             it.name, o.zone, o.capacity_type))
+    return offs
+
+
 # --- typed errors (types.go:313-399) --------------------------------------
 
 
@@ -152,6 +169,18 @@ class NodeClaimNotFoundError(CloudProviderError):
 
 
 class InsufficientCapacityError(CloudProviderError):
+    """``offerings`` carries the exhausted offering keys the provider
+    attributes the failure to: ``(instance_type, zone, capacity_type)``
+    tuples, "*" wildcard per position — a zone-wide drought reports
+    ("*", zone, "*"). The nodeclaim-lifecycle ICE path records them into
+    the UnavailableOfferings registry so the next solver pass routes
+    around them; an empty tuple (legacy/unattributable failures) records
+    nothing."""
+
+    def __init__(self, *args, offerings: "tuple | list" = ()):
+        super().__init__(*args)
+        self.offerings = tuple(offerings)
+
     def __str__(self):
         return f"insufficient capacity, {super().__str__()}"
 

@@ -46,7 +46,7 @@ class NodeClaimLifecycle(Controller):
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None,
                  registration_ttl: float = REGISTRATION_TTL_SECONDS,
-                 recorder=None):
+                 recorder=None, unavailable=None, trigger=None):
         from ..events.recorder import Recorder
         self.store = store
         self.cluster = cluster
@@ -54,6 +54,14 @@ class NodeClaimLifecycle(Controller):
         self.clock = clock or store.clock
         self.recorder = recorder or Recorder(self.clock)
         self.registration_ttl = registration_ttl
+        # UnavailableOfferings registry: ICE launch failures record their
+        # exhausted offering keys here so the next solve routes around them
+        self.unavailable = unavailable
+        # provisioner.trigger: an ICE-deleted claim is pre-registration (no
+        # Node exists), so NodeDeletionTrigger can never fire for it — the
+        # stranded pods must re-provision NOW, not on the next unrelated
+        # batch window
+        self.trigger = trigger
 
     def reconcile(self, nc: NodeClaim) -> Optional[Result]:
         if self.store.get(NodeClaim, nc.metadata.name,
@@ -86,13 +94,27 @@ class NodeClaimLifecycle(Controller):
         try:
             self.cloud_provider.create(nc)
         except InsufficientCapacityError as e:
-            # launch.go:78-86: ICE deletes the claim so the provisioner retries
+            # launch.go:78-86: ICE deletes the claim so the provisioner
+            # retries — but first the exhausted offering keys feed the
+            # registry (escalating TTL per repeated key) so the retry
+            # solves AROUND the drought instead of re-picking it
             from ..events import catalog as events_catalog
+            keys = getattr(e, "offerings", ())
+            if self.unavailable is not None:
+                for it_name, zone, capacity_type in keys:
+                    ttl = self.unavailable.mark(
+                        it_name, zone, capacity_type,
+                        reason="insufficient_capacity")
+                    log.warning("offering marked unavailable",
+                                instance_type=it_name, zone=zone,
+                                capacity_type=capacity_type, ttl=ttl)
             log.warning("insufficient capacity, deleting nodeclaim",
                         nodeclaim=nc.name, error=str(e))
             self.recorder.publish(
                 events_catalog.insufficient_capacity(nc, str(e)))
             self.store.delete(nc)
+            if self.trigger is not None:
+                self.trigger()
             return Result()
         except CloudProviderError as e:
             log.error("launching nodeclaim failed", nodeclaim=nc.name,
@@ -215,8 +237,16 @@ class NodeClaimLifecycle(Controller):
     def _liveness(self, nc: NodeClaim) -> Optional[Result]:
         age = self.clock.now() - nc.metadata.creation_timestamp
         if age >= self.registration_ttl:
+            from ..events import catalog as events_catalog
+            from ..metrics import registry as metrics
             log.warning("nodeclaim not registered within TTL, deleting",
                         nodeclaim=nc.name, ttl=self.registration_ttl)
+            # observable, not silent: registration droughts show up as a
+            # warning event + counter, not just vanishing claims
+            self.recorder.publish(
+                events_catalog.registration_timeout(nc, self.registration_ttl))
+            metrics.NODECLAIMS_LIVENESS_TERMINATED.inc(
+                {"nodepool": nc.nodepool_name})
             self.store.delete(nc)  # liveness.go:55-62
             return Result()
         return Result(requeue_after=self.registration_ttl - age)

@@ -132,7 +132,11 @@ class DisruptionSnapshot:
              for np_ in self.nodepools},
             state_nodes=self.state_nodes,
             daemonset_pods=cluster.daemonset_pod_list(),
-            cluster=StateClusterView(cluster.store, cluster))
+            cluster=StateClusterView(cluster.store, cluster),
+            # the unavailable-offerings mask rides into every disruption
+            # encode too: consolidation must never plan a replacement onto
+            # an offering a launch failure just proved dry
+            unavailable=getattr(provisioner, "unavailable", None))
         self._encodings: Dict[tuple, object] = {}
 
     # -- per-candidate-set encode (memoized) --------------------------------

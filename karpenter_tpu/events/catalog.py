@@ -225,6 +225,33 @@ def insufficient_capacity(nodeclaim, err: str) -> Event:
         dedupe_values=(nodeclaim.name,))
 
 
+def registration_timeout(nodeclaim, ttl: float) -> Event:
+    """Warning published when liveness deletes a claim that never
+    registered within the TTL (liveness.go:41-66 deletes silently; a
+    registration drought must be observable, not a disappearing claim)."""
+    return Event(
+        object_kind="NodeClaim", object_name=nodeclaim.name,
+        type=WARNING, reason="FailedRegistration",
+        message=(f"NodeClaim {nodeclaim.name} not registered within "
+                 f"{int(ttl)}s, deleting"),
+        dedupe_values=(nodeclaim.name,))
+
+
+def offerings_exhausted(pod, detail: str) -> Event:
+    """Warning published when every offering compatible with a pod is
+    masked by the unavailable-offerings registry: the pod waits for the
+    TTL (or fresh capacity), it is not hot-looped through doomed solves.
+    Distinct reason from FailedScheduling so drought alerting can key on
+    it; deduped per pod so the backoff requeues don't spam."""
+    return Event(
+        object_kind="Pod", object_name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        type=WARNING, reason="AllOfferingsUnavailable",
+        message=("Failed to schedule pod, every compatible offering is "
+                 f"marked unavailable: {_truncate(detail)}"),
+        dedupe_ttl=5 * 60.0, dedupe_values=(pod.uid,))
+
+
 # -- fault-tolerant runtime --------------------------------------------------
 
 def reconcile_quarantined(kind: str, name: str, namespace: str,

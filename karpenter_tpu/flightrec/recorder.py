@@ -39,6 +39,21 @@ from . import record as rec_codec
 DEFAULT_CAPACITY = 32
 
 
+def _masked_instance_types(ts) -> dict:
+    """The catalog AS THE SOLVE SAW IT: when an unavailable-offerings
+    registry masked offerings out of a solve, the captured catalog must
+    carry those offerings as available=False copies — otherwise replay
+    would re-solve against the unmasked catalog and flag the recorded
+    drought-routing decision as nondeterministic. Reads the scheduler's
+    PINNED pattern snapshot (drought_patterns), never the live registry:
+    a TTL lapsing between solve and capture must not shift the mask."""
+    from ..state.unavailable import mask_catalog
+    patterns = getattr(ts, "drought_patterns", ())
+    if not patterns:
+        return dict(ts.instance_types)
+    return mask_catalog(dict(ts.instance_types), patterns)
+
+
 class FlightRecord:
     """One captured decision. `solve` inputs — and for provisioning
     captures the decision digest too — may still be pinned object
@@ -77,7 +92,13 @@ class FlightRecord:
             if self._refs is None:
                 return
             nodepools, instance_types, pods, state_nodes, daemons, cluster, \
-                store = self._refs
+                store, drought_patterns = self._refs
+            # apply the solve's pinned unavailable-offerings view at
+            # materialize time (the O(T*O) copy stays OFF the capture hot
+            # path): catalog objects are replaced, never rewritten, so the
+            # deferred mask sees exactly what the solve saw
+            from ..state.unavailable import mask_catalog
+            instance_types = mask_catalog(instance_types, drought_patterns)
             for attempt in range(3):
                 # the /debug endpoint materializes on the serving thread
                 # while the operator loop mutates the (deliberately
@@ -158,9 +179,12 @@ class FlightRecorder:
                 "errors": len(results.pod_errors),
             }
             pinned = list(pods)
+            # the drought pattern snapshot rides the refs so the O(T*O)
+            # catalog mask is applied at materialize time, not here
             refs = (list(ts.nodepools), dict(ts.instance_types), pinned,
                     list(ts.state_nodes), list(ts.daemonset_pods), ts.cluster,
-                    getattr(ts.cluster, "store", None))
+                    getattr(ts.cluster, "store", None),
+                    tuple(getattr(ts, "drought_patterns", ())))
             # digest deferred too: its per-claim option-list hashing costs
             # ~10 ms at headline scale. Claim/option objects are immutable
             # after the solve; the error dict is snapshotted now.
@@ -212,7 +236,7 @@ class FlightRecorder:
                              if sn.name() not in winner_nodes]
                 digest = rec_codec.decision_digest(results, sim_pods)
                 solve = rec_codec.encode_solve_payload(
-                    ts.nodepools, ts.instance_types, sim_pods,
+                    ts.nodepools, _masked_instance_types(ts), sim_pods,
                     state_nodes=survivors, daemonset_pods=ts.daemonset_pods,
                     cluster=ts.cluster,
                     store=getattr(ts.cluster, "store", None))

@@ -250,6 +250,17 @@ SOLVER_COMPILE_CACHE_MISSES = REGISTRY.counter(
     "karpenter_solver_compile_cache_misses_total",
     "Feasibility-precompute solves that had to compile a fresh executable "
     "for a new padded shape bucket")
+OFFERINGS_UNAVAILABLE = REGISTRY.gauge(
+    "karpenter_offerings_unavailable",
+    "Offering keys currently cached as unavailable (TTL live) in the "
+    "capacity-failure feedback registry")
+OFFERINGS_MARKED = REGISTRY.counter(
+    "karpenter_offerings_marked_total",
+    "Offering keys marked unavailable by capacity failures", ("reason",))
+NODECLAIMS_LIVENESS_TERMINATED = REGISTRY.counter(
+    "karpenter_nodeclaims_liveness_terminated_total",
+    "NodeClaims deleted because they failed to register within the "
+    "liveness TTL", ("nodepool",))
 FLIGHTREC_RECORDS = REGISTRY.counter(
     "karpenter_flightrecorder_records_total",
     "Decision records captured by the flight recorder", ("kind",))

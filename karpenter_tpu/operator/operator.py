@@ -52,9 +52,17 @@ class Operator:
             self.store = Store(self.clock)
         self.cluster = Cluster(self.store, self.clock)
         wire_informers(self.store, self.cluster)
+        # capacity-failure feedback: launch ICEs mark offering keys here
+        # (nodeclaim lifecycle), both solvers mask live entries out of
+        # their offering tensors, and providers that support it skip dry
+        # offerings at create — one registry closes the whole loop
+        from ..state.unavailable import UnavailableOfferings
+        self.unavailable = UnavailableOfferings(clock=self.clock)
         # every SPI call is timed + error-counted (cloudprovider/metrics.py)
-        self.cloud_provider = decorate_cloud_provider(
-            cloud_provider or KwokCloudProvider(store=self.store))
+        raw_provider = cloud_provider or KwokCloudProvider(store=self.store)
+        if hasattr(raw_provider, "unavailable"):
+            raw_provider.unavailable = self.unavailable
+        self.cloud_provider = decorate_cloud_provider(raw_provider)
         self.recorder = Recorder(self.clock)
         if self.options.store_backend == "kube":
             # publish real v1.Event objects through the adapter so operators
@@ -95,7 +103,8 @@ class Operator:
                                        self.cloud_provider, self.clock,
                                        scheduler_factory=scheduler_factory,
                                        recorder=self.recorder,
-                                       flight_recorder=self.flightrec)
+                                       flight_recorder=self.flightrec,
+                                       unavailable=self.unavailable)
         self.provisioner.batcher.idle = self.options.batch_idle_duration
         self.provisioner.batcher.max_duration = self.options.batch_max_duration
         self.queue = OrchestrationQueue(self.store, self.cluster, self.clock,
@@ -113,7 +122,9 @@ class Operator:
             self.queue,
             self.disruption,
             NodeClaimLifecycle(self.store, self.cluster, self.cloud_provider,
-                               self.clock, recorder=self.recorder),
+                               self.clock, recorder=self.recorder,
+                               unavailable=self.unavailable,
+                               trigger=self.provisioner.trigger),
             NodeClaimDisruptionMarker(self.store, self.cluster,
                                       self.cloud_provider, self.clock),
             NodeTermination(self.store, self.cluster, self.clock,
@@ -186,7 +197,8 @@ class Operator:
                 healthy=lambda: True,
                 ready=lambda: self.cluster.synced(),
                 profiling=self.options.enable_profiling,
-                manager=self.manager, flightrec=self.flightrec).start()
+                manager=self.manager, flightrec=self.flightrec,
+                unavailable=self.unavailable).start()
             self.log.info("serving metrics and health probes",
                           metrics_port=self.serving.metrics_port,
                           health_port=self.serving.health_port)

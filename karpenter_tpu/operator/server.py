@@ -184,6 +184,27 @@ def _debug_flightrec_factory(flightrec):
     return fn
 
 
+def _debug_offerings_factory(unavailable):
+    """The unavailable-offerings registry's operator surface: which
+    offering keys are currently cached as dry, why, their (escalated) TTLs
+    and time to expiry — the first stop when pods carry
+    AllOfferingsUnavailable events or karpenter_offerings_unavailable is
+    non-zero. Operational like /debug/deadletter: served whenever a
+    registry exists, not gated behind profiling."""
+    def fn():
+        if unavailable is None:
+            return 404, "text/plain", "no unavailable-offerings registry"
+        entries = unavailable.snapshot()
+        lines = [f"unavailable {len(entries)}"]
+        for e in entries:
+            lines.append(
+                f"{e['instance_type']}/{e['zone']}/{e['capacity_type']} "
+                f"reason={e['reason']} ttl={e['ttl']:.0f}s "
+                f"strikes={e['strikes']} expires_in={e['expires_in']:.1f}s")
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
 def _debug_timers_factory(manager):
     def fn():
         if manager is None:
@@ -212,7 +233,7 @@ class ServingGroup:
                  healthy: Callable[[], bool] = lambda: True,
                  ready: Callable[[], bool] = lambda: True,
                  registry=REGISTRY, profiling: bool = False, manager=None,
-                 flightrec=None):
+                 flightrec=None, unavailable=None):
         def probe(check: Callable[[], bool]):
             def fn():
                 if check():
@@ -232,6 +253,9 @@ class ServingGroup:
             # recorder exists, not gated behind profiling
             metrics_routes["/debug/flightrecorder"] = \
                 _debug_flightrec_factory(flightrec)
+        if unavailable is not None:
+            metrics_routes["/debug/offerings"] = \
+                _debug_offerings_factory(unavailable)
         if profiling:
             metrics_routes["/debug/stacks"] = _debug_stacks
             metrics_routes["/debug/timers"] = _debug_timers_factory(manager)

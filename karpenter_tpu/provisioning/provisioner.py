@@ -144,11 +144,25 @@ class NodeDeletionTrigger(Controller):
 class Provisioner(SingletonController):
     name = "provisioner"
 
+    # cap on the exhausted-pod hold: when every pending pod is drought-
+    # blocked, the solve loop sleeps until the next registry expiry but
+    # never longer than this, so out-of-band capacity changes (a node
+    # freeing up) are picked up promptly even without a trigger
+    EXHAUSTED_HOLD_MAX_SECONDS = 30.0
+
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None, batcher: Optional[Batcher] = None,
-                 scheduler_factory=None, recorder=None, flight_recorder=None):
+                 scheduler_factory=None, recorder=None, flight_recorder=None,
+                 unavailable=None):
         from ..events.recorder import Recorder
         self.store = store
+        # state.unavailable.UnavailableOfferings: expired at the top of
+        # every pass (an expiry re-triggers a solve via the hold signature)
+        # and handed to every scheduler the default factory builds
+        self.unavailable = unavailable
+        # (until, registry_version, pending_uids) while every pending pod
+        # is drought-blocked: identical inputs re-solve nothing, so hold
+        self._exhausted_hold = None
         # optional flightrec.FlightRecorder: live provisioning solves (NOT
         # disruption simulation probes — those would flood the ring) are
         # captured as replayable DecisionRecords
@@ -165,7 +179,8 @@ class Provisioner(SingletonController):
             lambda nodepools, instance_types, state_nodes, daemonset_pods,
             cluster: TensorScheduler(
                 nodepools, instance_types, state_nodes=state_nodes,
-                daemonset_pods=daemonset_pods, cluster=cluster))
+                daemonset_pods=daemonset_pods, cluster=cluster,
+                unavailable=self.unavailable))
         # pod key -> nodeclaim name, consumed by the Binder
         self.nominations: Dict[str, str] = {}
         self.last_results = None
@@ -198,6 +213,11 @@ class Provisioner(SingletonController):
     # -- main loop ----------------------------------------------------------
 
     def reconcile(self) -> Optional[Result]:
+        if self.unavailable is not None:
+            # prune expired unavailable-offering entries FIRST: an expiry
+            # bumps the registry version, which releases the exhausted-pod
+            # hold below — capacity recovery is picked up within one TTL
+            self.unavailable.expire()
         pods = self.get_pending_pods()
         # pods on deleting nodes must be rescheduled too, even when nothing
         # is pending — their replacement capacity has to exist before the
@@ -214,7 +234,11 @@ class Provisioner(SingletonController):
                     deleting_pods.append(p)
         if not pods and not deleting_pods:
             self.batcher.reset()
+            self._exhausted_hold = None
             return None
+        hold = self._check_exhausted_hold(pods, deleting_pods)
+        if hold is not None:
+            return hold
         if self.batcher._first is None:
             # pods may predate trigger wiring; start the window now
             self.batcher.trigger()
@@ -250,10 +274,154 @@ class Provisioner(SingletonController):
         if results.pod_errors:
             for uid, err in list(results.pod_errors.items())[:10]:
                 log.debug("pod failed to schedule", pod_uid=uid, error=err)
-        return None
+        return self._handle_exhausted(results, deleting_pods)
 
     def _pod_by_uid(self, uid: str) -> Optional[Pod]:
         return self.store.get_by_uid(Pod, uid)
+
+    # -- capacity-exhaustion backoff ----------------------------------------
+
+    def _check_exhausted_hold(self, pods, deleting_pods) -> Optional[Result]:
+        """While every pending pod is drought-blocked and nothing changed
+        (same pending set, same registry state), a re-solve is a doomed hot
+        loop — sleep until the hold expires. Any new pod, any registry mark
+        or expiry, or the hold lapsing releases it."""
+        hold = self._exhausted_hold
+        if hold is None:
+            return None
+        until, version, held_uids = hold
+        now = self.clock.now()
+        pending = frozenset(p.uid for p in pods).union(
+            p.uid for p in deleting_pods)
+        if now >= until or pending != held_uids \
+                or self.unavailable is None \
+                or self.unavailable.version != version:
+            self._exhausted_hold = None
+            return None
+        return Result(requeue_after=until - now)
+
+    def _handle_exhausted(self, results, deleting_pods) -> Optional[Result]:
+        """Post-solve drought handling: pods whose every compatible
+        offering is masked get ONE distinct warning event (deduped per
+        pod) and, when they are the only failures, a backoff requeue to
+        the next registry expiry instead of a hot solve loop."""
+        exhausted = self._offerings_exhausted_pods(results)
+        if not exhausted:
+            self._exhausted_hold = None
+            return None
+        live = self.unavailable.snapshot()
+        detail = ", ".join(
+            f"{e['instance_type']}/{e['zone']}/{e['capacity_type']}"
+            for e in live[:5]) or "registry"
+        if len(live) > 5:
+            detail += f" (+{len(live) - 5} more)"
+        for p in exhausted:
+            self.recorder.publish(
+                events_catalog.offerings_exhausted(p, detail))
+        if len(exhausted) != len(results.pod_errors):
+            # mixed failures: the non-drought errors keep the normal
+            # re-solve cadence, no hold
+            self._exhausted_hold = None
+            return None
+        now = self.clock.now()
+        until = now + self.EXHAUSTED_HOLD_MAX_SECONDS
+        nxt = self.unavailable.next_expiry()
+        if nxt is not None:
+            until = min(until, nxt)
+        until = max(until, now + 1.0)
+        # the hold signature must equal NEXT pass's pending view: errored
+        # pods stay pending, and deleting-node pods reappear in the
+        # deleting set whether or not this pass placed them — omitting
+        # them would invalidate the hold every cycle and run the doomed
+        # solve loop the hold exists to prevent
+        self._exhausted_hold = (
+            until, self.unavailable.version,
+            frozenset(results.pod_errors).union(
+                p.uid for p in deleting_pods))
+        log.info("all pending pods blocked on unavailable offerings; "
+                 "holding solves", pods=len(exhausted),
+                 hold_seconds=round(until - now, 1))
+        return Result(requeue_after=until - now)
+
+    def _offerings_exhausted_pods(self, results) -> List[Pod]:
+        """Errored pods that some nodepool could otherwise host — taints
+        tolerated, pool and instance-type requirements compatible,
+        resources fit — but whose every admissible offering is covered by
+        a live registry entry: waiting on capacity, not misconfigured.
+        Pods no pool admits, or that fit no type, keep the plain
+        FailedScheduling path even under a wildcard drought."""
+        reg = self.unavailable
+        if reg is None or not results.pod_errors or not len(reg):
+            return []
+        ts = self.last_scheduler
+        its_by_pool = getattr(ts, "instance_types", None)
+        nodepools = getattr(ts, "nodepools", None)
+        if not its_by_pool or not nodepools:
+            return []
+        from ..scheduling import taints as scheduling_taints
+        from ..scheduling.requirements import (ALLOW_UNDEFINED_WELL_KNOWN,
+                                               pod_requirements)
+        from ..utils import resources as res
+        from .scheduler import NodeClaimTemplate
+        from .tensor_scheduler import _reqs_digest
+        pools = [(NodeClaimTemplate(np_), its_by_pool.get(np_.name, []))
+                 for np_ in nodepools]
+        by_uid = {p.uid: p for p in self.store.list(Pod)}
+        # drought batches are overwhelmingly homogeneous (one deployment's
+        # replicas share a spec): memoize the verdict per pod SHAPE so the
+        # catalog scan runs once per distinct (requirements, requests,
+        # tolerations), not once per errored pod — and cap the distinct
+        # shapes scanned so a pathological batch can't stall the pass
+        verdict_memo: dict = {}
+        MAX_SHAPES = 64
+        out: List[Pod] = []
+        for uid in results.pod_errors:
+            p = by_uid.get(uid)
+            if p is None:
+                continue
+            reqs = pod_requirements(p)
+            requests = p.requests()
+            shape = (_reqs_digest(reqs), tuple(sorted(requests.items())),
+                     tuple((t.key, t.operator, t.value, t.effect)
+                           for t in p.spec.tolerations))
+            verdict = verdict_memo.get(shape)
+            if verdict is None:
+                if len(verdict_memo) >= MAX_SHAPES:
+                    continue  # scan budget spent: keep FailedScheduling
+                verdict = self._shape_is_exhausted(p, reqs, requests, pools,
+                                                   reg, scheduling_taints,
+                                                   ALLOW_UNDEFINED_WELL_KNOWN,
+                                                   res)
+                verdict_memo[shape] = verdict
+            if verdict:
+                out.append(p)
+        return out
+
+    @staticmethod
+    def _shape_is_exhausted(p, reqs, requests, pools, reg, scheduling_taints,
+                            allow_undefined, res) -> bool:
+        compatible = False
+        for nct, its in pools:
+            # tolerates() returns the error list: truthy = blocked
+            if scheduling_taints.tolerates(nct.taints, p):
+                continue
+            if nct.requirements.compatible(reqs, allow_undefined):
+                continue  # pool-level requirements exclude the pod
+            for it in its:
+                if it.requirements.intersects(reqs):
+                    continue
+                if not res.fits(requests, it.allocatable()):
+                    continue
+                offs = (it.offerings.available().compatible(reqs)
+                        .compatible(nct.requirements))
+                if not offs:
+                    continue
+                compatible = True
+                if any(not reg.is_unavailable(it.name, o.zone,
+                                              o.capacity_type)
+                       for o in offs):
+                    return False  # an unmasked offering exists
+        return compatible
 
     def schedule(self, pods: List[Pod]):
         # exclude deleting nodes from pack targets (NewScheduler filters them)

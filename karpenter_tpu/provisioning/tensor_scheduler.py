@@ -67,6 +67,13 @@ class _CatalogEncoding:
     zone_values: np.ndarray
     allow_undefined: np.ndarray
     device_cache: dict
+    # offering identities as strings [T] / [T, O] ("" = absent slot):
+    # the unavailable-offerings registry mask is built by matching its
+    # (instance_type, zone, capacity_type) patterns against these in a few
+    # vectorized passes per solve — no per-offering Python on the hot path
+    off_names: np.ndarray = None
+    off_zone_names: np.ndarray = None
+    off_ct_names: np.ndarray = None
 
 
 import threading
@@ -281,7 +288,8 @@ class TensorScheduler:
                  cluster: Optional[ClusterView] = None,
                  initial_zone_counts=None, force_tensor: bool = False,
                  mesh=None, catalog_token: Optional[tuple] = None,
-                 circuit: Optional[SolverCircuitBreaker] = None):
+                 circuit: Optional[SolverCircuitBreaker] = None,
+                 unavailable=None):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -297,6 +305,19 @@ class TensorScheduler:
         self.catalog_token = catalog_token
         # shared breaker by default: schedulers are per-solve, trips aren't
         self.circuit = circuit if circuit is not None else SOLVER_CIRCUIT
+        # state.unavailable.UnavailableOfferings: live entries are masked
+        # out of off_available / it_price before every solve (tensor path)
+        # and out of the catalog copies the host fallback sees, so neither
+        # solver ever places onto an offering known to be dry
+        self.unavailable = unavailable
+        # the pattern set the LAST solve actually masked with: consumers
+        # that must reproduce this solve's view (the flight recorder's
+        # captured catalog) read these instead of the live registry, whose
+        # TTLs keep ticking under a real clock. _drought_pinned marks that
+        # THIS solve already snapshotted them (tensor build), so a host
+        # fallback later in the same solve reuses the identical view.
+        self.drought_patterns: tuple = ()
+        self._drought_pinned = False
         # optional flightrec.FlightRecorder: every solve() is captured as a
         # replayable DecisionRecord. None (the default) costs one attribute
         # compare per solve.
@@ -326,6 +347,8 @@ class TensorScheduler:
         return results
 
     def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
+        # fresh registry snapshot per solve (see drought_patterns)
+        self._drought_pinned = False
         # port eligibility needs existing-node usage: a port occupied on a
         # live node makes its pods CONFLICTED (capped groups with per-node
         # exclusion) instead of constraint-free
@@ -443,9 +466,23 @@ class TensorScheduler:
 
     def _make_host(self, pods: List[Pod]) -> Scheduler:
         from .domains import build_topology_domains
-        domains = build_topology_domains(self.nodepools, self.instance_types)
+        instance_types = self.instance_types
+        if self.unavailable is not None:
+            # the host oracle reads offering availability off the catalog
+            # objects, so the registry mask rides in as available=False
+            # copies — fallback solves route around droughts exactly like
+            # the tensor path's off_available mask. Patterns are pinned
+            # once per solve so a tensor attempt, its host remainder, and
+            # the capture/replay view all share ONE registry snapshot.
+            from ..state.unavailable import mask_catalog
+            if not self._drought_pinned:
+                self.drought_patterns = self.unavailable.live()
+                self._drought_pinned = True
+            instance_types = mask_catalog(instance_types,
+                                          self.drought_patterns)
+        domains = build_topology_domains(self.nodepools, instance_types)
         topo = Topology(self.cluster, domains, pods)
-        return Scheduler(self.nodepools, self.instance_types, topo,
+        return Scheduler(self.nodepools, instance_types, topo,
                          state_nodes=self.state_nodes,
                          daemonset_pods=self.daemonset_pods)
 
@@ -573,6 +610,10 @@ class TensorScheduler:
         off_zone, off_captype = ce.off_zone, ce.off_captype
         off_available, off_price = ce.off_available, ce.off_price
         zone_values, allow_undefined = ce.zone_values, ce.allow_undefined
+        device_cache = ce.device_cache
+        masked = self._drought_arrays(ce)
+        if masked is not None:
+            off_available, off_price, it_price, device_cache = masked
 
         group_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, g.requirements) for g in groups])
@@ -666,8 +707,57 @@ class TensorScheduler:
             off_price=off_price,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined,
-            device_cache=ce.device_cache, min_its=min_its)
+            device_cache=device_cache, min_its=min_its)
         return problem, templates, catalog
+
+    def _drought_arrays(self, ce: _CatalogEncoding):
+        """Registry-masked (off_available, off_price, it_price,
+        device_cache) for this solve, or None when no live entry touches
+        the catalog. The mask is built by matching the registry's live
+        (instance_type, zone, capacity_type) patterns against the
+        encoding's cached identity arrays in a few vectorized passes — a
+        zone-wide drought is one [T, O] compare, not 16k Python checks.
+        A fully masked type's it_price becomes +inf (the empty-offerings
+        contract, types.go:117-134). The masked device upload is cached
+        per live-pattern set so repeated solves under the same drought
+        state stay as upload-free as the unmasked path."""
+        from ..state.unavailable import WILDCARD
+        reg = self.unavailable
+        if reg is None:
+            return None
+        # pinned once per solve/pass (like _make_host): a disruption
+        # snapshot builds MANY problems through this one scheduler, and a
+        # TTL lapsing mid-pass must not price candidate sets of the same
+        # decision under different masks — nor leave drought_patterns
+        # disagreeing with the mask the recorded winner sim actually used
+        if not self._drought_pinned:
+            self.drought_patterns = reg.live()
+            self._drought_pinned = True
+        patterns = self.drought_patterns
+        if not patterns:
+            return None
+        hit = np.zeros(ce.off_available.shape, dtype=bool)
+        for pit, pz, pct in patterns:
+            m = np.ones(ce.off_available.shape, dtype=bool)
+            if pit != WILDCARD:
+                m &= (ce.off_names == pit)[:, None]
+            if pz != WILDCARD:
+                m &= ce.off_zone_names == pz
+            if pct != WILDCARD:
+                m &= ce.off_ct_names == pct
+            hit |= m
+        hit &= ce.off_available
+        if not hit.any():
+            return None
+        off_available = ce.off_available & ~hit
+        off_price = np.where(off_available, ce.off_price,
+                             np.inf).astype(np.float32)
+        it_price = off_price.min(axis=1)
+        slot = ce.device_cache.get("drought")
+        if slot is None or slot[0] != patterns:
+            slot = (patterns, {})
+            ce.device_cache["drought"] = slot
+        return off_available, off_price, it_price, slot[1]
 
     @staticmethod
     def _min_its_floor(templates, groups) -> Optional[np.ndarray]:
@@ -786,6 +876,9 @@ class TensorScheduler:
         off_available = np.zeros((T, O), dtype=bool)
         off_price = np.full((T, O), np.inf, dtype=np.float32)
         it_price = np.full(T, np.inf, dtype=np.float32)
+        off_names = np.array([it.name for it in catalog], dtype=object)
+        off_zone_names = np.full((T, O), "", dtype=object)
+        off_ct_names = np.full((T, O), "", dtype=object)
         for t, it in enumerate(catalog):
             for o, off in enumerate(it.offerings):
                 if not off.available:
@@ -794,6 +887,8 @@ class TensorScheduler:
                 off_price[t, o] = off.price
                 z = off.zone
                 ct = off.capacity_type
+                off_zone_names[t, o] = z
+                off_ct_names[t, o] = ct
                 if z:
                     off_zone[t, o] = vocab.value_idx[zone_key].get(z, -1)
                 if ct:
@@ -808,7 +903,8 @@ class TensorScheduler:
             it_price=it_price, off_zone=off_zone, off_captype=off_captype,
             off_available=off_available, off_price=off_price,
             zone_values=zone_values, allow_undefined=allow_undefined,
-            device_cache={})
+            device_cache={}, off_names=off_names,
+            off_zone_names=off_zone_names, off_ct_names=off_ct_names)
 
     def cluster_zone_counts(self, groups: List[PodGroup], zone_names,
                             exclude_uids) -> np.ndarray:

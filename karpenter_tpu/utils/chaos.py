@@ -87,6 +87,60 @@ class FaultInjector:
         return sum(self.counts.values())
 
 
+class CapacityDrought:
+    """Scheduled capacity-exhaustion windows for the simulated providers —
+    the chaos substrate behind the unavailable-offerings feedback loop.
+
+    A window is an ``(instance_type, zone, capacity_type)`` pattern ("*"
+    wildcard per position) with an optional expiry: while live, any create
+    whose CHOSEN offering matches raises InsufficientCapacityError carrying
+    the matched pattern — exactly the zone-running-dry / capacity-type-
+    exhausted failure every production autoscaler hits, recovering on its
+    own once the window lapses. Clock-injected (FakeClock in tests) so the
+    drought-and-recovery timeline is deterministic; ``hits`` counts fired
+    exhaustions per pattern for assertions ("zero repeat create calls
+    against a cached-unavailable offering" is ``hits`` staying flat while
+    the registry TTL lives).
+    """
+
+    def __init__(self, clock=None):
+        self.clock = clock
+        self._windows: list = []  # ((it, zone, ct), until_or_None)
+        self.hits: Counter = Counter()
+
+    def exhaust(self, instance_type: str = "*", zone: str = "*",
+                capacity_type: str = "*",
+                duration: Optional[float] = None) -> None:
+        until = None
+        if duration is not None:
+            if self.clock is None:
+                raise ValueError("duration needs an injected clock")
+            until = self.clock.now() + duration
+        self._windows.append(((instance_type, zone, capacity_type), until))
+
+    def clear(self) -> None:
+        self._windows.clear()
+
+    def match(self, instance_type: str, zone: str,
+              capacity_type: str) -> Optional[tuple]:
+        """First live pattern covering the offering (pruning lapsed
+        windows), or None. Counts the hit."""
+        now = self.clock.now() if self.clock is not None else None
+        live, hit = [], None
+        for pat, until in self._windows:
+            if until is not None and now is not None and now >= until:
+                continue
+            live.append((pat, until))
+            pit, pz, pct = pat
+            if hit is None and pit in ("*", instance_type) \
+                    and pz in ("*", zone) and pct in ("*", capacity_type):
+                hit = pat
+        self._windows = live
+        if hit is not None:
+            self.hits["/".join(hit)] += 1
+        return hit
+
+
 @contextlib.contextmanager
 def chaos_pause(injector: Optional[FaultInjector]):
     """Context manager: suspend fault injection (convergence checks)."""

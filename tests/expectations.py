@@ -84,6 +84,13 @@ class Env:
         self.provider = (provider(self.store) if callable(provider)
                          else provider) if provider is not None \
             else KwokCloudProvider(store=self.store)
+        # capacity-failure feedback registry, wired like the operator:
+        # lifecycle ICEs mark it, both solvers mask it, providers that
+        # support it skip cached-dry offerings at create
+        from karpenter_tpu.state.unavailable import UnavailableOfferings
+        self.unavailable = UnavailableOfferings(clock=self.clock)
+        if hasattr(self.provider, "unavailable"):
+            self.provider.unavailable = self.unavailable
         self.recorder = Recorder(self.clock)
         self.mgr = Manager(self.store, self.clock, recorder=self.recorder)
         # crash isolation would silently absorb a regressed reconciler that
@@ -93,7 +100,8 @@ class Env:
         self._reconcile_errors_mark = self._reconcile_errors_total()
         self.provisioner = Provisioner(self.store, self.cluster,
                                        self.provider, self.clock,
-                                       recorder=self.recorder)
+                                       recorder=self.recorder,
+                                       unavailable=self.unavailable)
         self.queue = OrchestrationQueue(self.store, self.cluster, self.clock,
                                         recorder=self.recorder)
         self.disruption = DisruptionController(
@@ -104,7 +112,9 @@ class Env:
             self.provisioner, PodTrigger(self.provisioner),
             Binder(self.store, self.cluster, self.provisioner),
             NodeClaimLifecycle(self.store, self.cluster, self.provider,
-                               self.clock, recorder=self.recorder),
+                               self.clock, recorder=self.recorder,
+                               unavailable=self.unavailable,
+                               trigger=self.provisioner.trigger),
             NodeClaimDisruptionMarker(self.store, self.cluster, self.provider,
                                       self.clock),
             NodeTermination(self.store, self.cluster, self.clock,

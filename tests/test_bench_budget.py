@@ -191,6 +191,48 @@ class TestFlightRecorderBudget:
             "BENCH_MODE=replay missing from the unknown-mode error list"
 
 
+class TestDroughtBudget:
+    """ISSUE 5 guard: the BENCH_MODE=drought line at test scale. The 5%
+    masked-vs-unmasked bound is asserted at 50k in bench_drought (10 ms
+    grace); at 2,000 pods timer noise dwarfs the mask cost, so this guard
+    widens the absolute grace and pins what a regression would actually
+    trip: the bench's internal assertions (tensor-path residency under the
+    mask, no claim on a masked offering) plus an absolute wall-clock
+    budget a host-Python mask rewrite would blow."""
+
+    BUDGET_SECONDS = 30.0
+
+    def test_drought_bench_shape_within_budget(self, capsys, monkeypatch):
+        import json
+        import os as _os
+
+        monkeypatch.setenv("BENCH_DROUGHT_GRACE", "0.25")
+        saved = (bench.N_PODS, bench.N_DEPLOYS, bench.REPEATS)
+        bench.N_PODS, bench.N_DEPLOYS, bench.REPEATS = N_PODS, N_DEPLOYS, 3
+        try:
+            t0 = time.perf_counter()
+            bench.bench_drought()
+            elapsed = time.perf_counter() - t0
+        finally:
+            bench.N_PODS, bench.N_DEPLOYS, bench.REPEATS = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"drought bench took {elapsed:.2f}s at {N_PODS} pods — the "
+            "registry mask likely left the vectorized path")
+        line = json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert line["unit"] == "pods/sec"
+        assert "unavailable-offerings registry" in line["metric"]
+
+    def test_bench_mode_drought_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "drought" in m.group(0), \
+            "BENCH_MODE=drought missing from the unknown-mode error list"
+
+
 @pytest.mark.parametrize("kind", [0, 1, 2, 4, 5, 6, 7, 8])
 def test_node_count_parity_vs_host_oracle_per_kind(kind):
     pods = [p for p in _mix()

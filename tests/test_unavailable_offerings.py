@@ -1,0 +1,700 @@
+"""Capacity-failure feedback: the TTL'd unavailable-offerings registry.
+
+Five layers of evidence that a capacity drought changes future decisions
+instead of hot-looping on the dry offering:
+
+- registry unit behavior: TTL expiry, escalating (capped) TTL on repeated
+  exhaustion, wildcard keys, metrics, object-level catalog masking;
+- the empty-offerings regression (ISSUE 5 satellite): cheapest() /
+  most_expensive() on an empty list, worst_launch_price, and the it_price
+  encode all treat "every offering masked" as price +inf, never a bare
+  ValueError;
+- directed vectors for wildcard-key masking in BOTH solver encodes: the
+  provisioning TensorScheduler.build_problem off_available tensor and the
+  disruption DisruptionSnapshot encode (consolidation replacements never
+  target a masked offering);
+- the lifecycle feedback path: an offering-keyed InsufficientCapacityError
+  marks the registry, deletes the claim, and re-triggers the provisioner
+  (pre-registration claims have no Node, so NodeDeletionTrigger can never
+  fire for them); liveness-TTL deletion publishes a warning event and a
+  counter instead of vanishing silently;
+- the seeded drought soak: zone-wide exhaustion -> one ICE -> the very
+  next pass routes every pod to surviving zones with ZERO further create
+  calls against the cached-dry zone -> TTL + drought expiry -> recovery
+  reaches quiescence with the zone usable again (no flapping).
+
+Deterministic throughout: FakeClock, fixed drought schedules, no sleeps.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import NodeClaim, NodeClaimSpec
+from karpenter_tpu.api.objects import (LabelSelector, Node, ObjectMeta, Pod,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.cloudprovider.types import (InsufficientCapacityError,
+                                               Offering, Offerings,
+                                               order_by_price)
+from karpenter_tpu.controllers.nodeclaim_lifecycle import \
+    REGISTRATION_TTL_SECONDS
+from karpenter_tpu.disruption.helpers import get_candidates
+from karpenter_tpu.disruption.methods import SingleNodeConsolidation
+from karpenter_tpu.disruption.prefix import DisruptionSnapshot
+from karpenter_tpu.metrics.registry import (NODECLAIMS_LIVENESS_TERMINATED,
+                                            OFFERINGS_MARKED,
+                                            OFFERINGS_UNAVAILABLE)
+from karpenter_tpu.provisioning.grouping import group_pods
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.state.unavailable import (UNAVAILABLE_TTL_SECONDS,
+                                             UnavailableOfferings, WILDCARD,
+                                             mask_instance_types_for)
+from karpenter_tpu.utils.chaos import CapacityDrought
+from karpenter_tpu.utils.clock import FakeClock
+
+from expectations import (Env, bind_pod, make_env, make_nodeclaim_and_node,
+                          most_expensive_instance)
+from factories import make_nodepool, make_pod, make_pods
+
+ZONE = api_labels.LABEL_TOPOLOGY_ZONE
+SPOT = api_labels.CAPACITY_TYPE_SPOT
+OD = api_labels.CAPACITY_TYPE_ON_DEMAND
+
+
+# --------------------------------------------------------------------------
+# registry unit behavior
+# --------------------------------------------------------------------------
+
+class TestRegistryUnit:
+    def test_mark_expire_ttl(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=60.0)
+        assert len(reg) == 0 and not reg.is_unavailable("it", "z", "spot")
+        ttl = reg.mark("it-a", "zone-1", SPOT)
+        assert ttl == 60.0
+        assert reg.is_unavailable("it-a", "zone-1", SPOT)
+        assert not reg.is_unavailable("it-a", "zone-2", SPOT)
+        clock.step(59.0)
+        assert reg.is_unavailable("it-a", "zone-1", SPOT)
+        clock.step(2.0)
+        assert not reg.is_unavailable("it-a", "zone-1", SPOT)
+        assert reg.expire() == [("it-a", "zone-1", SPOT)]
+        assert len(reg) == 0 and reg.live() == ()
+
+    def test_escalating_ttl_is_capped(self):
+        """Escalation fires on failed re-probes AFTER expiry (the marks
+        are spaced past each TTL) and is capped."""
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=10.0, escalation=2.0,
+                                   max_ttl=40.0)
+        ttls = []
+        for _ in range(5):
+            ttl = reg.mark(zone="zone-1")
+            ttls.append(ttl)
+            clock.step(ttl + 1.0)
+        assert ttls == [10.0, 20.0, 40.0, 40.0, 40.0]
+
+    def test_remark_while_live_refreshes_without_escalating(self):
+        """Several in-flight claims failing on the same drought in one
+        episode (review finding): a re-mark while the entry is LIVE is not
+        re-probe evidence — it refreshes the window at the current TTL
+        instead of multiplying it 2^N within seconds."""
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=10.0, escalation=2.0,
+                                   max_ttl=40.0)
+        assert reg.mark(zone="zone-1") == 10.0   # expires t=10
+        clock.step(5.0)
+        assert reg.mark(zone="zone-1") == 10.0   # refresh, no escalation
+        assert reg.next_expiry() == clock.now() + 10.0
+        clock.step(11.0)                         # t=16: expired re-probe
+        assert reg.mark(zone="zone-1") == 20.0   # NOW it escalates
+
+    def test_strikes_reset_after_clear_window(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=10.0, escalation=2.0,
+                                   max_ttl=40.0)
+        assert reg.mark(zone="zone-1") == 10.0   # expires t=10
+        clock.step(11.0)
+        assert reg.mark(zone="zone-1") == 20.0   # t=11, expires t=31
+        # clearance is measured from EXPIRY: the key must stay clear past
+        # the cap after the entry lapsed before strikes reset
+        clock.step(50.0)  # t=61: clear for 30s < 40s cap -> still strikes
+        assert reg.mark(zone="zone-1") == 40.0   # expires t=101
+        clock.step(40.0 + 42.0)  # t=143: clear for 42s > the 40s cap
+        assert reg.mark(zone="zone-1") == 10.0
+
+    def test_escalation_holds_at_cap_under_persistent_drought(self):
+        """Regression (review finding): re-probes arrive one pass AFTER
+        each entry expires, so the inter-mark gap ~= the previous TTL — a
+        since-last-mark clearance window would reset the escalation the
+        moment it reached the cap, cycling 10->...->40->10 forever. The
+        expiry-anchored window holds the cap."""
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=10.0, escalation=2.0,
+                                   max_ttl=40.0)
+        ttls = []
+        for _ in range(6):
+            ttl = reg.mark(zone="zone-1")
+            ttls.append(ttl)
+            clock.step(ttl + 1.0)  # next doomed probe just after expiry
+        assert ttls == [10.0, 20.0, 40.0, 40.0, 40.0, 40.0]
+
+    def test_wildcard_key_coverage(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock)
+        reg.mark(zone="zone-1")                      # zone-wide
+        reg.mark(instance_type="it-big")             # type-wide
+        reg.mark("it-x", "zone-2", SPOT)             # exact
+        assert reg.is_unavailable("anything", "zone-1", OD)
+        assert reg.is_unavailable("it-big", "zone-3", SPOT)
+        assert reg.is_unavailable("it-x", "zone-2", SPOT)
+        assert not reg.is_unavailable("it-x", "zone-2", OD)
+        assert not reg.is_unavailable("it-y", "zone-3", OD)
+
+    def test_metrics_and_snapshot(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock, ttl=30.0)
+        marked0 = OFFERINGS_MARKED.value({"reason": "insufficient_capacity"})
+        reg.mark(zone="zone-1")
+        reg.mark(zone="zone-2")
+        assert OFFERINGS_MARKED.value(
+            {"reason": "insufficient_capacity"}) == marked0 + 2
+        assert OFFERINGS_UNAVAILABLE.value() == 2.0
+        snap = reg.snapshot()
+        assert [e["zone"] for e in snap] == ["zone-1", "zone-2"]
+        assert all(e["instance_type"] == WILDCARD for e in snap)
+        clock.step(31.0)
+        reg.expire()
+        assert OFFERINGS_UNAVAILABLE.value() == 0.0
+
+    def test_mask_instance_types_copies_not_mutates(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock)
+        its = construct_instance_types()
+        # empty pattern set: no-op returning the same list
+        assert mask_instance_types_for(its, reg.live()) is its
+        reg.mark(zone="test-zone-a")
+        masked = mask_instance_types_for(its, reg.live())
+        assert masked is not its
+        for orig, cp in zip(its, masked):
+            assert cp is not orig
+            assert all(o.available for o in orig.offerings)  # untouched
+            for o in cp.offerings:
+                assert o.available == (o.zone != "test-zone-a")
+
+
+# --------------------------------------------------------------------------
+# empty-offerings regression (satellite: bare ValueError -> price inf)
+# --------------------------------------------------------------------------
+
+class TestEmptyOfferingsRegression:
+    def test_cheapest_and_most_expensive_on_empty_return_none(self):
+        assert Offerings().cheapest() is None
+        assert Offerings().most_expensive() is None
+
+    def test_worst_launch_price_on_empty_is_inf(self):
+        reqs = Requirements([Requirement(
+            api_labels.CAPACITY_TYPE_LABEL_KEY, IN, [SPOT, OD])])
+        assert Offerings().worst_launch_price(reqs) == math.inf
+
+    def test_order_by_price_with_fully_masked_type(self):
+        clock = FakeClock()
+        reg = UnavailableOfferings(clock=clock)
+        its = construct_instance_types()[:4]
+        reg.mark(instance_type=its[0].name)  # type-wide: empties it
+        masked = mask_instance_types_for(its, reg.live())
+        ordered = order_by_price(masked, Requirements())
+        # the fully masked type prices at +inf: sorted last, no ValueError
+        assert ordered[-1].name == its[0].name
+        assert not ordered[-1].offerings.available()
+
+    def test_it_price_encodes_inf_for_fully_masked_type(self):
+        reg = UnavailableOfferings(clock=FakeClock())
+        its = construct_instance_types()
+        dead = its[0].name
+        reg.mark(instance_type=dead)
+        ts = TensorScheduler([make_nodepool(name="default")],
+                             {"default": its}, unavailable=reg)
+        groups, reason = group_pods([make_pod()])
+        assert groups is not None, reason
+        problem, _, catalog = ts.build_problem(groups)
+        t = next(i for i, it in enumerate(catalog) if it.name == dead)
+        assert problem.it_price[t] == np.inf
+        assert not problem.off_available[t].any()
+        # unmasked rows are untouched
+        alive = next(i for i, it in enumerate(catalog) if it.name != dead)
+        assert problem.off_available[alive].any()
+        assert np.isfinite(problem.it_price[alive])
+
+
+# --------------------------------------------------------------------------
+# wildcard-key masking in the PROVISIONING encode
+# --------------------------------------------------------------------------
+
+class TestProvisioningEncodeMask:
+    def _ts(self, reg):
+        return TensorScheduler([make_nodepool(name="default")],
+                               {"default": construct_instance_types()},
+                               unavailable=reg)
+
+    def _spread_pods(self, n=8):
+        sel = LabelSelector(match_labels={"app": "spread"})
+        return make_pods(n, labels={"app": "spread"},
+                         spread=[TopologySpreadConstraint(
+                             topology_key=ZONE, max_skew=1,
+                             label_selector=sel)])
+
+    def test_zone_wide_mask_flips_off_available(self):
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(zone="test-zone-a")
+        ts = self._ts(reg)
+        groups, _ = group_pods(self._spread_pods())
+        problem, _, _ = ts.build_problem(groups)
+        zi = problem.vocab.value_idx[problem.zone_key]["test-zone-a"]
+        assert not np.any(problem.off_available & (problem.off_zone == zi))
+        # the other zones stay live
+        zb = problem.vocab.value_idx[problem.zone_key]["test-zone-b"]
+        assert np.any(problem.off_available & (problem.off_zone == zb))
+
+    def test_zone_wide_mask_routes_affinity_pods(self):
+        """Reroutable pods (zone affinity admitting the dry zone AND a
+        survivor) all schedule the very next pass — and when every
+        admitted zone is masked, they all error, proving the mask actually
+        gates the offering tensor rather than riding along inertly."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        pods = make_pods(6, required_affinity=[[NodeSelectorRequirement(
+            ZONE, "In", ("test-zone-a", "test-zone-b"))]])
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(zone="test-zone-a")
+        ts = self._ts(reg)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        assert not r.pod_errors
+        assert r.new_nodeclaims
+        reg.mark(zone="test-zone-b")  # now every admitted zone is dry
+        ts2 = self._ts(reg)
+        r2 = ts2.solve(pods)
+        assert ts2.fallback_reason == ""
+        assert len(r2.pod_errors) == len(pods)
+
+    def test_zone_wide_mask_waterlines_hard_spread(self):
+        """DoNotSchedule zonal spread keeps REFERENCE semantics: the dry
+        zone stays in the domain universe (domains derive from
+        requirements, not offerings — provisioner.go:236-283), so only the
+        skew waterline schedules into survivors and the rest error. The
+        mask must route what is routable and never commit the dry zone."""
+        pods = self._spread_pods(8)
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(zone="test-zone-a")
+        ts = self._ts(reg)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        # waterline: one pod per surviving zone (skew vs the empty dry
+        # zone caps at 1), five stuck
+        assert len(r.pod_errors) == 5, r.pod_errors
+        committed = set()
+        for nc in r.new_nodeclaims:
+            zr = nc.requirements.raw(ZONE)
+            assert zr is not None and not zr.complement
+            committed |= set(zr.values)
+        assert committed == {"test-zone-b", "test-zone-c", "test-zone-d"}
+        # documented deviation (DEVIATIONS.md): the host oracle mirrors the
+        # reference greedy, whose next-domain pick for a spread is the
+        # single min-count domain regardless of offerings — a dry min
+        # domain strands the whole group there, while the tensor path's
+        # offering-gated zone water-fill still ships the waterline. The
+        # tensor path never does WORSE than the oracle.
+        host = self._ts(reg)
+        rh = host._host_solve(pods, "forced oracle comparison")
+        assert len(rh.pod_errors) >= len(r.pod_errors)
+
+    def test_capacity_type_wide_mask(self):
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(capacity_type=SPOT)  # spot dry everywhere
+        ts = self._ts(reg)
+        groups, _ = group_pods([make_pod()])
+        problem, _, _ = ts.build_problem(groups)
+        ct_names = np.array(
+            [[problem.vocab.values[problem.captype_key][c] if c >= 0 else ""
+              for c in row] for row in problem.off_captype], dtype=object)
+        assert not np.any(problem.off_available & (ct_names == SPOT))
+        assert np.any(problem.off_available & (ct_names == OD))
+
+    def test_type_wide_mask_excludes_type_from_claims(self):
+        pods = make_pods(4)
+        plain = self._ts(None)
+        r0 = plain.solve(pods)
+        assert r0.new_nodeclaims
+        # without the mask, the launch decision's cheapest option is first
+        cheapest = r0.new_nodeclaims[0].instance_type_options[0].name
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(instance_type=cheapest)
+        ts = self._ts(reg)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "" and not r.pod_errors
+        for nc in r.new_nodeclaims:
+            assert cheapest not in {it.name
+                                    for it in nc.instance_type_options}
+
+    def test_host_fallback_sees_the_mask(self):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        reg = UnavailableOfferings(clock=FakeClock())
+        reg.mark(zone="test-zone-a")
+        ts = self._ts(reg)
+        pods = make_pods(6, required_affinity=[[NodeSelectorRequirement(
+            ZONE, "In", ("test-zone-a", "test-zone-b"))]])
+        r = ts._host_solve(pods, "forced for the test")
+        assert not r.pod_errors
+        assert r.new_nodeclaims
+        for nc in r.new_nodeclaims:
+            for it in nc.instance_type_options:
+                for o in it.offerings.available():
+                    assert o.zone != "test-zone-a"
+
+
+# --------------------------------------------------------------------------
+# wildcard-key masking in the DISRUPTION encode
+# --------------------------------------------------------------------------
+
+class TestDisruptionEncodeMask:
+    def test_snapshot_encode_masks_zone(self):
+        env = make_env()
+        nc, node = make_nodeclaim_and_node(env, zone="test-zone-b")
+        bind_pod(env, node, cpu="200m")
+        env.unavailable.mark(zone="test-zone-a")
+        snap = DisruptionSnapshot(env.cluster, env.provisioner)
+        method = SingleNodeConsolidation(env.cluster, env.provisioner)
+        candidates = get_candidates(env.cluster, env.provisioner,
+                                    method.should_disrupt)
+        assert candidates
+        enc = snap.encoding_for(candidates)
+        problem = enc.problem
+        zi = problem.vocab.value_idx[problem.zone_key]["test-zone-a"]
+        assert not np.any(problem.off_available & (problem.off_zone == zi))
+        zb = problem.vocab.value_idx[problem.zone_key]["test-zone-b"]
+        assert np.any(problem.off_available & (problem.off_zone == zb))
+
+    def test_replacement_never_targets_masked_type(self):
+        env = make_env()
+        big = most_expensive_instance(OD)
+        nc, node = make_nodeclaim_and_node(env, instance_type=big,
+                                           capacity_type=OD,
+                                           zone="test-zone-b")
+        bind_pod(env, node, cpu="200m", memory="128Mi")
+        method = SingleNodeConsolidation(env.cluster, env.provisioner)
+        candidates = get_candidates(env.cluster, env.provisioner,
+                                    method.should_disrupt)
+        cmd, _ = method.compute_command({"default": 10}, candidates)
+        assert cmd.decision == "replace", cmd.decision
+        cheapest_opt = cmd.replacements[0].instance_type_options[0].name
+
+        # mask the winning replacement type type-wide and re-plan: the new
+        # replacement must avoid it entirely
+        env.unavailable.mark(instance_type=cheapest_opt)
+        method2 = SingleNodeConsolidation(env.cluster, env.provisioner)
+        candidates2 = get_candidates(env.cluster, env.provisioner,
+                                     method2.should_disrupt)
+        cmd2, _ = method2.compute_command({"default": 10}, candidates2)
+        assert cmd2.decision == "replace", cmd2.decision
+        for repl in cmd2.replacements:
+            assert cheapest_opt not in {it.name
+                                        for it in repl.instance_type_options}
+
+
+# --------------------------------------------------------------------------
+# the lifecycle feedback path (ICE -> registry -> trigger; liveness)
+# --------------------------------------------------------------------------
+
+class TestLifecycleFeedback:
+    def test_ice_marks_registry_triggers_and_reroutes(self):
+        env = make_env()
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust(zone="test-zone-a")  # zone-wide, until cleared
+        pod = make_pod()
+        env.store.create(pod)
+        env.settle(rounds=6)
+        # exactly ONE create probed the dry zone; the registry now covers
+        # it zone-wide and the re-triggered pass landed in a survivor
+        assert sum(drought.hits.values()) == 1, dict(drought.hits)
+        assert env.unavailable.is_unavailable("m-4x-amd64-linux",
+                                              "test-zone-a", SPOT)
+        live = env.store.get(Pod, pod.name, "default")
+        assert live.spec.node_name, "pod never rescheduled after ICE"
+        node = env.store.get(Node, live.spec.node_name)
+        assert node.metadata.labels[ZONE] != "test-zone-a"
+        assert env.events("InsufficientCapacityError")
+        # no node ever materialized in the dry zone
+        assert all(n.metadata.labels.get(ZONE) != "test-zone-a"
+                   for n in env.nodes())
+
+    def test_ice_path_calls_the_provisioner_trigger(self):
+        """The satellite fix pinned directly: an ICE-deleted claim is
+        pre-registration (no Node), so NodeDeletionTrigger can never fire
+        — the lifecycle controller itself must re-trigger provisioning."""
+        from karpenter_tpu.controllers.nodeclaim_lifecycle import \
+            NodeClaimLifecycle
+        env = Env(provider=lambda s: FakeCloudProvider())
+        fired = []
+        lc = NodeClaimLifecycle(env.store, env.cluster, env.provider,
+                                env.clock, recorder=env.recorder,
+                                unavailable=env.unavailable,
+                                trigger=lambda: fired.append(1))
+        env.provider.next_create_err = InsufficientCapacityError(
+            "zone dry", offerings=(("*", "test-zone-1", "*"),))
+        nc = NodeClaim(
+            metadata=ObjectMeta(
+                name="doomed",
+                labels={api_labels.NODEPOOL_LABEL_KEY: "default"}),
+            spec=NodeClaimSpec())
+        env.store.create(nc)
+        lc.reconcile(env.store.get(NodeClaim, "doomed"))
+        assert fired == [1]
+        live = env.store.get(NodeClaim, "doomed")
+        # deleted (the termination finalizer may still be draining)
+        assert live is None or live.metadata.deletion_timestamp is not None
+        assert env.unavailable.live() == (("*", "test-zone-1", "*"),)
+
+    def test_ice_without_offering_keys_marks_nothing(self):
+        env = Env(provider=lambda s: FakeCloudProvider())
+        env.store.create(make_nodepool(name="default"))
+        env.provider.next_create_err = InsufficientCapacityError("legacy")
+        env.store.create(make_pod())
+        env.allow_reconcile_errors = True  # fake creates no Nodes: claims
+        for _ in range(3):                 # churn without quiescing
+            env.mgr.run_until_quiet()
+            env.clock.step(1.1)
+        assert len(env.unavailable) == 0
+
+    def test_liveness_deletion_publishes_event_and_metric(self):
+        env = Env(provider=lambda s: FakeCloudProvider())
+        base = NODECLAIMS_LIVENESS_TERMINATED.value({"nodepool": "default"})
+        nc = NodeClaim(
+            metadata=ObjectMeta(
+                name="stuck",
+                labels={api_labels.NODEPOOL_LABEL_KEY: "default"}),
+            spec=NodeClaimSpec())
+        env.store.create(nc)
+        env.mgr.run_until_quiet()
+        # launched (fake sets a provider id) but no Node ever appears
+        assert env.store.get(NodeClaim, "stuck") is not None
+        env.clock.step(REGISTRATION_TTL_SECONDS + 1.0)
+        env.settle()
+        assert env.store.get(NodeClaim, "stuck") is None
+        assert env.events("FailedRegistration"), \
+            [e.reason for e in env.recorder.events]
+        assert NODECLAIMS_LIVENESS_TERMINATED.value(
+            {"nodepool": "default"}) == base + 1
+
+
+# --------------------------------------------------------------------------
+# graceful exhaustion: every compatible offering masked
+# --------------------------------------------------------------------------
+
+class TestGracefulExhaustion:
+    def test_total_drought_warns_once_backs_off_and_recovers(self):
+        env = make_env()
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust()  # EVERYTHING dry
+        pod = make_pod()
+        env.store.create(pod)
+        env.settle(rounds=6)
+        # one probe, one wildcard registry entry, zero instances created
+        assert sum(drought.hits.values()) == 1, dict(drought.hits)
+        assert len(env.provider.created) == 0
+        live = env.store.get(Pod, pod.name, "default")
+        assert not live.spec.node_name
+        # ONE distinct warning, deduped across the backoff requeues
+        assert len(env.events("AllOfferingsUnavailable")) == 1
+        # more churn inside the TTL: no hot loop — no new create probes,
+        # no duplicate warning
+        env.settle(rounds=6)
+        assert sum(drought.hits.values()) == 1
+        assert len(env.provider.created) == 0
+        assert len(env.events("AllOfferingsUnavailable")) == 1
+
+        # capacity returns; the registry TTL lapses; the held provisioner
+        # re-solves and the pod lands — quiescence, no flapping
+        drought.clear()
+        env.clock.step(UNAVAILABLE_TTL_SECONDS + 1.0)
+        env.settle(rounds=6)
+        live = env.store.get(Pod, pod.name, "default")
+        assert live.spec.node_name, "pod never recovered after the drought"
+        assert len(env.unavailable) == 0
+        assert env.mgr.run_until_quiet()
+
+    def test_mixed_batch_only_drought_pods_warn(self):
+        """A pod failing for non-capacity reasons keeps the plain
+        FailedScheduling path; only the pod whose every compatible
+        offering is masked gets the distinct warning."""
+        env = make_env()
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust(zone="test-zone-a")
+        # pinned to the (about-to-be-)dry zone: after the ICE marks it,
+        # every offering this pod can use is masked
+        blocked = make_pod(name="drought-blocked",
+                           node_selector={ZONE: "test-zone-a"})
+        impossible = make_pod(name="impossible", cpu="100000")  # fits nothing
+        env.store.create(blocked)
+        env.store.create(impossible)
+        env.settle(rounds=6)
+        warned = {e.object_name
+                  for e in env.events("AllOfferingsUnavailable")}
+        assert warned == {"drought-blocked"}
+        failed = {e.object_name for e in env.events("FailedScheduling")}
+        assert "impossible" in failed
+
+    def test_untolerated_pool_pod_never_warns(self):
+        """Pool-level admission counts too (review finding): a pod no
+        nodepool admits (untolerated taint) is misconfigured, not
+        capacity-blocked, even when a wildcard drought masks everything."""
+        from karpenter_tpu.api.objects import Taint, Toleration
+        env = Env()
+        env.store.create(make_nodepool(
+            name="default",
+            taints=[Taint(key="team", value="x", effect="NoSchedule")]))
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust()  # everything dry
+        tolerant = make_pod(name="capacity-blocked", tolerations=[
+            Toleration(key="team", operator="Equal", value="x",
+                       effect="NoSchedule")])
+        excluded = make_pod(name="never-admitted")
+        env.store.create(tolerant)
+        env.store.create(excluded)
+        env.settle(rounds=6)
+        warned = {e.object_name
+                  for e in env.events("AllOfferingsUnavailable")}
+        assert warned == {"capacity-blocked"}
+        failed = {e.object_name for e in env.events("FailedScheduling")}
+        assert "never-admitted" in failed
+
+    def test_unfittable_pod_never_warns_even_under_total_drought(self):
+        """A wildcard drought masks every offering — but a pod that fits
+        NO instance type is unschedulable, not capacity-blocked, and must
+        not be misreported to operators chasing capacity."""
+        env = make_env()
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust()  # everything dry
+        blocked = make_pod(name="capacity-blocked")
+        impossible = make_pod(name="never-fits", cpu="100000")
+        env.store.create(blocked)
+        env.store.create(impossible)
+        env.settle(rounds=6)
+        warned = {e.object_name
+                  for e in env.events("AllOfferingsUnavailable")}
+        assert warned == {"capacity-blocked"}
+        failed = {e.object_name for e in env.events("FailedScheduling")}
+        assert "never-fits" in failed
+
+
+# --------------------------------------------------------------------------
+# the seeded drought soak (acceptance)
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestDroughtSoak:
+    """Zone-wide exhaustion -> reroute within one pass -> TTL expiry ->
+    recovery -> quiescence, with zero creates against the cached-dry zone
+    while its TTL lives."""
+
+    DROUGHT_SECONDS = 240.0
+
+    def _env(self):
+        env = make_env()
+        drought = CapacityDrought(clock=env.clock)
+        env.provider.drought = drought
+        drought.exhaust(zone="test-zone-a", duration=self.DROUGHT_SECONDS)
+        return env, drought
+
+    def _workload(self, n_generic=6, n_zonal=8, tag="w1"):
+        """Generic pods (provider routes them) + zone-affinity pods
+        admitting the dry zone and one survivor (the SOLVER must route
+        them) — both reroutable shapes of the acceptance criterion. Hard
+        DoNotSchedule spread over all zones is deliberately absent: the
+        dry zone stays in its domain universe (reference semantics), so
+        those pods waterline rather than reroute."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        return (make_pods(n_generic, cpu="500m", memory="256Mi",
+                          labels={"role": tag})
+                + make_pods(n_zonal, cpu="250m", memory="128Mi",
+                            labels={"app": tag},
+                            required_affinity=[[NodeSelectorRequirement(
+                                ZONE, "In",
+                                ("test-zone-a", "test-zone-b"))]]))
+
+    def test_drought_soak_converges_and_recovers(self):
+        env, drought = self._env()
+        for p in self._workload():
+            env.store.create(p)
+        env.settle(rounds=8)
+
+        # phase 1: exactly one create probed zone-a; everything else was
+        # routed by the registry — every pod bound, every node in a
+        # surviving zone, no repeat probe against the cached-dry zone
+        assert sum(drought.hits.values()) == 1, dict(drought.hits)
+        zones = {n.metadata.labels.get(ZONE) for n in env.nodes()
+                 if n.metadata.deletion_timestamp is None}
+        assert zones and "test-zone-a" not in zones, zones
+        for p in env.store.list(Pod):
+            assert p.spec.node_name, f"pod {p.name} unbound mid-drought"
+        assert ("*", "test-zone-a", "*") in env.unavailable.live()
+
+        # phase 2: a second wave INSIDE the TTL window rides the cache —
+        # still zero new probes against zone-a
+        for p in self._workload(n_generic=4, n_zonal=4, tag="w2"):
+            env.store.create(p)
+        env.settle(rounds=8)
+        assert sum(drought.hits.values()) == 1, dict(drought.hits)
+        zones = {n.metadata.labels.get(ZONE) for n in env.nodes()
+                 if n.metadata.deletion_timestamp is None}
+        assert "test-zone-a" not in zones
+
+        # phase 3: the drought lapses and the TTL expires; fresh demand
+        # that existing free capacity cannot absorb (7-cpu pods vs the
+        # small phase-1/2 nodes) forces new launches, which land in the
+        # recovered zone (kwok's cheapest offering is zone-a spot) and the
+        # system quiesces — no flapping, no stale registry entries
+        env.clock.step(max(self.DROUGHT_SECONDS,
+                           UNAVAILABLE_TTL_SECONDS) + 30.0)
+        env.settle(rounds=4)
+        assert len(env.unavailable) == 0
+        for p in make_pods(3, cpu="7", memory="8Gi", labels={"role": "w3"}):
+            env.store.create(p)
+        env.settle(rounds=8)
+        live_nodes = {n.name for n in env.nodes()
+                      if n.metadata.deletion_timestamp is None}
+        for p in env.store.list(Pod):
+            assert p.spec.node_name in live_nodes, f"pod {p.name} lost"
+        zones = {n.metadata.labels.get(ZONE) for n in env.nodes()
+                 if n.metadata.deletion_timestamp is None}
+        assert "test-zone-a" in zones, \
+            f"recovered zone never reused: {zones}"
+        assert sum(drought.hits.values()) == 1  # the window is over
+        assert env.mgr.run_until_quiet()
+
+    def test_soak_is_deterministic(self):
+        def run():
+            env, drought = self._env()
+            for p in self._workload():
+                env.store.create(p)
+            env.settle(rounds=8)
+            env.clock.step(self.DROUGHT_SECONDS + 200.0)
+            env.settle(rounds=6)
+            return (dict(drought.hits), tuple(env.unavailable.live()),
+                    sorted((n.metadata.labels.get(ZONE) or "")
+                           for n in env.nodes()
+                           if n.metadata.deletion_timestamp is None),
+                    sorted(bool(p.spec.node_name)
+                           for p in env.store.list(Pod)))
+
+        assert run() == run()
