@@ -39,8 +39,15 @@ N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
 N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 # provisioning|consolidation|single|spot|mesh|mesh-local|mesh-headroom|
-# sidecar|minvalues|faults|replay|drought|all
+# sidecar|minvalues|faults|replay|drought|churn|all
 MODE = os.environ.get("BENCH_MODE", "all")
+# BENCH_MODE=churn knobs: windows in the timed stream, pod arrivals per
+# window, bound pods per warm node, minimum sustained arrival rate the
+# line must hold (pods/sec over summed time-to-decision)
+CHURN_WINDOWS = int(os.environ.get("BENCH_CHURN_WINDOWS", "20"))
+CHURN_ARRIVALS = int(os.environ.get("BENCH_CHURN_ARRIVALS", "600"))
+CHURN_PODS_PER_NODE = int(os.environ.get("BENCH_CHURN_PODS_PER_NODE", "10"))
+CHURN_MIN_RATE = float(os.environ.get("BENCH_CHURN_MIN_RATE", "1000"))
 # minValues benchmark line (the reference benchmarks minValues explicitly,
 # scheduling_benchmark_test.go:97-101): opt-in via BENCH_MINVALUES=1 in the
 # default run, or BENCH_MODE=minvalues alone; requirement floor knob below
@@ -392,6 +399,233 @@ def bench_drought():
         "seconds": round(best_masked, 3),
         "unmasked_seconds": round(best_plain, 3),
         "overhead_pct": round((best_masked / best_plain - 1) * 100, 2),
+    }), flush=True)
+
+
+def bench_churn():
+    """ISSUE 6 acceptance line (BENCH_MODE=churn): steady-state delta
+    solving on the batcher loop. A warm cluster — N_NODES initialized
+    nodes carrying CHURN_PODS_PER_NODE bound pods each (50k scheduled pods
+    at defaults) against the 2k-type catalog — absorbs a sustained stream
+    of pod arrivals: every window, CHURN_ARRIVALS fresh pods from a
+    rotating set of deployment shapes (plain / zonal spread / hostname
+    spread) join a standing unschedulable backlog and are solved through
+    the provisioner's persistent ProblemState. Every few windows a slice
+    of nodes churns (a bound pod completes), dirtying exactly those node
+    rows. Pins the tentpole's three claims:
+
+    (1) THROUGHPUT — the delta path sustains >= CHURN_MIN_RATE pod
+        arrivals/sec over the summed batcher-loop time-to-decision, with
+        p50/p99 per-window latency reported;
+    (2) DELTA RESIDENCY — after the untimed warmup pass every window
+        encodes as `delta` on the pure tensor path (no fallback, no
+        partition), node-churn windows re-encode ONLY the dirty rows, and
+        steady windows re-encode none and warm-restore the backlog prefix;
+    (3) PARITY — sampled windows re-solve the identical batch + cluster
+        state from a cold ProblemState-free scheduler and the decisions
+        (claims, existing-node placements, errors) are bit-identical."""
+    from karpenter_tpu.api import labels as api_labels
+    from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED, COND_LAUNCHED,
+                                             COND_REGISTERED, NodeClaim,
+                                             NodeClaimSpec)
+    from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                           ObjectMeta, PodSpec,
+                                           TopologySpreadConstraint)
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.provisioning.provisioner import Provisioner
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    n_its = N_ITS or 2000
+    catalog = _catalog(n_its)
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(instance_types=catalog, store=store)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    store.create(NodePool(metadata=ObjectMeta(name="default"),
+                          spec=NodePoolSpec(template=NodeClaimTemplate(
+                              spec=NodeClaimTemplateSpec()))))
+    big = next(it for it in catalog
+               if it.capacity.get("cpu") == 4000 and "amd64-linux" in it.name)
+    # warm cluster: initialized nodes, each with bound (scheduled) pods
+    bound_by_node = {}
+    for i in range(N_NODES):
+        name = f"churn-node-{i:05d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: big.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: f"test-zone-{'abc'[i % 3]}",
+            api_labels.CAPACITY_TYPE_LABEL_KEY: api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"churn-nc-{i:05d}",
+                                           namespace="", labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"churn://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"churn://{i}"),
+            status=NodeStatus(capacity=dict(big.capacity),
+                              allocatable=big.allocatable())))
+        pods_here = []
+        for j in range(CHURN_PODS_PER_NODE):
+            p = Pod(metadata=ObjectMeta(name=f"warm-{i}-{j}",
+                                        namespace="default",
+                                        labels={"warm": f"w{i % 40}"}),
+                    spec=PodSpec(node_name=name),
+                    container_requests=[res.parse_list(
+                        {"cpu": "200m", "memory": "128Mi"})])
+            store.create(p)
+            pods_here.append(p)
+        bound_by_node[name] = pods_here
+
+    # standing unschedulable backlog: pending pods no instance type can
+    # host. Their huge requests sort them FIRST in the packer's FFD order,
+    # so every steady window warm-restores this prefix from the seed.
+    backlog = []
+    for d in range(16):
+        for j in range(4):
+            backlog.append(Pod(
+                metadata=ObjectMeta(name=f"backlog-{d}-{j}",
+                                    namespace="default",
+                                    labels={"app": f"backlog-{d}"}),
+                container_requests=[res.parse_list(
+                    {"cpu": "300", "memory": "2000Gi"})]))
+
+    def arrivals(window: int) -> list:
+        """CHURN_ARRIVALS fresh pods from 12 of 24 rotating deployment
+        shapes: plain, zonal topology spread, hostname topology spread."""
+        out = []
+        n_deploys = 12
+        per = max(1, CHURN_ARRIVALS // n_deploys)
+        for k in range(n_deploys):
+            d = (window + k) % 24
+            labels = {"app": f"churn-{d}"}
+            sel = LabelSelector(match_labels=dict(labels))
+            spread = []
+            if d % 3 == 1:
+                spread = [TopologySpreadConstraint(
+                    topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+                    label_selector=sel)]
+            elif d % 3 == 2:
+                spread = [TopologySpreadConstraint(
+                    topology_key=api_labels.LABEL_HOSTNAME, max_skew=1,
+                    label_selector=sel)]
+            requests = res.parse_list({"cpu": _CPUS[d % 5],
+                                       "memory": _MEMS[d % 5]})
+            for j in range(per):
+                out.append(Pod(
+                    metadata=ObjectMeta(name=f"arr-{window}-{d}-{j}",
+                                        namespace="default",
+                                        labels=dict(labels)),
+                    spec=PodSpec(topology_spread_constraints=list(spread)),
+                    container_requests=[requests]))
+        return out
+
+    def digest(r):
+        return (sorted(
+            (nc.template.nodepool_name,
+             tuple(sorted(nc.requirements.get(
+                 api_labels.LABEL_TOPOLOGY_ZONE).values)),
+             tuple(it.name for it in nc.instance_type_options),
+             len(nc.pods)) for nc in r.new_nodeclaims),
+            sorted((en.name, len(en.pods))
+                   for en in r.existing_nodes if en.pods),
+            dict(r.pod_errors))
+
+    ps = provisioner.problem_state
+
+    def solve(batch, cold=False):
+        if cold:
+            saved = provisioner.problem_state
+            provisioner.problem_state = None
+            try:
+                return provisioner.schedule(batch)
+            finally:
+                provisioner.problem_state = saved
+        return provisioner.schedule(batch)
+
+    # untimed warmup pass: jit compile at the padded shape buckets, the
+    # first (cold) node-row encode and topology scans
+    solve(backlog + arrivals(0))
+    assert provisioner.last_scheduler.fallback_reason == ""
+
+    times = []
+    churned_total = 0
+    n_arrivals_total = 0
+    for w in range(1, CHURN_WINDOWS + 1):
+        churn_nodes = 0
+        if w % 4 == 0:
+            # node churn: a bound pod completes on a slice of nodes — only
+            # these rows may re-encode in the next delta solve
+            churn_nodes = min(8, N_NODES)
+            for i in range(churn_nodes):
+                name = f"churn-node-{(w * 131 + i * 977) % N_NODES:05d}"
+                pods_here = bound_by_node[name]
+                if pods_here:
+                    store.delete(pods_here.pop())
+            churned_total += churn_nodes
+        batch = backlog + arrivals(w)
+        n_arrivals_total += len(batch) - len(backlog)
+        t0 = time.perf_counter()
+        r = solve(batch)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        ts = provisioner.last_scheduler
+        assert ts.fallback_reason == "", ts.fallback_reason
+        assert ts.partition == (len(batch), 0), ts.partition
+        assert ts.encode_kind == "delta", \
+            f"window {w} fell back to a cold encode"
+        if churn_nodes:
+            # dirty-row re-encode: only the churned nodes' rows rebuilt
+            assert 0 < ps.last["node_rows_reencoded"] <= churn_nodes, \
+                ps.last
+        else:
+            assert ps.last["node_rows_reencoded"] == 0, ps.last
+            # the standing backlog leads the FFD order: steady windows
+            # restore its packed prefix from the previous pass's seed
+            assert ps.last["warm_restored"] > 0, ps.last
+        if w % 5 == 0:
+            r_cold = solve(batch, cold=True)
+            assert digest(r) == digest(r_cold), \
+                f"window {w}: delta solve diverged from cold solve"
+
+    import numpy as _np
+    total = sum(times)
+    rate = n_arrivals_total / total
+    p50 = float(_np.percentile(times, 50))
+    p99 = float(_np.percentile(times, 99))
+    assert rate >= CHURN_MIN_RATE, (
+        f"sustained {rate:.0f} arrivals/sec < {CHURN_MIN_RATE:.0f} floor "
+        f"(p50 {p50 * 1000:.0f}ms p99 {p99 * 1000:.0f}ms)")
+    print(json.dumps({
+        "metric": (f"steady-state churn: sustained pod arrivals/sec over "
+                   f"{CHURN_WINDOWS} batcher windows against a warm "
+                   f"{N_NODES * CHURN_PODS_PER_NODE}-pod / {N_NODES}-node "
+                   f"cluster x {n_its} instance types (persistent "
+                   "ProblemState delta solves; decisions bit-identical to "
+                   "cold; node churn re-encodes dirty rows only)"),
+        "value": round(rate, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(rate / 100.0, 2),
+        "seconds": round(total, 3),
+        "p50_ms": round(p50 * 1000, 1),
+        "p99_ms": round(p99 * 1000, 1),
+        "windows": CHURN_WINDOWS,
+        "arrivals_per_window": CHURN_ARRIVALS,
+        "nodes_churned": churned_total,
+        "warm_restored_groups": ps.stats["warm_restored_groups"],
+        "delta_encodes": ps.stats["delta_encodes"],
     }), flush=True)
 
 
@@ -1158,11 +1392,14 @@ def main():
     if MODE == "drought":
         bench_drought()
         return
+    if MODE == "churn":
+        bench_churn()
+        return
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
-            "mesh-headroom|sidecar|minvalues|faults|replay|drought")
+            "mesh-headroom|sidecar|minvalues|faults|replay|drought|churn")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

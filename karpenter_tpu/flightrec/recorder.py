@@ -172,6 +172,10 @@ class FlightRecorder:
                 "nodepools": [np_.name for np_ in ts.nodepools],
                 "circuit": ts.circuit.state,
                 "fallback_reason": ts.fallback_reason,
+                # cold vs delta problem encode (ProblemState): replay always
+                # re-encodes cold, so a byte-identical replay verdict on a
+                # delta-kind record is the delta path's determinism proof
+                "encode_kind": getattr(ts, "encode_kind", "cold"),
                 "partition": list(ts.partition),
                 "claims": len(results.new_nodeclaims),
                 "existing": sum(1 for en in results.existing_nodes

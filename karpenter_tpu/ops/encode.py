@@ -258,6 +258,29 @@ def stack_encoded(items: Sequence[EncodedRequirements]) -> EncodedRequirements:
         lt=np.stack([e.lt for e in items]))
 
 
+def pad_stacked(e: EncodedRequirements, total: int,
+                zero: EncodedRequirements) -> EncodedRequirements:
+    """Pad a stacked [B, ...] batch along axis 0 to ``total`` rows with
+    copies of ``zero`` (an empty-Requirements row: defined nowhere, so a
+    padded row never fails a compatibility check and never packs). The
+    row-sliced delta encode uses this to keep the group/node batch axes on
+    pow2 shape buckets so the compiled-executable cache keeps hitting."""
+    n = e.mask.shape[0]
+    if total <= n:
+        return e
+
+    def rep(name: str) -> np.ndarray:
+        a = getattr(e, name)
+        z = getattr(zero, name)
+        return np.concatenate(
+            [a, np.broadcast_to(z, (total - n,) + z.shape).copy()])
+
+    return EncodedRequirements(
+        mask=rep("mask"), defined=rep("defined"),
+        complement=rep("complement"), exempt=rep("exempt"),
+        gt=rep("gt"), lt=rep("lt"))
+
+
 def pack_bits(a: np.ndarray) -> np.ndarray:
     """Little-endian bitpack of a bool array along its LAST axis:
     [..., Z] bool -> [..., ceil(Z/8)] uint8 with bit i of word w standing

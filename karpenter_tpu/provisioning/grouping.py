@@ -104,6 +104,34 @@ def _req_signature(reqs: Requirements):
         for k in reqs))
 
 
+def group_signature(g: PodGroup) -> tuple:
+    """Content-stable identity of a tensor group ACROSS solves — unlike
+    partition_pods' per-call signature (whose tokens are call-local ints),
+    this hashes actual content, so the persistent ProblemState can match
+    "the same deployment arrived again" between reconcile passes. Two
+    groups with equal signatures encode to identical tensor rows and make
+    identical packer decisions at equal counts; everything the packer or
+    the topology counter reads off a group rides in here (requirements,
+    requests, tolerations, labels, topo specs incl. selectors, ports, the
+    probe's namespace + raw affinity/selector shape for the spread node
+    filter)."""
+    probe = g.pods[0]
+    return (
+        _req_signature(g.requirements),
+        tuple(sorted(g.requests.items())),
+        tuple(g.tolerations),
+        tuple(sorted(g.labels.items())),
+        tuple((s.kind, s.max_skew, s.schedule_anyway, s.min_domains,
+               s.self_select, s.selector) for s in g.topo),
+        tuple(g.host_ports),
+        g.has_relaxable,
+        probe.namespace,
+        tuple(sorted(probe.spec.node_selector.items())),
+        _affinity_key(probe),
+        () if not probe.spec.volumes else tuple(probe.spec.volumes),
+    )
+
+
 def _port_triples(pod: Pod) -> tuple:
     """Canonical (ip, port, protocol) triples (hostportusage.go entry shape;
     an unset hostIP binds the wildcard)."""

@@ -1,0 +1,367 @@
+"""Persistent ProblemState: the incremental delta solver's cross-pass memory.
+
+Every reconcile pass used to rebuild the whole solve input from scratch:
+re-encode 5k state-node label sets, re-scan 50k scheduled cluster pods per
+topology selector, re-encode every pod group, re-upload the node tensors,
+and re-pack every group — even when the pass differed from the previous one
+by a handful of pod arrivals. ProblemState lives across passes (owned by the
+Provisioner, handed to each per-solve TensorScheduler) and turns the solve
+into a delta application:
+
+- **node rows** — per-node encoded requirement rows / available vectors /
+  zone indices / taint views, keyed by ``(name, StateNode.revision)``
+  (state/cluster.py bumps the revision on every mutation an encode can
+  observe). Only dirty rows re-encode; the pow2-padded stacked tensors and
+  their device upload (PackProblem.exist_token) are reused byte-identical
+  while the node set is unchanged.
+- **group rows** — encoded requirement rows + request vectors keyed by the
+  content-stable ``grouping.group_signature``, so "the same deployment
+  arrived again" never re-encodes.
+- **topology counts** — per-group cluster topology occupancy
+  (izc/exist_counts/host_total) memoized against ``Cluster.topo_revision``:
+  while no scheduled pod binding or node changed, the 50k-pod selector
+  scans are skipped entirely.
+- **warm-started packing** — after each pack the packer's state is
+  checkpointed along the FFD group order (ops/binpack.py PackSeed); the
+  next solve restores the longest clean prefix (groups whose signature,
+  count, and topology rows are unchanged under an unchanged global input
+  token) and re-packs only from there. Decisions are bit-identical to a
+  cold solve by construction: the packer is sequentially deterministic, so
+  equal inputs up to position P imply byte-equal state at P.
+
+Invalidation matrix — every delta a pass can carry, and what it costs:
+
+| delta                                   | effect                         |
+|-----------------------------------------|--------------------------------|
+| pod arrival/completion (known group)    | group count changes: cached    |
+|                                         | rows reused, warm prefix up to |
+|                                         | the first dirty FFD position   |
+| new deployment shape (new signature)    | one group row encoded; warm    |
+|                                         | prefix cut at its FFD position |
+| new vocab entry (label/value/resource)  | FULL re-encode (cold): masks   |
+|                                         | enumerate the value universe   |
+| catalog change                          | cold (new catalog encoding)    |
+| node add/remove/update                  | dirty node rows re-encode;     |
+|                                         | exist tensors restack +        |
+|                                         | re-upload; warm pack disabled  |
+|                                         | for the pass (exist_avail is   |
+|                                         | shared mutable packer state)   |
+| scheduled-pod/binding change            | topology counts recompute      |
+|                                         | (per-group, memoized again     |
+|                                         | after one pass)                |
+| unavailable-offerings version bump      | drought mask arrays rebuilt    |
+|                                         | (already per-solve); warm pack |
+|                                         | invalidated via the pattern    |
+|                                         | set in the global token        |
+| daemonset set change                    | node rows cleared (overhead    |
+|                                         | rides in the avail vectors)    |
+| hostports / volumes / minValues floors  | warm pack disabled             |
+|                                         | (binpack._warm_usable);        |
+|                                         | delta encode still applies     |
+| topology/affinity coupling              | grouping demotes to the host   |
+|                                         | path exactly as a cold solve   |
+|                                         | would (partition_pods runs     |
+|                                         | per pass)                      |
+
+Anything the matrix cannot express falls back to a cold encode/pack; the
+fallback is always decision-equivalent, never semantic. The churn fuzzer
+(tests/test_problem_state.py) interleaves arrivals/deletions/node churn/
+drought marks and asserts delta == cold at every step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import labels as api_labels
+from ..ops import binpack
+from ..ops import encode as enc
+from ..scheduling.requirements import Requirements, label_requirements
+from ..utils import resources as res
+from .grouping import group_signature
+
+# _pow2_bucket is THE shape-bucketing policy — shared with the cold path
+# (build_problem) so the delta-built stacks stay byte-identical to it
+from .tensor_scheduler import _pow2_bucket  # noqa: E402
+
+# bound on signature-keyed caches: distinct deployment shapes seen across
+# the state's lifetime. Past it the cache clears wholesale (simple + rare:
+# a production cluster cycles far fewer shapes than this).
+MAX_SIG_ENTRIES = 4096
+
+
+class ProblemState:
+    """Cross-pass solver state. NOT thread-safe: owned by the single-threaded
+    provisioner loop (or a bench/fuzzer driver); per-solve TensorSchedulers
+    borrow it one at a time."""
+
+    def __init__(self):
+        # vocab identity gates every cached row: complement-encoded masks
+        # enumerate the value universe, so rows are only valid against the
+        # exact vocabulary object they were encoded with. Strong refs keep
+        # ids from being recycled.
+        self._last_vocab = None
+        # node rows: (name, identity) ->
+        #   ((identity, revision), enc_row, avail_vec, zone_idx, taints)
+        self._node_vocab = None
+        self._node_ds_token = None
+        self._node_rows: Dict[tuple, tuple] = {}
+        self._node_stack_token = None
+        self._node_stack = None
+        # group rows: signature -> (enc_row, req_vec), per vocab
+        self._group_vocab = None
+        self._group_rows: Dict[tuple, tuple] = {}
+        # topology counts: signature -> (izc_row, exist_row, host_total)
+        self._topo_token = None
+        self._topo_memo: Dict[tuple, tuple] = {}
+        # warm-start seed from the previous pack
+        self.seed: Optional[binpack.PackSeed] = None
+        # cumulative
+        self.stats = {
+            "solves": 0, "cold_encodes": 0, "delta_encodes": 0,
+            "node_rows_reencoded": 0, "group_rows_encoded": 0,
+            "topo_groups_counted": 0, "warm_restored_groups": 0,
+        }
+        # per-solve (begin_solve resets; initialized here so a direct
+        # build_problem call outside a solve can't hit missing keys)
+        self._sig_memo: Dict[int, tuple] = {}
+        self.last: dict = {}
+        self.begin_solve()
+        self.stats["solves"] = 0
+
+    # -- per-solve lifecycle -------------------------------------------------
+
+    def begin_solve(self) -> None:
+        self._sig_memo = {}
+        self.last = {"encode_kind": "cold", "node_rows_reencoded": 0,
+                     "group_rows_encoded": 0, "topo_groups_counted": 0,
+                     "warm": "none", "warm_restored": 0, "warm_matched": 0}
+        self.stats["solves"] += 1
+
+    def note_encode(self, vocab) -> str:
+        """cold vs delta for this solve: delta iff the catalog encoding
+        (and with it the whole vocabulary) is the one the previous pass
+        used — the condition under which every cached row stays exact."""
+        kind = "delta" if self._last_vocab is vocab else "cold"
+        self._last_vocab = vocab
+        self.last["encode_kind"] = kind
+        self.stats["delta_encodes" if kind == "delta"
+                   else "cold_encodes"] += 1
+        return kind
+
+    def sig(self, g) -> tuple:
+        s = self._sig_memo.get(id(g))
+        if s is None:
+            s = group_signature(g)
+            self._sig_memo[id(g)] = s
+        return s
+
+    # -- node rows -----------------------------------------------------------
+
+    @staticmethod
+    def _daemon_token(daemonset_pods) -> tuple:
+        return tuple(sorted(
+            (p.uid, tuple(sorted(p.requests().items())))
+            for p in daemonset_pods))
+
+    def node_rows(self, vocab, zone_key: int, state_nodes, daemonset_pods
+                  ) -> tuple:
+        """(exist_enc, exist_avail, exist_zone, taint_lists, exist_token)
+        with the node axis pow2-padded — byte-identical to what
+        build_problem's cold path constructs, with only dirty rows
+        re-encoded. taint_lists covers the REAL nodes only."""
+        from .tensor_scheduler import _node_remaining_daemons
+        ds_token = self._daemon_token(daemonset_pods)
+        if self._node_vocab is not vocab or self._node_ds_token != ds_token:
+            self._node_rows = {}
+            self._node_vocab = vocab
+            self._node_ds_token = ds_token
+            self._node_stack_token = None
+            self._node_stack = None
+        rows = self._node_rows
+        reencoded = 0
+        fresh: Dict[tuple, tuple] = {}
+        keys = []
+        for sn in state_nodes:
+            # cache key (name, identity); row-validity token (identity,
+            # revision). The identity distinguishes both a deleted-and-
+            # recreated node under the same name (whose replayed event
+            # sequence can land on the same revision count) and two live
+            # StateNodes sharing a name (placeholder + claim entries) —
+            # name alone would alias their rows in the stacked tensors.
+            key = (sn.name(), getattr(sn, "identity", None))
+            keys.append(key)
+            rev = (key[1], getattr(sn, "revision", None))
+            row = rows.get(key)
+            if row is None or rev[0] is None or rev[1] is None \
+                    or row[0] != rev:
+                reqs = label_requirements(sn.labels())
+                known = Requirements(
+                    r for r in reqs.values()
+                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
+                    in vocab.key_idx)
+                avail = res.subtract(
+                    sn.available(),
+                    _node_remaining_daemons(sn, daemonset_pods))
+                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
+                row = (rev,
+                       enc.encode_requirements(vocab, known),
+                       enc.encode_resource_vector(vocab, avail,
+                                                  capacity=True),
+                       vocab.value_idx[zone_key].get(z, -1),
+                       sn.taints())
+                reencoded += 1
+            fresh[key] = row
+        self._node_rows = fresh
+        self.last["node_rows_reencoded"] = reencoded
+        self.stats["node_rows_reencoded"] += reencoded
+        exist_token = (vocab, ds_token,
+                       tuple((k, getattr(sn, "revision", None))
+                             for k, sn in zip(keys, state_nodes)))
+        if self._node_stack_token == exist_token:
+            return self._node_stack + (exist_token,)
+        N = len(state_nodes)
+        Np = _pow2_bucket(N, 16)
+        encs = [fresh[k][1] for k in keys]
+        taint_lists = [fresh[k][4] for k in keys]
+        if Np > N:
+            zero = enc.encode_requirements(vocab, Requirements())
+            encs = encs + [zero] * (Np - N)
+        exist_enc = enc.stack_encoded(encs)
+        avail = np.stack([fresh[k][2] for k in keys])
+        exist_avail = np.concatenate(
+            [avail, np.zeros((Np - N,) + avail.shape[1:], avail.dtype)]) \
+            if Np > N else avail
+        zones = np.array([fresh[k][3] for k in keys], dtype=np.int32)
+        exist_zone = np.concatenate([zones, np.full(Np - N, -1, np.int32)]) \
+            if Np > N else zones
+        self._node_stack = (exist_enc, exist_avail, exist_zone, taint_lists)
+        self._node_stack_token = exist_token
+        return exist_enc, exist_avail, exist_zone, taint_lists, exist_token
+
+    # -- group rows ----------------------------------------------------------
+
+    def group_row(self, vocab, g) -> tuple:
+        """(enc_row, req_vec) for one group, signature-cached per vocab."""
+        if self._group_vocab is not vocab:
+            self._group_rows = {}
+            self._group_vocab = vocab
+        sig = self.sig(g)
+        row = self._group_rows.get(sig)
+        if row is None:
+            if len(self._group_rows) >= MAX_SIG_ENTRIES:
+                self._group_rows = {}
+            row = (enc.encode_requirements(vocab, g.requirements),
+                   enc.encode_resource_vector(vocab, g.requests,
+                                              capacity=False))
+            self._group_rows[sig] = row
+            self.last["group_rows_encoded"] += 1
+            self.stats["group_rows_encoded"] += 1
+        return row
+
+    # -- topology counts -----------------------------------------------------
+
+    def topology_counts(self, ts, groups, zone_names, pods):
+        """cluster_topology_counts with a per-group memo proven by
+        Cluster.topo_revision: the scheduled-pod selector scans run only
+        for groups whose counts the revision can no longer vouch for."""
+        excl = {p.uid for p in pods}
+        cl = getattr(ts.cluster, "cluster", None)
+        rev = getattr(cl, "topo_revision", None)
+        if rev is None:
+            return ts.cluster_topology_counts(groups, zone_names, excl)
+        # the memo excludes scheduled batch pods by identity (deleting-node
+        # pods are both scheduled and in the batch), so the token carries
+        # them; pending pods never count either way
+        sched_excl = frozenset(p.uid for p in pods if p.spec.node_name)
+        token = (rev, tuple(zone_names),
+                 tuple(sn.name() for sn in ts.state_nodes), sched_excl)
+        if token != self._topo_token:
+            self._topo_memo = {}
+            self._topo_token = token
+        sigs = [self.sig(g) for g in groups]
+        miss = [i for i, s in enumerate(sigs) if s not in self._topo_memo]
+        if miss:
+            if len(self._topo_memo) + len(miss) > MAX_SIG_ENTRIES:
+                # overflow wipes the memo, so EVERY group of this solve
+                # must recompute — recomputing only the misses would leave
+                # the wiped hit entries dangling for the assembly below
+                self._topo_memo = {}
+                miss = list(range(len(groups)))
+            sub_izc, sub_exist, sub_host = ts.cluster_topology_counts(
+                [groups[i] for i in miss], zone_names, excl)
+            for j, i in enumerate(miss):
+                self._topo_memo[sigs[i]] = (sub_izc[j], sub_exist[j],
+                                            int(sub_host[j]))
+            self.last["topo_groups_counted"] += len(miss)
+            self.stats["topo_groups_counted"] += len(miss)
+        G = len(groups)
+        Z = len(zone_names)
+        N = max(1, len(ts.state_nodes))
+        izc = np.zeros((G, Z), dtype=np.int64)
+        exist_counts = np.zeros((G, N), dtype=np.int64)
+        host_total = np.zeros(G, dtype=np.int64)
+        for i, s in enumerate(sigs):
+            row = self._topo_memo[s]
+            izc[i] = row[0]
+            exist_counts[i] = row[1]
+            host_total[i] = row[2]
+        return izc, exist_counts, host_total
+
+    # -- warm-started packing ------------------------------------------------
+
+    def _templates_token(self, templates) -> tuple:
+        from .tensor_scheduler import _reqs_digest
+        return tuple(
+            (nct.nodepool_name, _reqs_digest(nct.requirements),
+             tuple(nct.taints), tuple(nct.startup_taints),
+             tuple(it.name for it in nct.instance_type_options))
+            for nct in templates)
+
+    def warm_start(self, ts, vocab, groups, templates, limits,
+                   izc, exist_counts, host_total, exist_token
+                   ) -> Optional[binpack.WarmStart]:
+        """Build the per-solve WarmStart context, or None when the solve
+        shape can't warm-start (explicit initial_zone_counts injection)."""
+        if ts.initial_zone_counts is not None:
+            self.last["warm"] = "disabled:initial_zone_counts"
+            return None
+        global_token = (
+            vocab,                      # identity: the whole encoding
+            tuple(ts.drought_patterns),
+            exist_token,
+            # daemonset overhead shapes daemon_overhead/ppn even with ZERO
+            # existing nodes (exist_token None), so it must ride the token
+            # on its own, not only inside exist_token
+            self._daemon_token(ts.daemonset_pods),
+            self._templates_token(templates),
+            tuple(None if lm is None else tuple(sorted(lm.items()))
+                  for lm in limits),
+        )
+        tokens: List[tuple] = []
+        for i, g in enumerate(groups):
+            tokens.append((
+                self.sig(g), len(g.pods), izc[i].tobytes(),
+                None if exist_counts is None else exist_counts[i].tobytes(),
+                None if host_total is None else int(host_total[i])))
+        return binpack.WarmStart(global_token=global_token, tokens=tokens,
+                                 seed=self.seed)
+
+    def finish_pack(self, warm: Optional[binpack.WarmStart]) -> None:
+        if warm is None:
+            return
+        if warm.result_seed is not None:
+            self.seed = warm.result_seed
+            self.last["warm"] = (f"prefix:{warm.restored_pos}"
+                                 if warm.restored_pos else "recorded")
+            self.last["warm_restored"] = warm.restored_pos
+            self.last["warm_matched"] = warm.matched
+            self.stats["warm_restored_groups"] += warm.restored_pos
+        else:
+            # the packer declined (ports/volumes/minValues): conservative
+            # full pack, and the stale seed must not survive — its
+            # checkpoints no longer describe the latest decisions
+            self.seed = None
+            self.last["warm"] = "disabled:inexpressible"

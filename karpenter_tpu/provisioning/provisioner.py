@@ -153,9 +153,16 @@ class Provisioner(SingletonController):
     def __init__(self, store: Store, cluster: Cluster, cloud_provider,
                  clock: Optional[Clock] = None, batcher: Optional[Batcher] = None,
                  scheduler_factory=None, recorder=None, flight_recorder=None,
-                 unavailable=None):
+                 unavailable=None, problem_state=None):
         from ..events.recorder import Recorder
+        from .problem_state import ProblemState
         self.store = store
+        # persistent cross-pass solver state (delta encode + warm-started
+        # packing): attached to LIVE provisioning solves only — disruption
+        # simulation probes solve hypothetical node subsets and must not
+        # thrash the caches (see schedule_with)
+        self.problem_state = (problem_state if problem_state is not None
+                              else ProblemState())
         # state.unavailable.UnavailableOfferings: expired at the top of
         # every pass (an expiry re-triggers a solve via the hold signature)
         # and handed to every scheduler the default factory builds
@@ -447,6 +454,12 @@ class Provisioner(SingletonController):
             nodepools, instance_types, state_nodes,
             self.cluster.daemonset_pod_list(),
             StateClusterView(self.store, self.cluster))
+        if record and self.problem_state is not None \
+                and hasattr(ts, "problem_state"):
+            # live solves share the persistent delta state; simulation
+            # probes (record=False) stay cold so their hypothetical node
+            # subsets can't poison the caches or the warm-pack seed
+            ts.problem_state = self.problem_state
         if record and self.flight_recorder is not None \
                 and hasattr(ts, "flight_recorder"):
             # the in-process TensorScheduler captures inside solve(); the

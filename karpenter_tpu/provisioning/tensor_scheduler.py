@@ -289,7 +289,7 @@ class TensorScheduler:
                  initial_zone_counts=None, force_tensor: bool = False,
                  mesh=None, catalog_token: Optional[tuple] = None,
                  circuit: Optional[SolverCircuitBreaker] = None,
-                 unavailable=None):
+                 unavailable=None, problem_state=None):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -323,6 +323,17 @@ class TensorScheduler:
         # compare per solve.
         self.flight_recorder = None
         self.fallback_reason: str = ""
+        # provisioning.problem_state.ProblemState: the persistent cross-pass
+        # delta cache (node rows, group rows, topology-count memo, warm-pack
+        # seed). None (the default) keeps the self-contained cold path —
+        # disruption simulation probes and ad-hoc schedulers never share it.
+        self.problem_state = problem_state
+        # "cold" | "delta": how this solve's problem encode was produced
+        # (delta = cached rows against an unchanged vocabulary). Recorded on
+        # every flight-recorder DecisionRecord; replay re-encodes cold, so a
+        # byte-identical replay verdict on a delta record pins the delta
+        # path's determinism contract.
+        self.encode_kind = "cold"
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
         # per-instance state-node encoding memo keyed by vocab identity:
@@ -349,6 +360,9 @@ class TensorScheduler:
     def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
         # fresh registry snapshot per solve (see drought_patterns)
         self._drought_pinned = False
+        self.encode_kind = "cold"
+        if self.problem_state is not None:
+            self.problem_state.begin_solve()
         # port eligibility needs existing-node usage: a port occupied on a
         # live node makes its pods CONFLICTED (capped groups with per-node
         # exclusion) instead of constraint-free
@@ -615,13 +629,24 @@ class TensorScheduler:
         if masked is not None:
             off_available, off_price, it_price, device_cache = masked
 
-        group_enc = enc.stack_encoded(
-            [enc.encode_requirements(vocab, g.requirements) for g in groups])
+        ps = self.problem_state
+        if ps is not None:
+            # (_drought_arrays above already pinned this solve's registry
+            # snapshot, so the warm-pack global token reads a stable view)
+            self.encode_kind = ps.note_encode(vocab)
+            g_rows = [ps.group_row(vocab, g) for g in groups]
+            group_enc = enc.stack_encoded([r[0] for r in g_rows])
+            group_req = np.stack([r[1] for r in g_rows])
+        else:
+            group_enc = enc.stack_encoded(
+                [enc.encode_requirements(vocab, g.requirements)
+                 for g in groups])
+            group_req = np.stack(
+                [enc.encode_resource_vector(vocab, g.requests,
+                                            capacity=False)
+                 for g in groups])
         template_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, t.requirements) for t in templates])
-
-        group_req = np.stack([enc.encode_resource_vector(vocab, g.requests, capacity=False)
-                              for g in groups])
         daemon = np.stack([
             enc.encode_resource_vector(vocab, _daemon_overhead(t, self.daemonset_pods),
                                        capacity=False)
@@ -641,7 +666,17 @@ class TensorScheduler:
         min_its = self._min_its_floor(templates, groups)
 
         exist_enc = exist_avail = exist_zone = tol_exist = None
-        if self.state_nodes:
+        exist_token = None
+        if self.state_nodes and ps is not None:
+            # persistent per-node rows: only dirty rows re-encode, and the
+            # padded stack (plus its device upload, via exist_token) is
+            # reused while the node set is unchanged
+            (exist_enc, exist_avail, exist_zone, taint_lists,
+             exist_token) = ps.node_rows(vocab, zone_key, self.state_nodes,
+                                         self.daemonset_pods)
+            tol_exist = _tol_exist_matrix(groups, taint_lists,
+                                          exist_enc.mask.shape[0])
+        elif self.state_nodes:
             memo = self._exist_memo.get(id(vocab))
             if memo is None:
                 encs, avails, zones, taint_lists = [], [], [], []
@@ -653,7 +688,7 @@ class TensorScheduler:
                         in vocab.key_idx)
                     encs.append(enc.encode_requirements(vocab, known))
                     node_daemons = _node_remaining_daemons(
-                        sn, templates, self.daemonset_pods)
+                        sn, self.daemonset_pods)
                     avail = res.subtract(sn.available(), node_daemons)
                     avails.append(enc.encode_resource_vector(vocab, avail,
                                                              capacity=True))
@@ -666,16 +701,8 @@ class TensorScheduler:
                         np.array(zones, dtype=np.int32), taint_lists)
                 self._exist_memo[id(vocab)] = memo
             _, encs, avail_rows, zone_rows, taint_lists = memo
-            # group-side pieces are per-build: tol_exist pairs groups with
-            # the memoized node taints. True = tolerated (tolerates()
-            # returns the error list), so untainted nodes default True.
-            tol_exist = np.ones((G, len(self.state_nodes)), dtype=bool)
-            for i, nt in enumerate(taint_lists):
-                if not nt:
-                    continue
-                for gi, g in enumerate(groups):
-                    tol_exist[gi, i] = not scheduling_taints.tolerates(
-                        nt, g.pods[0])
+            tol_exist = _tol_exist_matrix(groups, taint_lists,
+                                          len(self.state_nodes))
             exist_enc = enc.stack_encoded(encs)
             exist_avail = avail_rows.copy()
             exist_zone = zone_rows.copy()
@@ -696,9 +723,33 @@ class TensorScheduler:
                 tol_exist = np.concatenate(
                     [tol_exist, np.zeros((G, pad), bool)], axis=1)
 
+        group_count = np.array([g.count for g in groups], dtype=np.int64)
+        if ps is not None:
+            # group-axis pow2 bucket: steady-state churn nudges G every
+            # pass; stable padded shapes keep the compiled-executable cache
+            # hitting (the node axis is already bucketed). Padded rows are
+            # empty-Requirements with zero requests — never packable, and
+            # the packer only iterates the real G anyway.
+            Gp = _pow2_bucket(G, 16)
+            if Gp > G:
+                pad = Gp - G
+                zero = enc.encode_requirements(vocab, Requirements())
+                group_enc = enc.pad_stacked(group_enc, Gp, zero)
+                group_req = np.concatenate(
+                    [group_req, np.zeros((pad,) + group_req.shape[1:],
+                                         group_req.dtype)])
+                group_count = np.concatenate(
+                    [group_count, np.zeros(pad, np.int64)])
+                tol_template = np.concatenate(
+                    [tol_template, np.zeros((pad, M), bool)])
+                if tol_exist is not None:
+                    tol_exist = np.concatenate(
+                        [tol_exist,
+                         np.zeros((pad, tol_exist.shape[1]), bool)])
+
         problem = binpack.PackProblem(
             vocab=vocab, group_enc=group_enc, group_req=group_req,
-            group_count=np.array([g.count for g in groups], dtype=np.int64),
+            group_count=group_count,
             template_enc=template_enc, daemon_overhead=daemon,
             tol_template=tol_template, it_enc=it_enc, it_alloc=it_alloc,
             it_capacity=it_capacity, it_price=it_price, template_its=template_its,
@@ -707,7 +758,8 @@ class TensorScheduler:
             off_price=off_price,
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined,
-            device_cache=device_cache, min_its=min_its)
+            device_cache=device_cache, min_its=min_its,
+            exist_token=exist_token)
         return problem, templates, catalog
 
     def _drought_arrays(self, ce: _CatalogEncoding):
@@ -1033,6 +1085,13 @@ class TensorScheduler:
                 counts = self.initial_zone_counts(g, zone_names)
                 for z, cnt in enumerate(counts):
                     izc[gi, z] = cnt
+        elif self.problem_state is not None:
+            # per-group counts memoized against Cluster.topo_revision: the
+            # scheduled-pod selector scans run only for groups the revision
+            # can no longer vouch for
+            izc, exist_counts, host_total = \
+                self.problem_state.topology_counts(self, groups, zone_names,
+                                                   pods)
         else:
             # default: count scheduled cluster pods matching each group's
             # topology selectors so a deployment scale-up spreads against its
@@ -1062,6 +1121,11 @@ class TensorScheduler:
                     for ni, sn in enumerate(self.state_nodes):
                         exist_port_block[gi, ni] = \
                             sn.host_port_usage().conflicts_triples(gp)
+        warm = None
+        if self.problem_state is not None:
+            warm = self.problem_state.warm_start(
+                self, vocab, groups, templates, limits,
+                izc, exist_counts, host_total, problem.exist_token)
         packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
                                 initial_zone_counts=izc, exist_order=sn_order,
                                 exist_counts=exist_counts,
@@ -1069,8 +1133,11 @@ class TensorScheduler:
                                 vol_group_counts=vol_group_counts,
                                 vol_node_remaining=vol_node_remaining,
                                 group_ports=group_ports,
-                                exist_port_block=exist_port_block)
+                                exist_port_block=exist_port_block,
+                                warm=warm)
         pr = packer.pack()
+        if self.problem_state is not None:
+            self.problem_state.finish_pack(warm)
         return self._materialize(pr, problem, groups, templates, catalog,
                                  vocab, zone_key)
 
@@ -1244,7 +1311,25 @@ def pad_exist_counts(problem, exist_counts: np.ndarray) -> np.ndarray:
     return exist_counts
 
 
-def _node_remaining_daemons(sn, templates, daemonset_pods) -> dict:
+def _tol_exist_matrix(groups, taint_lists, total_cols: int) -> np.ndarray:
+    """[G, total_cols] group x existing-node toleration matrix — THE one
+    construction both the cold and delta encode paths share (a divergence
+    would break the delta path's bit-identical contract). True = the
+    group's probe pod tolerates node i's taints (tolerates() returns the
+    error list, so untainted nodes default True); columns past
+    len(taint_lists) are pow2 padding and stay False (never packable)."""
+    G = len(groups)
+    out = np.zeros((G, total_cols), dtype=bool)
+    out[:, :len(taint_lists)] = True
+    for i, nt in enumerate(taint_lists):
+        if not nt:
+            continue
+        for gi, g in enumerate(groups):
+            out[gi, i] = not scheduling_taints.tolerates(nt, g.pods[0])
+    return out
+
+
+def _node_remaining_daemons(sn, daemonset_pods) -> dict:
     """Remaining daemonset overhead a node must still absorb
     (existingnode.go:44-54)."""
     from ..scheduling.requirements import pod_requirements as preqs

@@ -53,6 +53,12 @@ class Cluster:
         # timestamp of the last consolidation-relevant cluster change
         # (cluster.go clusterState); methods memoize it per-method
         self._cluster_state: float = 0.0
+        # monotone revision of everything topology counting reads: the
+        # scheduled-pod set (bindings) and node identity/labels. The
+        # persistent ProblemState memoizes per-group cluster topology
+        # counts against this; an unchanged revision proves the counts.
+        # Conservative over-bumping is safe (just a recompute).
+        self.topo_revision: int = 0
 
     # -- sync ---------------------------------------------------------------
 
@@ -94,10 +100,12 @@ class Cluster:
             self.nodes[pid] = sn
         else:
             sn.nodeclaim = nodeclaim
+        sn.bump()
         if sn.node is None and nodeclaim.status.node_name:
             node = self.store.get(Node, nodeclaim.status.node_name)
             if node is not None:
                 sn.node = node
+        self.topo_revision += 1
 
     def delete_nodeclaim(self, name: str) -> None:
         pid = self.nodeclaim_name_to_provider_id.pop(name, None)
@@ -107,8 +115,10 @@ class Cluster:
         if sn is None:
             return
         sn.nodeclaim = None
+        sn.bump()
         if sn.node is None:
             del self.nodes[pid]
+        self.topo_revision += 1
 
     def update_node(self, node: Node) -> None:
         pid = node.spec.provider_id or f"node://{node.name}"
@@ -124,6 +134,8 @@ class Cluster:
             self.nodes[pid] = sn
         else:
             sn.node = node
+        sn.bump()
+        self.topo_revision += 1
         if first_seen:
             self._populate_resource_requests(sn, node.name)
 
@@ -146,8 +158,10 @@ class Cluster:
         if sn is None:
             return
         sn.node = None
+        sn.bump()
         if sn.nodeclaim is None:
             del self.nodes[pid]
+        self.topo_revision += 1
 
     # -- pods ---------------------------------------------------------------
 
@@ -157,6 +171,10 @@ class Cluster:
             self.delete_pod(pod)
             return
         self._update_anti_affinity_index(pod)
+        if pod.spec.node_name or key in self.bindings:
+            # the scheduled-pod set (or a scheduled pod's content) changed:
+            # memoized topology counts are no longer proven
+            self.topo_revision += 1
         if is_terminal(pod):
             # a Failed/Succeeded pod no longer consumes node resources
             # (cluster.go UpdatePod:312 -> updateNodeUsageFromPodCompletion)
@@ -193,6 +211,7 @@ class Cluster:
         binding = self.bindings.pop(key, None)
         if binding:
             self._unbind(binding[1], binding[0])
+            self.topo_revision += 1
         self._anti_affinity_pods.pop(key, None)
         self.pod_acks.pop(key, None)
         self.pod_scheduling_decisions.pop(key, None)

@@ -9,6 +9,7 @@ Allocatable - PodRequests (:364-366), and the disruption validation gates
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional
 
 from ..api import labels as api_labels
@@ -32,6 +33,22 @@ class StateNode:
         self.pod_volumes: Dict[str, Volumes] = {}
         self.mark_for_deletion = False
         self.nominated_until: float = 0.0
+        # monotone content revision, bumped by Cluster on every mutation
+        # that can change what a solver encode reads off this node (labels,
+        # taints, allocatable, pod usage, ports, volumes), paired with a
+        # process-unique creation identity. The persistent ProblemState
+        # keys its per-node encoded rows on (identity, revision): the
+        # identity makes a deleted-and-recreated node under the same name
+        # a NEW cache key even when its event sequence replays the same
+        # revision count (revision alone would collide and serve the old
+        # node's stale row). deep_copy preserves both.
+        self.revision: int = 0
+        self.identity: int = next(StateNode._IDENT_SEQ)
+
+    _IDENT_SEQ = itertools.count(1)
+
+    def bump(self) -> None:
+        self.revision += 1
 
     # --- identity ----------------------------------------------------------
 
@@ -150,6 +167,7 @@ class StateNode:
     # --- pod tracking ------------------------------------------------------
 
     def update_pod(self, pod: Pod, volumes: Optional[Volumes] = None) -> None:
+        self.revision += 1
         requests = pod.requests()
         self.pod_requests[pod.uid] = requests
         if pod.is_daemonset_pod:
@@ -164,6 +182,7 @@ class StateNode:
             self._volume_usage.add(volumes)
 
     def cleanup_pod(self, pod_uid: str) -> None:
+        self.revision += 1
         self.pod_requests.pop(pod_uid, None)
         self.pod_limits.pop(pod_uid, None)
         self.daemonset_pod_requests.pop(pod_uid, None)
@@ -202,4 +221,6 @@ class StateNode:
         out.pod_volumes = dict(self.pod_volumes)
         out.mark_for_deletion = self.mark_for_deletion
         out.nominated_until = self.nominated_until
+        out.revision = self.revision
+        out.identity = self.identity
         return out

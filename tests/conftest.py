@@ -29,3 +29,8 @@ def pytest_configure(config):
         "markers",
         "replay: flight-recorder record/replay tests (deterministic "
         "offline re-solves of captured traces — tier-1 eligible)")
+    config.addinivalue_line(
+        "markers",
+        "churn: incremental delta-solver tests (persistent ProblemState, "
+        "seeded churn streams asserting delta == cold at every step — "
+        "deterministic, tier-1 eligible)")

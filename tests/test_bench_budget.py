@@ -233,6 +233,120 @@ class TestDroughtBudget:
             "BENCH_MODE=drought missing from the unknown-mode error list"
 
 
+class TestMeshBudget:
+    """8-device mesh regression gate (ISSUE 6 satellite): BENCH_r05 showed
+    the mesh line regress 0.412s -> 0.918s with NO tier-1 gate — it was
+    discovered at re-anchor time. This runs the headline mix on the
+    conftest-provided virtual 8-device CPU mesh at test scale and pins
+    (1) exact decision equality vs the single-device solve and (2) a
+    wall-clock envelope: an absolute budget a host-Python sharding path
+    would blow, plus a relative bound on the mesh's overhead over the
+    single-device solve (r05-style regressions at least double it)."""
+
+    N_PODS_MESH = 6000
+    ABSOLUTE_BUDGET_SECONDS = 5.0
+    RELATIVE_FACTOR = 3.0
+    RELATIVE_GRACE_SECONDS = 0.3
+
+    def test_mesh_solve_budget_and_parity(self):
+        import jax
+
+        from karpenter_tpu.parallel.mesh import make_solver_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the conftest 8-device virtual CPU platform")
+        saved = (bench.N_PODS, bench.N_DEPLOYS)
+        bench.N_PODS, bench.N_DEPLOYS = self.N_PODS_MESH, N_DEPLOYS
+        try:
+            pods = bench._pods()
+        finally:
+            bench.N_PODS, bench.N_DEPLOYS = saved
+        mesh = make_solver_mesh(8)
+
+        def best_of(mesh_or_none, n=3):
+            best, results = float("inf"), None
+            for _ in range(n + 1):  # first pass warms the jit cache
+                s = bench._scheduler(0)
+                s.mesh = mesh_or_none
+                t0 = time.perf_counter()
+                results = s.solve(pods)
+                best = min(best, time.perf_counter() - t0)
+                assert s.fallback_reason == "", s.fallback_reason
+            return best, results
+
+        t_single, r_single = best_of(None)
+        t_mesh, r_mesh = best_of(mesh)
+        assert sorted(map(_claim_key, r_mesh.new_nodeclaims)) == \
+            sorted(map(_claim_key, r_single.new_nodeclaims))
+        assert r_mesh.pod_errors == r_single.pod_errors
+        assert t_mesh < self.ABSOLUTE_BUDGET_SECONDS, (
+            f"8-device mesh solve took {t_mesh:.2f}s at "
+            f"{self.N_PODS_MESH} pods — the sharded precompute likely "
+            "fell off the compiled path")
+        assert t_mesh <= t_single * self.RELATIVE_FACTOR \
+            + self.RELATIVE_GRACE_SECONDS, (
+            f"mesh overhead regressed: {t_mesh:.3f}s vs single-device "
+            f"{t_single:.3f}s (bound {self.RELATIVE_FACTOR}x + "
+            f"{self.RELATIVE_GRACE_SECONDS}s)")
+
+
+class TestChurnBudget:
+    """ISSUE 6 guard: the BENCH_MODE=churn line at test scale. The 1k+
+    arrivals/sec floor is asserted at 50k scale inside bench_churn; here
+    the bench's own shape runs small (300 nodes) so tier-1 pins what a
+    regression would trip: the internal delta-residency asserts
+    (encode_kind == delta every window, dirty-row counts on node-churn
+    windows, warm prefix restores on steady ones), the sampled
+    delta-vs-cold bit-identity, and a p99 time-to-decision budget a
+    return of cold encodes would blow."""
+
+    N_NODES = 300
+    P99_BUDGET_MS = 1500.0
+    RATE_FLOOR = 200.0
+
+    def test_churn_bench_shape_within_budget(self, capsys):
+        import json
+
+        saved = (bench.N_NODES, bench.CHURN_PODS_PER_NODE,
+                 bench.CHURN_WINDOWS, bench.CHURN_ARRIVALS,
+                 bench.CHURN_MIN_RATE, bench.N_ITS)
+        (bench.N_NODES, bench.CHURN_PODS_PER_NODE, bench.CHURN_WINDOWS,
+         bench.CHURN_ARRIVALS, bench.CHURN_MIN_RATE, bench.N_ITS) = \
+            (self.N_NODES, 4, 8, 120, self.RATE_FLOOR, 144)
+        try:
+            bench.bench_churn()
+        finally:
+            (bench.N_NODES, bench.CHURN_PODS_PER_NODE, bench.CHURN_WINDOWS,
+             bench.CHURN_ARRIVALS, bench.CHURN_MIN_RATE, bench.N_ITS) = saved
+        line = json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert line["unit"] == "pods/sec"
+        assert "steady-state churn" in line["metric"]
+        assert line["p99_ms"] < self.P99_BUDGET_MS, (
+            f"churn p99 {line['p99_ms']}ms at {self.N_NODES} nodes — the "
+            "delta path likely fell back to cold encodes")
+        assert line["value"] >= self.RATE_FLOOR
+        assert line["delta_encodes"] == 8  # every timed window rode deltas
+        assert line["warm_restored_groups"] > 0
+
+    def test_bench_mode_churn_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "churn" in m.group(0), \
+            "BENCH_MODE=churn missing from the unknown-mode error list"
+
+    def test_unknown_bench_mode_errors_loudly(self, monkeypatch):
+        monkeypatch.setattr(bench, "MODE", "definitely-not-a-mode")
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+        msg = str(exc.value)
+        assert "definitely-not-a-mode" in msg
+        assert "churn" in msg and "drought" in msg and "replay" in msg
+
+
 @pytest.mark.parametrize("kind", [0, 1, 2, 4, 5, 6, 7, 8])
 def test_node_count_parity_vs_host_oracle_per_kind(kind):
     pods = [p for p in _mix()

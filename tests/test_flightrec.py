@@ -325,6 +325,36 @@ def test_cli_replay_smoke(tmp_path, capsys):
     assert len(reports) == 1 and reports[0].ok
 
 
+def test_cli_replay_delta_record_byte_identical(tmp_path, capsys):
+    """ISSUE 6 satellite: a DELTA-path record (solve encoded through a
+    persistent ProblemState) replays byte-identically through the CLI.
+    Replay always rebuilds the problem COLD, so a clean deterministic
+    verdict on an encode_kind="delta" record pins the tentpole's
+    determinism contract — delta encode == cold encode — forever."""
+    from karpenter_tpu.flightrec.__main__ import main
+    from karpenter_tpu.provisioning.problem_state import ProblemState
+    rng = random.Random(2026)
+    pools = gen_nodepools(rng)
+    its = {p.name: gen_catalog(rng) for p in pools}
+    pods = gen_pods(random.Random(2027), pools)
+    ps = ProblemState()
+    ts = TensorScheduler(pools, its, problem_state=ps)
+    ts.solve(pods)  # cold pass seeds the persistent state
+    rec = FlightRecorder(capacity=4)
+    ts2 = TensorScheduler(pools, its, problem_state=ps)
+    ts2.flight_recorder = rec
+    ts2.solve(pods)
+    assert ts2.encode_kind == "delta", ts2.fallback_reason
+    loaded = loads_record(rec.lines()[-1])
+    assert loaded["meta"]["encode_kind"] == "delta"
+    path = str(tmp_path / "delta.jsonl")
+    assert rec.dump(path) == 1
+    assert main(["replay", path]) == 0
+    out = capsys.readouterr().out
+    assert "deterministic=ok" in out
+    assert "0 verdict failures" in out
+
+
 def test_cli_rejects_future_schema(tmp_path, capsys):
     from karpenter_tpu.flightrec.__main__ import main
     path = str(tmp_path / "future.jsonl")

@@ -1,0 +1,550 @@
+"""ProblemState: incremental delta solver tests (ISSUE 6 tentpole).
+
+Every test here enforces ONE contract: a solve through a persistent
+ProblemState (delta path) makes decisions bit-identical to a cold solve of
+the same inputs — across every row of the invalidation matrix
+(provisioning/problem_state.py module docstring) and under a seeded churn
+stream interleaving pod arrivals/deletions, node churn, and drought marks.
+"""
+
+import pytest
+
+import numpy as np
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED, COND_LAUNCHED,
+                                         COND_REGISTERED, NodeClaim,
+                                         NodeClaimSpec)
+from karpenter_tpu.api.objects import (LabelSelector, Node, NodeSpec,
+                                       NodeStatus, ObjectMeta, Pod, PodSpec,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.kube.store import Store
+from karpenter_tpu.provisioning.grouping import group_signature, partition_pods
+from karpenter_tpu.provisioning.problem_state import ProblemState
+from karpenter_tpu.provisioning.provisioner import StateClusterView
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informers import wire_informers
+from karpenter_tpu.state.unavailable import UnavailableOfferings
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+
+from factories import make_nodepool, make_pod, make_pods, spread_zone
+
+pytestmark = pytest.mark.churn
+
+
+def digest(r):
+    """Full decision digest: launch claims, existing-node fills, errors."""
+    return (sorted(
+        (nc.template.nodepool_name,
+         tuple(sorted(nc.requirements.get(
+             api_labels.LABEL_TOPOLOGY_ZONE).values)),
+         tuple(it.name for it in nc.instance_type_options),
+         len(nc.pods),
+         tuple(sorted(p.metadata.name for p in nc.pods)))
+        for nc in r.new_nodeclaims),
+        sorted((en.name, tuple(sorted(p.metadata.name for p in en.pods)))
+               for en in r.existing_nodes if en.pods),
+        {uid: msg for uid, msg in r.pod_errors.items()})
+
+
+class ChurnEnv:
+    """A live cluster (store + informers + state) plus a persistent
+    ProblemState; solve_pair() runs the delta path and a cold control on
+    identical inputs and asserts bit-identical decisions."""
+
+    def __init__(self, n_nodes=4, pods_per_node=2, catalog=None):
+        self.clock = FakeClock()
+        self.store = Store(self.clock)
+        self.cluster = Cluster(self.store, self.clock)
+        wire_informers(self.store, self.cluster)
+        self.catalog = catalog if catalog is not None \
+            else construct_instance_types()
+        self.pool = make_nodepool(name="default")
+        self.ps = ProblemState()
+        self.registry = UnavailableOfferings(clock=self.clock)
+        self.bound = {}
+        self._seq = 0
+        big = next(it for it in self.catalog
+                   if it.capacity.get("cpu") == 4000)
+        self.node_type = big
+        for i in range(n_nodes):
+            self.add_node(i, pods_per_node)
+
+    def add_node(self, i, pods_per_node=0):
+        name = f"churn-node-{i:03d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: self.node_type.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: f"test-zone-{'abc'[i % 3]}",
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"churn-nc-{i:03d}",
+                                           namespace="",
+                                           labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"churn://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.conditions.set_true(cond, now=self.clock.now())
+        self.store.create(nc)
+        self.store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"churn://{i}"),
+            status=NodeStatus(capacity=dict(self.node_type.capacity),
+                              allocatable=self.node_type.allocatable())))
+        self.bound.setdefault(name, [])
+        for _ in range(pods_per_node):
+            self.bind_pod(name)
+        return name
+
+    def bind_pod(self, node_name, labels=None):
+        self._seq += 1
+        p = Pod(metadata=ObjectMeta(name=f"bound-{self._seq}",
+                                    namespace="default",
+                                    labels=dict(labels or {"warm": "w"})),
+                spec=PodSpec(node_name=node_name),
+                container_requests=[res.parse_list(
+                    {"cpu": "200m", "memory": "128Mi"})])
+        self.store.create(p)
+        self.bound[node_name].append(p)
+        return p
+
+    def complete_bound(self, node_name):
+        if self.bound.get(node_name):
+            self.store.delete(self.bound[node_name].pop())
+
+    def delete_node(self, name):
+        node = self.store.get(Node, name)
+        if node is not None:
+            self.store.delete(node)
+        nc = self.store.get(NodeClaim, name.replace("node", "nc"))
+        if nc is not None:
+            self.store.delete(nc)
+        self.bound.pop(name, None)
+
+    def scheduler(self, ps, unavailable=True):
+        state_nodes = [sn for sn in self.cluster.state_nodes()
+                       if not sn.deleting()]
+        return TensorScheduler(
+            [self.pool], {"default": self.catalog},
+            state_nodes=state_nodes,
+            cluster=StateClusterView(self.store, self.cluster),
+            unavailable=self.registry if unavailable else None,
+            problem_state=ps)
+
+    def solve_pair(self, batch):
+        """(delta results, delta scheduler): decisions asserted identical
+        to a ProblemState-free cold solve of the same inputs."""
+        ts = self.scheduler(self.ps)
+        r = ts.solve(batch)
+        cold = self.scheduler(None)
+        r_cold = cold.solve(batch)
+        assert digest(r) == digest(r_cold), \
+            "delta solve diverged from cold solve"
+        assert ts.fallback_reason == cold.fallback_reason
+        return r, ts
+
+
+def deployment(name, n, cpu="250m", spread_key=None, host_spread=False):
+    labels = {"app": name}
+    sel = LabelSelector(match_labels=dict(labels))
+    spread = []
+    if spread_key == "zone":
+        spread = [TopologySpreadConstraint(
+            topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+            label_selector=sel)]
+    elif host_spread:
+        spread = [TopologySpreadConstraint(
+            topology_key=api_labels.LABEL_HOSTNAME, max_skew=1,
+            label_selector=sel)]
+    return [Pod(metadata=ObjectMeta(name=f"{name}-{i}", namespace="default",
+                                    labels=dict(labels)),
+                spec=PodSpec(topology_spread_constraints=list(spread)),
+                container_requests=[res.parse_list(
+                    {"cpu": cpu, "memory": "128Mi"})])
+            for i in range(n)]
+
+
+# -- signatures --------------------------------------------------------------
+
+
+def test_group_signature_stable_across_passes():
+    """Equal-content deployments stamped in different passes (fresh pod
+    objects) share a signature; a changed request does not."""
+    g1, _, _ = partition_pods(deployment("sig", 3))
+    g2, _, _ = partition_pods(deployment("sig", 5))
+    g3, _, _ = partition_pods(deployment("sig", 3, cpu="300m"))
+    assert group_signature(g1[0]) == group_signature(g2[0])
+    assert group_signature(g1[0]) != group_signature(g3[0])
+
+
+# -- node rows ---------------------------------------------------------------
+
+
+class TestNodeRows:
+    def test_dirty_rows_only_reencode(self):
+        env = ChurnEnv(n_nodes=4, pods_per_node=1)
+        env.solve_pair(deployment("a", 4))
+        n0 = env.ps.last["node_rows_reencoded"]
+        assert n0 == 4  # first pass encodes everything
+        env.solve_pair(deployment("a", 5))
+        assert env.ps.last["node_rows_reencoded"] == 0
+        env.complete_bound("churn-node-001")  # dirties exactly one node
+        env.solve_pair(deployment("a", 5))
+        assert env.ps.last["node_rows_reencoded"] == 1
+
+    def test_node_add_and_remove_invalidate_their_rows_only(self):
+        env = ChurnEnv(n_nodes=3, pods_per_node=1)
+        env.solve_pair(deployment("a", 3))
+        env.add_node(7, pods_per_node=0)
+        env.solve_pair(deployment("a", 3))
+        assert env.ps.last["node_rows_reencoded"] == 1  # the new node only
+        env.delete_node("churn-node-000")
+        env.solve_pair(deployment("a", 3))
+        assert env.ps.last["node_rows_reencoded"] == 0  # removal: no encode
+
+    def test_daemonset_change_reencodes_all_rows(self):
+        env = ChurnEnv(n_nodes=3, pods_per_node=1)
+        ds = make_pod(name="ds-0", cpu="50m")
+        ds.metadata.owner_refs = []
+        env.solve_pair(deployment("a", 3))
+        # daemonset overhead rides in the node avail vectors: a changed
+        # daemonset set clears the whole row cache (invalidation row)
+        ts = env.scheduler(env.ps)
+        ts.daemonset_pods = [ds]
+        ts.solve(deployment("a", 3))
+        assert env.ps.last["node_rows_reencoded"] == 3
+
+
+# -- topology memo -----------------------------------------------------------
+
+
+class TestTopologyMemo:
+    def test_counts_memoized_until_revision_bump(self):
+        env = ChurnEnv(n_nodes=3, pods_per_node=1)
+        batch = deployment("t", 4, spread_key="zone")
+        env.solve_pair(batch)
+        assert env.ps.last["topo_groups_counted"] == 1
+        env.solve_pair(deployment("t", 6, spread_key="zone"))
+        assert env.ps.last["topo_groups_counted"] == 0  # memo hit
+        # binding a selector-matching pod bumps topo_revision -> recount,
+        # and the recount must see the new occupancy (parity pins it)
+        env.bind_pod("churn-node-000", labels={"app": "t"})
+        env.solve_pair(deployment("t", 6, spread_key="zone"))
+        assert env.ps.last["topo_groups_counted"] == 1
+
+
+# -- warm-started packing ----------------------------------------------------
+
+
+class TestWarmPack:
+    def test_identical_batch_full_replay(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        batch = deployment("w", 4) + deployment("x", 3, cpu="500m")
+        env.solve_pair(batch)
+        # same shape, fresh pod objects: the whole pack replays from seed
+        batch2 = deployment("w", 4) + deployment("x", 3, cpu="500m")
+        _, ts = env.solve_pair(batch2)
+        assert ts.encode_kind == "delta"
+        assert env.ps.last["warm_matched"] == 2
+        assert env.ps.last["warm_restored"] == 2
+
+    def test_dirty_group_cuts_prefix(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        # FFD order: big (500m) first, small (100m) last
+        env.solve_pair(deployment("big", 3, cpu="500m")
+                       + deployment("small", 3, cpu="100m"))
+        _, ts = env.solve_pair(deployment("big", 3, cpu="500m")
+                               + deployment("small", 5, cpu="100m"))
+        assert env.ps.last["warm_matched"] == 1  # big unchanged
+        assert env.ps.last["warm_restored"] == 1
+
+    def test_error_groups_replay_onto_fresh_pods(self):
+        """An unschedulable backlog group's errors re-bind to the NEW pod
+        objects on replay (uids change across passes; counts don't)."""
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        backlog = deployment("impossible", 3, cpu="900")
+        r1, _ = env.solve_pair(backlog + deployment("ok", 2))
+        assert len(r1.pod_errors) == 3
+        backlog2 = deployment("impossible", 3, cpu="900")
+        r2, _ = env.solve_pair(backlog2 + deployment("ok", 2))
+        assert set(r2.pod_errors) == {p.uid for p in backlog2}
+        assert env.ps.last["warm_restored"] >= 1
+
+    def test_node_churn_disables_warm_pack_for_the_pass(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=2)
+        batch = deployment("w", 4)
+        env.solve_pair(batch)
+        env.complete_bound("churn-node-000")
+        _, ts = env.solve_pair(deployment("w", 4))
+        # exist state changed: global token mismatch, no restore — but the
+        # pass still encodes delta and re-seeds for the next one
+        assert env.ps.last["warm_restored"] == 0
+        assert ts.encode_kind == "delta"
+        _, ts = env.solve_pair(deployment("w", 4))
+        assert env.ps.last["warm_restored"] > 0
+
+
+# -- invalidation matrix: directed vectors -----------------------------------
+
+
+class TestInvalidationMatrix:
+    def test_vocab_overflow_falls_back_to_cold_encode(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        _, ts = env.solve_pair(deployment("v", 3))
+        _, ts = env.solve_pair(deployment("v", 3))
+        assert ts.encode_kind == "delta"
+        # a pod with a never-seen label value: inexpressible as a delta
+        # (complement masks enumerate the value universe) -> cold
+        novel = [make_pod(name="novel-1", labels={"app": "v"},
+                          node_selector={"brand-new-key": "brand-new-val"})]
+        _, ts = env.solve_pair(deployment("v", 3) + novel)
+        assert ts.encode_kind == "cold"
+        # and the state re-warms on the next unchanged pass
+        _, ts = env.solve_pair(deployment("v", 3) + [
+            make_pod(name="novel-2", labels={"app": "v"},
+                     node_selector={"brand-new-key": "brand-new-val"})])
+        assert ts.encode_kind == "delta"
+
+    def test_catalog_change_falls_back_to_cold_encode(self):
+        its = construct_instance_types()
+        env = ChurnEnv(n_nodes=2, pods_per_node=1, catalog=its[:40])
+        _, ts = env.solve_pair(deployment("c", 3))
+        env.catalog = its[:44]  # provider refreshed the catalog
+        _, ts = env.solve_pair(deployment("c", 3))
+        assert ts.encode_kind == "cold"
+
+    def test_drought_mark_and_expiry_stay_bit_identical(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        batch = deployment("d", 4)
+        env.solve_pair(batch)
+        env.registry.mark(zone="test-zone-a")
+        r, ts = env.solve_pair(deployment("d", 4))
+        assert ts.encode_kind == "delta"  # mask rebuild, not a re-encode
+        for nc in r.new_nodeclaims:
+            zr = nc.requirements.raw(api_labels.LABEL_TOPOLOGY_ZONE)
+            if zr is not None and not zr.complement:
+                assert "test-zone-a" not in zr.values
+        # expiry bumps the registry version; the delta path must follow
+        env.clock.step(10_000)
+        env.registry.expire()
+        env.solve_pair(deployment("d", 4))
+
+    def test_minvalues_disables_warm_pack_not_delta_encode(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+
+        class MinValuesReq:
+            key = api_labels.LABEL_INSTANCE_TYPE
+            values = ()
+            min_values = 5
+
+            def operator(self):
+                return "Exists"
+        env.pool = make_nodepool(name="default", requirements=[
+            type("R", (), {"key": api_labels.LABEL_INSTANCE_TYPE,
+                           "operator": "Exists", "values": (),
+                           "min_values": 5})()])
+        env.solve_pair(deployment("m", 3))
+        _, ts = env.solve_pair(deployment("m", 3))
+        assert ts.encode_kind == "delta"
+        assert env.ps.last["warm"] == "disabled:inexpressible"
+        assert env.ps.last["warm_restored"] == 0
+
+    def test_conflicting_host_ports_disable_warm_pack(self):
+        from karpenter_tpu.api.objects import HostPort
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        ported = [make_pod(name=f"hp-{i}", labels={"app": "hp"},
+                           host_ports=[HostPort(port=8080)])
+                  for i in range(3)]
+        env.solve_pair(ported)
+        _, ts = env.solve_pair([
+            make_pod(name=f"hp2-{i}", labels={"app": "hp"},
+                     host_ports=[HostPort(port=8080)]) for i in range(3)])
+        assert env.ps.last["warm_restored"] == 0
+        assert env.ps.last["warm"] == "disabled:inexpressible"
+
+    def test_coupled_topology_demotes_to_host_on_both_paths(self):
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        # group B's spread selector matches group A's labels: cross-group
+        # coupling demotes both to the host oracle — on the delta path
+        # exactly as on a cold one (partition runs per pass)
+        a = deployment("couple-a", 2)
+        sel = LabelSelector(match_labels={"app": "couple-a"})
+        b = [Pod(metadata=ObjectMeta(name=f"couple-b-{i}",
+                                     namespace="default",
+                                     labels={"app": "couple-b"}),
+                 spec=PodSpec(topology_spread_constraints=[
+                     TopologySpreadConstraint(
+                         topology_key=api_labels.LABEL_TOPOLOGY_ZONE,
+                         max_skew=1, label_selector=sel)]),
+                 container_requests=[res.parse_list(
+                     {"cpu": "100m", "memory": "64Mi"})])
+             for i in range(2)]
+        _, ts = env.solve_pair(a + b)
+        assert ts.fallback_reason  # host path, same on both sides
+
+    def test_registry_version_in_warm_token(self):
+        """A drought mark between identical batches must invalidate the
+        warm seed (offering masks changed) — pinned by parity, and by the
+        restore count dropping to zero on the marked pass."""
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        env.solve_pair(deployment("rv", 4))
+        env.solve_pair(deployment("rv", 4))
+        assert env.ps.last["warm_restored"] > 0
+        env.registry.mark(instance_type=env.catalog[0].name)
+        env.solve_pair(deployment("rv", 4))
+        assert env.ps.last["warm_restored"] == 0
+
+
+# -- review-hardening regressions --------------------------------------------
+
+
+class TestReviewRegressions:
+    def test_topo_memo_overflow_recomputes_all_groups(self, monkeypatch):
+        """Overflow wipes the memo; the pass must recompute EVERY group,
+        not only the misses — a dangling hit sig was a KeyError that the
+        solve's blanket except turned into circuit-breaker failures."""
+        from karpenter_tpu.provisioning import problem_state as ps_mod
+        monkeypatch.setattr(ps_mod, "MAX_SIG_ENTRIES", 3)
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        env.solve_pair(deployment("ov-a", 2) + deployment("ov-b", 2))
+        # 2 cached + 2 new = 4 > 3: overflow path with live hit entries
+        _, ts = env.solve_pair(deployment("ov-a", 2) + deployment("ov-b", 2)
+                               + deployment("ov-c", 2)
+                               + deployment("ov-d", 2))
+        assert ts.fallback_reason == ""  # no KeyError -> no host fallback
+        assert env.ps.last["topo_groups_counted"] == 4
+
+    def test_recreated_node_same_name_never_reuses_stale_row(self):
+        """A node deleted and re-created under the same name replays the
+        same revision sequence; the identity component of the cache key
+        must still force a fresh encode (here: the replacement sits in a
+        DIFFERENT zone, so a stale row would mis-zone placements)."""
+        env = ChurnEnv(n_nodes=3, pods_per_node=0)
+        batch = deployment("rz", 6, spread_key="zone")
+        env.solve_pair(batch)
+        sn0 = {sn.name(): (sn.identity, sn.revision)
+               for sn in env.cluster.state_nodes()}
+        env.delete_node("churn-node-001")
+        # re-create the same name through the same event sequence but in
+        # another zone (i=4 -> zone-b; original i=1 -> zone-b... use i=3
+        # -> zone-a to guarantee the zone actually changes)
+        name = env.add_node(1 + 3 * 1000, pods_per_node=0)
+        node = env.store.get(Node, name)
+        renamed = Node(
+            metadata=ObjectMeta(name="churn-node-001", namespace="",
+                                labels=dict(node.metadata.labels)),
+            spec=NodeSpec(provider_id=node.spec.provider_id),
+            status=NodeStatus(capacity=dict(node.status.capacity),
+                              allocatable=dict(node.status.allocatable)))
+        env.store.delete(node)
+        env.store.create(renamed)
+        _, ts = env.solve_pair(deployment("rz", 6, spread_key="zone"))
+        sn1 = {sn.name(): (sn.identity, sn.revision)
+               for sn in env.cluster.state_nodes()}
+        # same name present both times, but a different identity
+        assert "churn-node-001" in sn0 and "churn-node-001" in sn1
+        assert sn0["churn-node-001"][0] != sn1["churn-node-001"][0]
+
+    def test_daemonset_change_on_empty_cluster_invalidates_warm_seed(self):
+        """Zero state nodes: exist_token is None, so the daemonset token
+        must ride the warm global token on its own — daemon overhead
+        shapes every fresh-node fill even with no existing nodes."""
+        its = construct_instance_types()
+        pool = make_nodepool(name="default")
+        ps = ProblemState()
+        batch = deployment("ds", 6)
+
+        def solve(ds_pods):
+            ts = TensorScheduler([pool], {"default": its},
+                                 daemonset_pods=ds_pods, problem_state=ps)
+            r = ts.solve(deployment("ds", 6))
+            cold = TensorScheduler([pool], {"default": its},
+                                   daemonset_pods=ds_pods)
+            assert digest(r) == digest(cold.solve(deployment("ds", 6)))
+            return ts
+
+        solve([])
+        solve([])
+        assert ps.last["warm_restored"] > 0
+        ds = make_pod(name="ds-pod", cpu="2")
+        solve([ds])
+        assert ps.last["warm_restored"] == 0  # seed invalidated
+
+
+    def test_seed_checkpoints_stay_bounded_across_passes(self):
+        """Carried + fresh checkpoints must not accumulate: a long-lived
+        provisioner restoring the full prefix every pass would otherwise
+        grow the seed (full cohort-array copies) without bound."""
+        from karpenter_tpu.ops.binpack import MAX_SEED_CHECKPOINTS
+        env = ChurnEnv(n_nodes=2, pods_per_node=1)
+        for w in range(30):
+            # stable core + one fresh small deployment appended per pass:
+            # the previous prefix always matches fully, so every old
+            # checkpoint is carried and new ones are recorded
+            batch = deployment("core", 4, cpu="800m") \
+                + [p for d in range(w + 1)
+                   for p in deployment(f"tail-{d}", 1, cpu="50m")]
+            env.solve_pair(batch)
+            assert len(env.ps.seed.checkpoints) <= MAX_SEED_CHECKPOINTS
+        assert env.ps.last["warm_restored"] > 0  # still warm at pass 30
+
+
+# -- seeded churn fuzzer -----------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_churn_fuzzer_delta_matches_cold_every_step(seed):
+    """Interleaved arrivals/completions/node churn/drought marks over a
+    persistent ProblemState: the delta solve must match a cold solve of
+    the same state BIT-IDENTICALLY at every step."""
+    import random
+    rng = random.Random(seed)
+    env = ChurnEnv(n_nodes=5, pods_per_node=2,
+                   catalog=construct_instance_types())
+    shapes = [dict(cpu="100m"), dict(cpu="250m", spread_key="zone"),
+              dict(cpu="500m", host_spread=True), dict(cpu="750m")]
+    pending = {}
+    step_seq = 0
+    for step in range(24):
+        op = rng.choice(["arrive", "arrive", "arrive", "complete",
+                         "node-churn", "drought", "expire", "node-add"])
+        if op == "arrive":
+            d = rng.randrange(6)
+            step_seq += 1
+            kw = dict(shapes[d % len(shapes)])
+            pending.setdefault(d, []).extend(
+                deployment(f"fz-{d}-{step_seq}", rng.randrange(1, 5), **kw))
+        elif op == "complete" and pending:
+            d = rng.choice(list(pending))
+            drop = rng.randrange(0, len(pending[d]) + 1)
+            pending[d] = pending[d][drop:]
+            if not pending[d]:
+                del pending[d]
+        elif op == "node-churn":
+            env.complete_bound(
+                f"churn-node-{rng.randrange(5):03d}")
+        elif op == "drought":
+            it = rng.choice(env.catalog)
+            env.registry.mark(instance_type=it.name,
+                              zone=rng.choice(["test-zone-a",
+                                               "test-zone-b"]))
+        elif op == "expire":
+            env.clock.step(rng.choice([30, 400, 2000]))
+            env.registry.expire()
+        elif op == "node-add":
+            env.add_node(10 + step, pods_per_node=1)
+        batch = [p for pods in pending.values() for p in pods]
+        if not batch:
+            continue
+        env.solve_pair(batch)  # asserts delta == cold
+
+    st = env.ps.stats
+    assert st["delta_encodes"] > 0, st  # the stream actually rode deltas
