@@ -84,7 +84,9 @@ class LeaveOneOutEngine:
         self.stats = {"classified": 0, "needs_sim": 0, "probes": 0}
         self._worst_memo: Dict[tuple, np.ndarray] = {}
         self._reqs_memo: Dict[tuple, object] = {}
-        self._verdicts = self._classify()
+        from ..obs.tracer import TRACER
+        with TRACER.span("disruption.loo", candidates=len(self.candidates)):
+            self._verdicts = self._classify()
         self.stats["classified"] = sum(
             1 for v in self._verdicts if v.kind != NEEDS_SIM)
         self.stats["needs_sim"] = sum(

@@ -21,6 +21,7 @@ from ..controllers.manager import Result, SingletonController
 from ..events import catalog as events_catalog
 from ..kube.store import Store
 from ..logging import get_logger
+from ..obs.tracer import TRACER
 from ..provisioning.provisioner import Provisioner
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
@@ -252,6 +253,10 @@ class DisruptionController(SingletonController):
 
     def _disrupt(self, method: Method) -> bool:
         """controller.go:155-190."""
+        with TRACER.span("disruption.pass", method=method.reason) as sp:
+            return self._disrupt_traced(method, sp)
+
+    def _disrupt_traced(self, method: Method, sp) -> bool:
         from ..metrics import registry as metrics
         disrupting = {pid for qc in self.queue.items for pid in qc.provider_ids}
         snapshot = self._pass_snapshot()
@@ -265,7 +270,11 @@ class DisruptionController(SingletonController):
         metrics.DISRUPTION_ELIGIBLE_NODES.set(
             len(candidates), {"reason": method.reason})
         if not candidates:
+            # idle pass: up to 4 of these every 10s poll would flood the
+            # trace ring and evict the interesting traces — don't ring it
+            TRACER.drop_current()
             return False
+        sp.set(candidates=len(candidates))
         budgets = build_disruption_budget_mapping(self.cluster, method.reason,
                                                   recorder=self.recorder)
         started = self.clock.now()
@@ -276,6 +285,9 @@ class DisruptionController(SingletonController):
              method.reason})
         if cmd.is_empty():
             return False
+        # the pass trace_id rides the command so the execute-time log line
+        # (possibly a TTL validation later) can still join the trace
+        cmd.trace_id = TRACER.current_trace_id()
         if self.flight_recorder is not None:
             # capture at decision time (before the TTL validation pass): the
             # record must hold the inputs the decision was COMPUTED from
@@ -298,7 +310,8 @@ class DisruptionController(SingletonController):
                  reason=cmd.reason, decision=cmd.decision,
                  consolidation_type=cmd.consolidation_type,
                  candidates=[c.state_node.name() for c in cmd.candidates],
-                 replacements=len(cmd.replacements))
+                 replacements=len(cmd.replacements),
+                 trace_id=cmd.trace_id)
         from ..metrics import registry as metrics
         metrics.DISRUPTION_DECISIONS.inc({
             "decision": cmd.decision, "reason": cmd.reason,

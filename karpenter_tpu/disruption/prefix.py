@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..api.nodepool import NodePool, order_by_weight
 from ..api.objects import ObjectMeta, Pod, PodSpec
+from ..obs.tracer import TRACER
 from ..ops import binpack
 from ..provisioning.grouping import PodGroup, group_pods
 from ..provisioning.provisioner import Provisioner, StateClusterView
@@ -87,6 +88,10 @@ class DisruptionSnapshot:
     """Pass-level shared state for every disruption simulation."""
 
     def __init__(self, cluster: Cluster, provisioner: Provisioner):
+        with TRACER.span("disruption.snapshot"):
+            self._build(cluster, provisioner)
+
+    def _build(self, cluster: Cluster, provisioner: Provisioner):
         from .helpers import build_pdb_limits, pods_by_node
         self.cluster = cluster
         self.provisioner = provisioner
@@ -198,6 +203,11 @@ class SnapshotEncoding:
 
     def __init__(self, snapshot: DisruptionSnapshot,
                  candidates: Sequence[Candidate]):
+        with TRACER.span("disruption.encode", candidates=len(candidates)):
+            self._build(snapshot, candidates)
+
+    def _build(self, snapshot: DisruptionSnapshot,
+               candidates: Sequence[Candidate]):
         self.snapshot = snapshot
         self.candidates = list(candidates)
         self.pod_uids_by_candidate = [
@@ -245,6 +255,11 @@ class SnapshotEncoding:
         candidate list); returns (results, sim_errors) like
         helpers.simulate_scheduling, including the uninitialized-node
         rejection (helpers.go:93-111)."""
+        idxs = list(idxs)
+        with TRACER.span("disruption.sim", subset=len(idxs)):
+            return self._simulate_subset(idxs)
+
+    def _simulate_subset(self, idxs) -> Tuple[object, Dict[str, str]]:
         snap = self.snapshot
         ts = snap.ts
         allowed: Set[str] = set(snap.base_uids)

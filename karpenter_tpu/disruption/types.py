@@ -134,6 +134,10 @@ class Command:
     replacements: list = field(default_factory=list)  # in-flight nodeclaims
     reason: str = ""
     consolidation_type: str = ""
+    # pass trace_id of the disruption pass that computed this command
+    # ("" when tracing is off): joins the execute-time log line with the
+    # compute-time trace and flight-recorder record
+    trace_id: str = ""
 
     @property
     def decision(self) -> str:

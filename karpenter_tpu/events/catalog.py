@@ -267,6 +267,23 @@ def reconcile_quarantined(kind: str, name: str, namespace: str,
         dedupe_values=(controller, name))
 
 
+# -- SLO watcher (obs/slo.py) ------------------------------------------------
+
+def slo_breached(slo: str, trace_id: str, duration: float, budget: float,
+                 dump_path: str) -> Event:
+    """Warning published when a pass trace exceeds a configured SLO budget
+    (no reference analog). Deduped per breaching trace so a replayed
+    observation can never double-publish; the message carries the
+    flight-recorder dump path so the incident snapshot is one click away."""
+    detail = f" (flight recorder: {dump_path})" if dump_path else ""
+    return Event(
+        object_kind="SLO", object_name=slo,
+        type=WARNING, reason="SLOBreached",
+        message=(f"Pass {trace_id} took {duration:.3f}s against the "
+                 f"{budget:.3f}s {slo} budget{detail}"),
+        dedupe_values=(slo, trace_id))
+
+
 # -- node health (health/events.go) ------------------------------------------
 
 def node_repair_blocked(node_name: str, nodeclaim_name: str,

@@ -176,6 +176,10 @@ class FlightRecorder:
                 # re-encodes cold, so a byte-identical replay verdict on a
                 # delta-kind record is the delta path's determinism proof
                 "encode_kind": getattr(ts, "encode_kind", "cold"),
+                # the pass trace id (obs/tracer): joins this record with
+                # its /debug/traces span tree and log lines; the SLO
+                # watcher's breach dump selects records by it
+                "trace_id": getattr(ts, "last_trace_id", ""),
                 "partition": list(ts.partition),
                 "claims": len(results.new_nodeclaims),
                 "existing": sum(1 for en in results.existing_nodes
@@ -209,9 +213,11 @@ class FlightRecorder:
         surviving nodes), eagerly encoded (candidate state nodes are live)."""
         from ..metrics import registry as metrics
         try:
+            from ..obs.tracer import TRACER
             ts = snapshot.ts
             winner_nodes = {c.state_node.name() for c in cmd.candidates}
             meta = {
+                "trace_id": TRACER.current_trace_id(),
                 "reason": cmd.reason,
                 "consolidation_type": cmd.consolidation_type,
                 "disruption_class": method.disruption_class,
@@ -275,6 +281,23 @@ class FlightRecorder:
     def dump(self, path: str) -> int:
         """Write the ring as JSONL (oldest first); returns the record count."""
         lines = self.lines()
+        with open(path, "w") as f:
+            for line in lines:
+                f.write(line + "\n")
+        return len(lines)
+
+    def dump_matching(self, path: str, trace_id: str) -> int:
+        """Write only the records of ONE pass (meta.trace_id match) — the
+        SLO watcher's breach dump. Returns the count; nothing is written
+        when no record matches (recorder unhooked, ring already evicted).
+        All lines are encoded BEFORE the file opens (like dump()): a
+        mid-materialize failure must not leave a truncated dump on disk
+        that the watcher's file cap never learns about."""
+        matched = [r for r in self.records()
+                   if r.meta.get("trace_id") == trace_id]
+        if not matched:
+            return 0
+        lines = [rec_codec.dumps_record(r.to_dict()) for r in matched]
         with open(path, "w") as f:
             for line in lines:
                 f.write(line + "\n")

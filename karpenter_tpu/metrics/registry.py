@@ -20,12 +20,33 @@ def _label_key(labels: dict) -> Tuple:
     return tuple(sorted(labels.items()))
 
 
+def _count_series_drop(metric_name: str) -> None:
+    # SERIES_DROPPED is defined at module bottom (it needs REGISTRY); it is
+    # itself uncapped, so this can never recurse
+    sd = globals().get("SERIES_DROPPED")
+    if sd is not None:
+        sd.inc({"metric": metric_name})
+
+
 class Metric:
-    def __init__(self, name: str, help: str, label_names: Iterable[str] = ()):
+    def __init__(self, name: str, help: str, label_names: Iterable[str] = (),
+                 max_series: int = 0):
         self.name = name
         self.help = help
         self.label_names = tuple(label_names)
+        # cardinality cap (0 = unbounded): a pathological label mix (one
+        # series per pod uid, per dynamic phase name, ...) must not grow
+        # the registry without bound — new series past the cap are dropped
+        # and counted on karpenter_metrics_series_dropped_total{metric}
+        self.max_series = max_series
         self._values: Dict[Tuple, float] = {}
+
+    def _admit(self, container: dict, k: Tuple) -> bool:
+        if not self.max_series or k in container \
+                or len(container) < self.max_series:
+            return True
+        _count_series_drop(self.name)
+        return False
 
     def labels_dict(self, key: Tuple) -> dict:
         return dict(key)
@@ -36,6 +57,8 @@ class Counter(Metric):
 
     def inc(self, labels: Optional[dict] = None, value: float = 1.0) -> None:
         k = _label_key(labels or {})
+        if not self._admit(self._values, k):
+            return
         self._values[k] = self._values.get(k, 0.0) + value
 
     def value(self, labels: Optional[dict] = None) -> float:
@@ -46,7 +69,10 @@ class Gauge(Metric):
     kind = "gauge"
 
     def set(self, value: float, labels: Optional[dict] = None) -> None:
-        self._values[_label_key(labels or {})] = value
+        k = _label_key(labels or {})
+        if not self._admit(self._values, k):
+            return
+        self._values[k] = value
 
     def delete(self, labels: Optional[dict] = None) -> None:
         self._values.pop(_label_key(labels or {}), None)
@@ -68,14 +94,17 @@ class Histogram(Metric):
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                        1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
-    def __init__(self, name, help, label_names=(), buckets=None):
-        super().__init__(name, help, label_names)
+    def __init__(self, name, help, label_names=(), buckets=None,
+                 max_series: int = 0):
+        super().__init__(name, help, label_names, max_series=max_series)
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
 
     def observe(self, value: float, labels: Optional[dict] = None) -> None:
         k = _label_key(labels or {})
+        if not self._admit(self._counts, k):
+            return
         counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
         for i, b in enumerate(self.buckets):
             if value <= b:
@@ -95,37 +124,52 @@ class Registry:
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
         self._lock = threading.Lock()
+        # measure() duration clock, injectable (the set_condition_clock
+        # pattern): fake-clock tests assert exact bucket placement instead
+        # of sleeping
+        self._measure_clock = time.perf_counter
 
-    def counter(self, name: str, help: str = "", label_names=()) -> Counter:
-        return self._register(Counter, name, help, label_names)
+    def set_measure_clock(self, now) -> "Callable[[], float]":
+        """Swap the measure() timing clock; returns the previous one so
+        tests can restore it."""
+        prev = self._measure_clock
+        self._measure_clock = now
+        return prev
 
-    def gauge(self, name: str, help: str = "", label_names=()) -> Gauge:
-        return self._register(Gauge, name, help, label_names)
+    def counter(self, name: str, help: str = "", label_names=(),
+                max_series: int = 0) -> Counter:
+        return self._register(Counter, name, help, label_names, max_series)
+
+    def gauge(self, name: str, help: str = "", label_names=(),
+              max_series: int = 0) -> Gauge:
+        return self._register(Gauge, name, help, label_names, max_series)
 
     def histogram(self, name: str, help: str = "", label_names=(),
-                  buckets=None) -> Histogram:
+                  buckets=None, max_series: int = 0) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Histogram(name, help, label_names, buckets)
+                m = Histogram(name, help, label_names, buckets,
+                              max_series=max_series)
                 self._metrics[name] = m
             return m
 
-    def _register(self, cls, name, help, label_names):
+    def _register(self, cls, name, help, label_names, max_series=0):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help, label_names)
+                m = cls(name, help, label_names, max_series=max_series)
                 self._metrics[name] = m
             return m
 
     def measure(self, histogram_name: str, labels: Optional[dict] = None):
-        """metrics.Measure() duration helper (metrics.go:88-96)."""
+        """metrics.Measure() duration helper (metrics.go:88-96), timed on
+        the injectable measure clock."""
         h = self.histogram(histogram_name)
-        start = time.perf_counter()
+        start = self._measure_clock()
 
         def done():
-            h.observe(time.perf_counter() - start, labels)
+            h.observe(self._measure_clock() - start, labels)
 
         return done
 
@@ -159,9 +203,17 @@ def _fmt(v: float) -> str:
     return repr(v) if not math.isinf(v) else "+Inf"
 
 
+def _escape(v) -> str:
+    """Prometheus text-format label-value escaping (exposition format spec:
+    backslash, double-quote, and line feed must be escaped)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _line(name: str, labels: dict, value) -> str:
     if labels:
-        body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        body = ",".join(f'{k}="{_escape(v)}"'
+                        for k, v in sorted(labels.items()))
         return f"{name}{{{body}}} {value}"
     return f"{name} {value}"
 
@@ -268,3 +320,34 @@ FLIGHTREC_DROPPED = REGISTRY.counter(
     "karpenter_flightrecorder_dropped_total",
     "Decision records dropped (ring eviction or capture failure)",
     ("reason",))
+
+# -- pass-level tracing + end-to-end SLO layer (obs/) ----------------------
+
+SOLVER_PHASE_DURATION = REGISTRY.histogram(
+    "karpenter_solver_phase_duration_seconds",
+    "Per-phase solver wall clock, derived from the pass tracer's span data "
+    "(phase = span name: encode.catalog, encode.groups, encode.nodes, "
+    "device.upload, compile, device.execute, pack, materialize, ...)",
+    ("phase", "encode_kind"),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0),
+    # phases are a fixed vocabulary x {cold, delta, ""}; the cap is a
+    # backstop against a dynamic span name leaking into the label
+    max_series=256)
+PODS_TIME_TO_SCHEDULE = REGISTRY.histogram(
+    "karpenter_pods_time_to_schedule_seconds",
+    "First seen pending to capacity decision (NodeClaim created or "
+    "existing-node placement) per pod — the operator-side end-to-end "
+    "scheduling SLO",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+             1800.0))
+SLO_BREACHES = REGISTRY.counter(
+    "karpenter_slo_breaches_total",
+    "Pass traces that exceeded a configured SLO budget (slo = the watched "
+    "span name); each breach also publishes an SLOBreached warning event "
+    "and dumps the pass's flight-recorder records",
+    ("slo",), max_series=64)
+SERIES_DROPPED = REGISTRY.counter(
+    "karpenter_metrics_series_dropped_total",
+    "Label sets dropped by a metric's cardinality cap (max_series)",
+    ("metric",))

@@ -1,0 +1,17 @@
+"""Observability substrate: pass-level span tracing + end-to-end SLOs.
+
+- ``tracer``: the clock-injectable span tracer, its bounded ring of
+  completed pass traces, and the Chrome trace-event export (Perfetto /
+  chrome://tracing compatible). Instrumentation sites use the process-wide
+  ``TRACER``.
+- ``slo``: the SLOWatcher enforcing per-span wall-clock budgets over
+  completed traces (breach metric + warning event + flight-recorder dump).
+- ``python -m karpenter_tpu.obs dump|show``: trace-dump workflow.
+"""
+
+from .slo import SLOWatcher, parse_budgets
+from .tracer import (TRACER, PassTrace, Span, Tracer, chrome_trace,
+                     dumps_chrome, phase_millis)
+
+__all__ = ["TRACER", "Tracer", "Span", "PassTrace", "chrome_trace",
+           "dumps_chrome", "phase_millis", "SLOWatcher", "parse_budgets"]

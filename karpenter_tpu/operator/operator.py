@@ -81,6 +81,33 @@ class Operator:
             from ..flightrec import FlightRecorder
             self.flightrec = FlightRecorder(
                 capacity=self.options.flightrec_ring, clock=self.clock)
+        # pass tracer + SLO watcher (obs/): the tracer is process-wide (the
+        # instrumented hot paths reach it directly), so this operator
+        # CONFIGURES it — ring size, enabled flag — and owns the single
+        # watcher slot (re-wiring replaces any previous operator's watcher;
+        # tests construct many operators per process)
+        from ..obs.tracer import TRACER
+        if self.options.slo_budgets and self.options.trace_ring <= 0:
+            # an SLO that can never fire (no traces complete with the
+            # tracer off) is worse than a boot failure — same philosophy as
+            # parse_budgets rejecting typo'd entries. Checked BEFORE any
+            # tracer mutation so a failed boot leaves the process-wide
+            # tracer untouched.
+            raise ValueError(
+                "--slo-budgets requires --trace-ring > 0: SLO breaches "
+                "are detected on completed pass traces")
+        self.tracer = TRACER
+        TRACER.enabled = self.options.trace_ring > 0
+        if self.options.trace_ring > 0:
+            TRACER.set_capacity(self.options.trace_ring)
+        self.slo = None
+        if self.options.slo_budgets:
+            from ..obs.slo import SLOWatcher, parse_budgets
+            self.slo = SLOWatcher(parse_budgets(self.options.slo_budgets),
+                                  recorder=self.recorder,
+                                  flightrec=self.flightrec,
+                                  clock=self.clock)
+        TRACER.watcher = self.slo
         self.serving: Optional[ServingGroup] = None
 
         gates = self.options.gates
@@ -198,7 +225,9 @@ class Operator:
                 ready=lambda: self.cluster.synced(),
                 profiling=self.options.enable_profiling,
                 manager=self.manager, flightrec=self.flightrec,
-                unavailable=self.unavailable).start()
+                unavailable=self.unavailable,
+                tracer=self.tracer if self.options.trace_ring > 0 else None,
+                slo=self.slo).start()
             self.log.info("serving metrics and health probes",
                           metrics_port=self.serving.metrics_port,
                           health_port=self.serving.health_port)

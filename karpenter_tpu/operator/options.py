@@ -57,6 +57,17 @@ class Options:
     # Each record pins its full solver inputs until dumped — size for
     # incident context, not history.
     flightrec_ring: int = 32
+    # pass tracer ring size (completed pass traces kept for /debug/traces
+    # and the obs dump CLI); 0 disables span tracing entirely. Traces are
+    # a few KB each (span names + timings, no object pins).
+    trace_ring: int = 64
+    # SLO budgets as "span=seconds,..." (e.g.
+    # "provisioner.pass=2.0,disruption.pass=5.0,solve=1.0"); "" disables
+    # the watcher. A breaching pass increments
+    # karpenter_slo_breaches_total{slo}, publishes an SLOBreached warning
+    # event, and dumps its flight-recorder records to
+    # $KARPENTER_FLIGHTREC_DIR.
+    slo_budgets: str = ""
     # TPU solver knobs (new surface: no reference analog)
     solver_backend: str = "tensor"   # tensor | sidecar
     solver_address: str = "127.0.0.1:50551"  # sidecar gRPC endpoint

@@ -205,6 +205,52 @@ def _debug_offerings_factory(unavailable):
     return fn
 
 
+def _debug_traces_factory(tracer):
+    """The pass tracer's operator surface: GET serves trace summaries
+    (?n=, default 50); ?format=chrome returns Chrome trace-event JSON of
+    the last-N completed traces (open in Perfetto / chrome://tracing —
+    `python -m karpenter_tpu.obs dump --url` wraps this); ?trace_id=
+    narrows to one pass (the id from a log line, flight-recorder record,
+    or SLO breach)."""
+    def fn(query: dict):
+        if tracer is None:
+            return 404, "text/plain", "no tracer attached"
+        try:
+            n = max(1, int(query.get("n", ["50"])[0]))
+        except (TypeError, ValueError):
+            return 400, "text/plain", "n must be an integer"
+        trace_id = query.get("trace_id", [""])[0]
+        if trace_id:
+            t = tracer.find(trace_id)
+            if t is None:
+                return (404, "text/plain",
+                        f"trace {trace_id} not in the ring\n")
+            traces = [t]
+        else:
+            traces = tracer.traces(n)
+        if query.get("format", [""])[0] == "chrome":
+            from ..obs.tracer import dumps_chrome
+            return 200, "application/json", dumps_chrome(traces)
+        lines = [f"traces {len(traces)} (ring capacity {tracer.capacity}, "
+                 f"enabled {tracer.enabled})"]
+        lines += [t.summary() for t in traces]
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
+def _debug_slo_factory(slo):
+    """The SLO watcher's operator surface: configured budgets with their
+    rolling p50/p99, and the recent breaches (trace_id + flight-recorder
+    dump path) — the first stop when karpenter_slo_breaches_total moves."""
+    def fn():
+        import json
+        if slo is None:
+            return 404, "text/plain", "no SLO watcher attached"
+        return (200, "application/json",
+                json.dumps(slo.snapshot(), indent=1) + "\n")
+    return fn
+
+
 def _debug_timers_factory(manager):
     def fn():
         if manager is None:
@@ -233,7 +279,7 @@ class ServingGroup:
                  healthy: Callable[[], bool] = lambda: True,
                  ready: Callable[[], bool] = lambda: True,
                  registry=REGISTRY, profiling: bool = False, manager=None,
-                 flightrec=None, unavailable=None):
+                 flightrec=None, unavailable=None, tracer=None, slo=None):
         def probe(check: Callable[[], bool]):
             def fn():
                 if check():
@@ -256,6 +302,12 @@ class ServingGroup:
         if unavailable is not None:
             metrics_routes["/debug/offerings"] = \
                 _debug_offerings_factory(unavailable)
+        if tracer is not None:
+            # operational like /debug/flightrecorder: served whenever the
+            # pass tracer exists, not gated behind profiling
+            metrics_routes["/debug/traces"] = _debug_traces_factory(tracer)
+        if slo is not None:
+            metrics_routes["/debug/slo"] = _debug_slo_factory(slo)
         if profiling:
             metrics_routes["/debug/stacks"] = _debug_stacks
             metrics_routes["/debug/timers"] = _debug_timers_factory(manager)

@@ -280,6 +280,12 @@ def _offering_value_ok(mask_b, key: int, off_val):
 
 def device_args(p: PackProblem):
     """Build the positional-array / static-kwarg split for precompute_kernel."""
+    from ..obs.tracer import TRACER
+    with TRACER.span("device.upload"):
+        return _device_args(p)
+
+
+def _device_args(p: PackProblem):
     has_exist = p.exist_enc is not None and p.exist_enc.mask.shape[0] > 0
     dev = lambda e: feas.to_device(e)
     i32 = lambda a: jnp.asarray(np.clip(a, -INT32_MAX - 1, INT32_MAX).astype(np.int32))
@@ -373,6 +379,7 @@ def _exec_cache_key(args, statics) -> tuple:
 def _run_precompute(args, statics):
     from ..metrics.registry import (SOLVER_COMPILE_CACHE_HITS,
                                     SOLVER_COMPILE_CACHE_MISSES)
+    from ..obs.tracer import TRACER
     key = _exec_cache_key(args, statics)
     with _EXEC_CACHE_LOCK:
         exe = _EXEC_CACHE.get(key)
@@ -380,23 +387,29 @@ def _run_precompute(args, statics):
             _EXEC_CACHE.move_to_end(key)
     if exe is not None:
         SOLVER_COMPILE_CACHE_HITS.inc()
-        return exe(*args)
+        with TRACER.span("device.execute", compile_cache="hit"):
+            return exe(*args)
     SOLVER_COMPILE_CACHE_MISSES.inc()
-    exe = _precompute_packed.lower(*args, **statics).compile()
+    with TRACER.span("compile"):
+        exe = _precompute_packed.lower(*args, **statics).compile()
     with _EXEC_CACHE_LOCK:
         if key not in _EXEC_CACHE and len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
             _EXEC_CACHE.popitem(last=False)
         _EXEC_CACHE[key] = exe
         _EXEC_CACHE.move_to_end(key)
-    return exe(*args)
+    with TRACER.span("device.execute", compile_cache="miss"):
+        return exe(*args)
 
 
 def precompute(p: PackProblem) -> PackTensors:
+    from ..obs.tracer import TRACER
     args, statics = device_args(p)
     # single packed fetch: per-array device_get pays a host<->device round
     # trip per tensor, and through a network tunnel (axon) the LATENCY of
-    # those trips — not the bytes — dominates the fetch
-    flat = np.asarray(_run_precompute(args, statics))
+    # those trips — not the bytes — dominates the fetch. Device execution is
+    # async-dispatched, so the fetch span carries the kernel's compute time.
+    with TRACER.span("device.fetch"):
+        flat = np.asarray(_run_precompute(args, statics))
     compat_tm, it_okz_packed, ppn, zone_adm, exist_ok, exist_cap = \
         _split_packed(flat, _output_layout(p, statics["has_exist"]))
     return unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,

@@ -15,6 +15,7 @@ reference: once a nominated NodeClaim's node is initialized, bind the pods.
 from __future__ import annotations
 
 import itertools
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from ..api import labels as api_labels
@@ -25,6 +26,7 @@ from ..controllers.manager import Controller, Result, SingletonController
 from ..events import catalog as events_catalog
 from ..kube.store import Store
 from ..logging import get_logger
+from ..obs.tracer import TRACER
 from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
 from ..utils import pod as pod_utils
@@ -190,6 +192,19 @@ class Provisioner(SingletonController):
                 unavailable=self.unavailable))
         # pod key -> nodeclaim name, consumed by the Binder
         self.nominations: Dict[str, str] = {}
+        # pod uid -> clock.now() when the pod was FIRST observed pending:
+        # the start of the karpenter_pods_time_to_schedule_seconds window,
+        # closed at the capacity decision (claim created / existing-node
+        # placement). Bounded by the pending set — entries for pods that
+        # scheduled or vanished are dropped each pass.
+        self._pending_first_seen: Dict[str, float] = {}
+        # uid -> original first-seen of pods whose window just closed: a
+        # pod recycled back to pending by a FAILED claim (ICE delete,
+        # liveness TTL) must resume its ORIGINAL window, not start a fresh
+        # one — otherwise a capacity drought reads as a stream of healthy
+        # ~10s samples instead of the real 10-minute wait. Bounded FIFO
+        # (successfully-bound pods never come back to claim their entry).
+        self._observed_first_seen: "OrderedDict[str, float]" = OrderedDict()
         self.last_results = None
         self.last_scheduler = None
         # --enable-profiling analog (operator.go:159-175): jax profiler trace
@@ -242,7 +257,24 @@ class Provisioner(SingletonController):
         if not pods and not deleting_pods:
             self.batcher.reset()
             self._exhausted_hold = None
+            self._pending_first_seen.clear()
             return None
+        # first-seen-pending watermark (time-to-schedule window start):
+        # stamped before the batcher gate so batching latency counts, and
+        # pruned to the live pending view so vanished pods can't
+        # accumulate. PENDING pods only — deleting-node ride-alongs are
+        # still bound and re-enter the batch every drain pass; stamping
+        # them would observe one bogus ~0s sample per pass (their real
+        # window opens when the drain unbinds them into the pending set).
+        now = self.clock.now()
+        pending = {p.uid for p in pods}
+        for uid in [u for u in self._pending_first_seen if u not in pending]:
+            del self._pending_first_seen[uid]
+        for uid in pending:
+            if uid not in self._pending_first_seen:
+                # a failed-claim recycle resumes its original window
+                self._pending_first_seen[uid] = \
+                    self._observed_first_seen.pop(uid, now)
         hold = self._check_exhausted_hold(pods, deleting_pods)
         if hold is not None:
             return hold
@@ -254,19 +286,26 @@ class Provisioner(SingletonController):
         self.batcher.reset()
         self.cluster.ack_pods(pods)
         from ..metrics import registry as metrics
-        done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
-        started = self.clock.now()
-        if self.profile_dir:
-            import jax
-            with jax.profiler.trace(self.profile_dir):
+        with TRACER.span("provisioner.pass",
+                         pods=len(pods) + len(deleting_pods)) as psp:
+            done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
+            started = self.clock.now()
+            if self.profile_dir:
+                import jax
+                with jax.profiler.trace(self.profile_dir):
+                    results = self.schedule(pods + deleting_pods)
+            else:
                 results = self.schedule(pods + deleting_pods)
-        else:
-            results = self.schedule(pods + deleting_pods)
-        done()
-        metrics.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
-        self.last_results = results
-        self._create_nodeclaims(results)
-        self._record(results)
+            done()
+            metrics.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
+            self.last_results = results
+            with TRACER.span("commit",
+                             claims=len(results.new_nodeclaims)):
+                self._create_nodeclaims(results)
+                self._record(results)
+            psp.set(claims=len(results.new_nodeclaims),
+                    errors=len(results.pod_errors))
+            trace_id = TRACER.current_trace_id()
         ts = self.last_scheduler
         log.info("scheduled pod batch",
                  pods=len(pods) + len(deleting_pods),
@@ -277,7 +316,8 @@ class Provisioner(SingletonController):
                  duration=round(self.clock.now() - started, 4),
                  tensor_pods=getattr(ts, "partition", (0, 0))[0],
                  host_pods=getattr(ts, "partition", (0, 0))[1],
-                 fallback_reason=getattr(ts, "fallback_reason", ""))
+                 fallback_reason=getattr(ts, "fallback_reason", ""),
+                 trace_id=trace_id)
         if results.pod_errors:
             for uid, err in list(results.pod_errors.items())[:10]:
                 log.debug("pod failed to schedule", pod_uid=uid, error=err)
@@ -469,6 +509,27 @@ class Provisioner(SingletonController):
         self.last_scheduler = ts
         return ts.solve(pods)
 
+    # bound on the observed-window memory: pods whose claims bound never
+    # reclaim their entry, so old ones age out FIFO
+    OBSERVED_FIRST_SEEN_MAX = 4096
+
+    def _observe_scheduled(self, pod) -> None:
+        """Close the pod's time-to-schedule window: first seen pending ->
+        this pass's capacity decision (claim created / existing-node
+        placement). The original first-seen is remembered so a failed
+        claim recycling the pod resumes the SAME window — each retry then
+        observes the cumulative wait, and p99 surfaces a drought instead
+        of averaging it away."""
+        from ..metrics import registry as metrics
+        first = self._pending_first_seen.pop(pod.uid, None)
+        if first is not None:
+            metrics.PODS_TIME_TO_SCHEDULE.observe(
+                max(0.0, self.clock.now() - first))
+            while len(self._observed_first_seen) >= \
+                    self.OBSERVED_FIRST_SEEN_MAX:
+                self._observed_first_seen.popitem(last=False)
+            self._observed_first_seen[pod.uid] = first
+
     def _create_nodeclaims(self, results) -> None:
         from ..metrics import registry as metrics
         for nc in results.new_nodeclaims:
@@ -479,6 +540,7 @@ class Provisioner(SingletonController):
             metrics.NODECLAIMS_CREATED.inc(
                 {"nodepool": api_nc.nodepool_name})
             for p in nc.pods:
+                self._observe_scheduled(p)
                 self.nominations[f"{p.namespace}/{p.name}"] = api_nc.name
                 # provisioner.go:388: pods bound for a brand-new claim are
                 # nominated against the claim (no node exists yet)
@@ -501,6 +563,7 @@ class Provisioner(SingletonController):
                         events_catalog.pod_failed_to_schedule(p, err))
         for existing in results.existing_nodes:
             for p in existing.pods:
+                self._observe_scheduled(p)
                 self.cluster.nominate_node_for_pod(existing.name, p)
                 nominations[f"{p.namespace}/{p.name}"] = existing.name
                 self.recorder.publish(
@@ -513,6 +576,10 @@ class Provisioner(SingletonController):
                 if live is not None and not live.spec.node_name:
                     live.spec.node_name = existing.name
                     self.store.update(live)
+                # bound = this scheduling episode is OVER: a later unbind
+                # (drain, disruption) opens a fresh window, it does not
+                # resume this one
+                self._observed_first_seen.pop(p.uid, None)
 
 
 class Binder(SingletonController):
@@ -565,6 +632,9 @@ class Binder(SingletonController):
                 continue
             pod.spec.node_name = node.name
             self.store.update(pod)
+            # the episode closed at bind: a future unbind starts a fresh
+            # time-to-schedule window (see _observe_scheduled)
+            self.provisioner._observed_first_seen.pop(pod.uid, None)
             nc.status.last_pod_event_time = self.store.clock.now()
             done.append(pod_key)
         for k in done:

@@ -20,6 +20,7 @@ from ..api import labels as api_labels
 from ..api.nodeclaim import NodeClaim as APINodeClaim, NodeClaimSpec
 from ..api.objects import ObjectMeta, OwnerReference, Pod
 from ..cloudprovider.types import InstanceType
+from ..obs.tracer import TRACER
 from ..ops import binpack
 from ..ops import encode as enc
 from ..scheduling import taints as scheduling_taints
@@ -328,6 +329,10 @@ class TensorScheduler:
         # seed). None (the default) keeps the self-contained cold path —
         # disruption simulation probes and ad-hoc schedulers never share it.
         self.problem_state = problem_state
+        # trace id of the pass this scheduler's last solve() ran under
+        # ("" when tracing is disabled): stamped onto flight-recorder
+        # records and the provisioner's summary log line
+        self.last_trace_id = ""
         # "cold" | "delta": how this solve's problem encode was produced
         # (delta = cached rows against an unchanged vocabulary). Recorded on
         # every flight-recorder DecisionRecord; replay re-encodes cold, so a
@@ -349,12 +354,21 @@ class TensorScheduler:
     def solve(self, pods: List[Pod], prebuckets=None) -> Results:
         from ..utils.gcpause import no_gc
         rec = self.flight_recorder
-        started = time.perf_counter() if rec is not None else 0.0
-        with no_gc():
-            results = self._solve(pods, prebuckets)
-        if rec is not None:
-            rec.capture_provisioning(self, pods, results,
-                                     time.perf_counter() - started)
+        # roots its own PassTrace when no pass span is active (bench, sims);
+        # nests under the provisioner/disruption pass loop otherwise
+        with TRACER.span("solve", pods=len(pods)) as sp:
+            started = time.perf_counter() if rec is not None else 0.0
+            with no_gc():
+                results = self._solve(pods, prebuckets)
+            sp.set(encode_kind=self.encode_kind,
+                   fallback_reason=self.fallback_reason)
+            TRACER.annotate(encode_kind=self.encode_kind)
+            # the pass trace_id joins this solve's trace, its flight-recorder
+            # record, and the provisioner's log line
+            self.last_trace_id = TRACER.current_trace_id()
+            if rec is not None:
+                rec.capture_provisioning(self, pods, results,
+                                         time.perf_counter() - started)
         return results
 
     def _solve(self, pods: List[Pod], prebuckets=None) -> Results:
@@ -476,7 +490,8 @@ class TensorScheduler:
 
     def _host_solve(self, pods: List[Pod], reason: str) -> Results:
         self.fallback_reason = reason
-        return self._make_host(pods).solve(pods)
+        with TRACER.span("host.solve", pods=len(pods), reason=reason):
+            return self._make_host(pods).solve(pods)
 
     def _make_host(self, pods: List[Pod]) -> Scheduler:
         from .domains import build_topology_domains
@@ -513,6 +528,11 @@ class TensorScheduler:
         groupmates, so the host solve's skew arithmetic must see the tensor
         half. (Leftover pods can't couple by construction — partition_pods
         demotes any group whose selectors touch host-side pods.)"""
+        with TRACER.span("host.remainder", pods=len(pods)):
+            return self._host_remainder(pods, tensor_results)
+
+    def _host_remainder(self, pods: List[Pod], tensor_results: Results
+                        ) -> Results:
         from .scheduler import InFlightNodeClaim, _subtract_max
         host = self._make_host(pods)
         by_name = {en.name: en for en in host.existing_nodes}
@@ -576,6 +596,13 @@ class TensorScheduler:
         """Encode groups + catalog + state into a PackProblem; returns
         (problem, templates, catalog). Raises _FallbackError when the batch
         isn't expressible."""
+        with TRACER.span("build_problem", groups=len(groups),
+                         nodes=len(self.state_nodes)) as sp:
+            out = self._build_problem(groups)
+            sp.set(encode_kind=self.encode_kind)
+            return out
+
+    def _build_problem(self, groups: List[PodGroup]):
         templates: List[NodeClaimTemplate] = []
         for np_ in self.nodepools:
             nct = NodeClaimTemplate(np_)
@@ -630,21 +657,23 @@ class TensorScheduler:
             off_available, off_price, it_price, device_cache = masked
 
         ps = self.problem_state
-        if ps is not None:
-            # (_drought_arrays above already pinned this solve's registry
-            # snapshot, so the warm-pack global token reads a stable view)
-            self.encode_kind = ps.note_encode(vocab)
-            g_rows = [ps.group_row(vocab, g) for g in groups]
-            group_enc = enc.stack_encoded([r[0] for r in g_rows])
-            group_req = np.stack([r[1] for r in g_rows])
-        else:
-            group_enc = enc.stack_encoded(
-                [enc.encode_requirements(vocab, g.requirements)
-                 for g in groups])
-            group_req = np.stack(
-                [enc.encode_resource_vector(vocab, g.requests,
-                                            capacity=False)
-                 for g in groups])
+        with TRACER.span("encode.groups", groups=G) as gsp:
+            if ps is not None:
+                # (_drought_arrays above already pinned this solve's registry
+                # snapshot, so the warm-pack global token reads a stable view)
+                self.encode_kind = ps.note_encode(vocab)
+                g_rows = [ps.group_row(vocab, g) for g in groups]
+                group_enc = enc.stack_encoded([r[0] for r in g_rows])
+                group_req = np.stack([r[1] for r in g_rows])
+                gsp.set(encoded=ps.last["group_rows_encoded"])
+            else:
+                group_enc = enc.stack_encoded(
+                    [enc.encode_requirements(vocab, g.requirements)
+                     for g in groups])
+                group_req = np.stack(
+                    [enc.encode_resource_vector(vocab, g.requests,
+                                                capacity=False)
+                     for g in groups])
         template_enc = enc.stack_encoded(
             [enc.encode_requirements(vocab, t.requirements) for t in templates])
         daemon = np.stack([
@@ -671,57 +700,19 @@ class TensorScheduler:
             # persistent per-node rows: only dirty rows re-encode, and the
             # padded stack (plus its device upload, via exist_token) is
             # reused while the node set is unchanged
-            (exist_enc, exist_avail, exist_zone, taint_lists,
-             exist_token) = ps.node_rows(vocab, zone_key, self.state_nodes,
-                                         self.daemonset_pods)
-            tol_exist = _tol_exist_matrix(groups, taint_lists,
-                                          exist_enc.mask.shape[0])
+            with TRACER.span("encode.nodes",
+                             nodes=len(self.state_nodes)) as nsp:
+                (exist_enc, exist_avail, exist_zone, taint_lists,
+                 exist_token) = ps.node_rows(vocab, zone_key,
+                                             self.state_nodes,
+                                             self.daemonset_pods)
+                tol_exist = _tol_exist_matrix(groups, taint_lists,
+                                              exist_enc.mask.shape[0])
+                nsp.set(dirty=ps.last["node_rows_reencoded"])
         elif self.state_nodes:
-            memo = self._exist_memo.get(id(vocab))
-            if memo is None:
-                encs, avails, zones, taint_lists = [], [], [], []
-                for sn in self.state_nodes:
-                    reqs = label_requirements(sn.labels())
-                    known = Requirements(
-                        r for r in reqs.values()
-                        if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
-                        in vocab.key_idx)
-                    encs.append(enc.encode_requirements(vocab, known))
-                    node_daemons = _node_remaining_daemons(
-                        sn, self.daemonset_pods)
-                    avail = res.subtract(sn.available(), node_daemons)
-                    avails.append(enc.encode_resource_vector(vocab, avail,
-                                                             capacity=True))
-                    z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
-                    zones.append(vocab.value_idx[zone_key].get(z, -1))
-                    taint_lists.append(sn.taints())
-                # the memo holds the vocab itself so its id() can never be
-                # recycled by a new object while the entry is alive
-                memo = (vocab, encs, np.stack(avails),
-                        np.array(zones, dtype=np.int32), taint_lists)
-                self._exist_memo[id(vocab)] = memo
-            _, encs, avail_rows, zone_rows, taint_lists = memo
-            tol_exist = _tol_exist_matrix(groups, taint_lists,
-                                          len(self.state_nodes))
-            exist_enc = enc.stack_encoded(encs)
-            exist_avail = avail_rows.copy()
-            exist_zone = zone_rows.copy()
-            # bucket the node-batch axis: padded rows have undefined masks and
-            # zero capacity, so they are never packable (exist_cap < 1)
-            N = len(self.state_nodes)
-            Np = _pow2_bucket(N, 16)
-            if Np > N:
-                pad = Np - N
-                zero = enc.encode_requirements(vocab, Requirements())
-                exist_enc = enc.stack_encoded(
-                    encs + [zero] * pad)
-                exist_avail = np.concatenate(
-                    [exist_avail, np.zeros((pad,) + exist_avail.shape[1:],
-                                           exist_avail.dtype)])
-                exist_zone = np.concatenate(
-                    [exist_zone, np.full(pad, -1, np.int32)])
-                tol_exist = np.concatenate(
-                    [tol_exist, np.zeros((G, pad), bool)], axis=1)
+            with TRACER.span("encode.nodes", nodes=len(self.state_nodes)):
+                exist_enc, exist_avail, exist_zone, tol_exist = \
+                    self._cold_node_rows(vocab, zone_key, groups, G)
 
         group_count = np.array([g.count for g in groups], dtype=np.int64)
         if ps is not None:
@@ -761,6 +752,57 @@ class TensorScheduler:
             device_cache=device_cache, min_its=min_its,
             exist_token=exist_token)
         return problem, templates, catalog
+
+    def _cold_node_rows(self, vocab, zone_key: int, groups, G: int):
+        """State-node encode for the self-contained (no ProblemState) path,
+        memoized per vocab identity; returns the pow2-padded
+        (exist_enc, exist_avail, exist_zone, tol_exist)."""
+        memo = self._exist_memo.get(id(vocab))
+        if memo is None:
+            encs, avails, zones, taint_lists = [], [], [], []
+            for sn in self.state_nodes:
+                reqs = label_requirements(sn.labels())
+                known = Requirements(
+                    r for r in reqs.values()
+                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
+                    in vocab.key_idx)
+                encs.append(enc.encode_requirements(vocab, known))
+                node_daemons = _node_remaining_daemons(
+                    sn, self.daemonset_pods)
+                avail = res.subtract(sn.available(), node_daemons)
+                avails.append(enc.encode_resource_vector(vocab, avail,
+                                                         capacity=True))
+                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
+                zones.append(vocab.value_idx[zone_key].get(z, -1))
+                taint_lists.append(sn.taints())
+            # the memo holds the vocab itself so its id() can never be
+            # recycled by a new object while the entry is alive
+            memo = (vocab, encs, np.stack(avails),
+                    np.array(zones, dtype=np.int32), taint_lists)
+            self._exist_memo[id(vocab)] = memo
+        _, encs, avail_rows, zone_rows, taint_lists = memo
+        tol_exist = _tol_exist_matrix(groups, taint_lists,
+                                      len(self.state_nodes))
+        exist_enc = enc.stack_encoded(encs)
+        exist_avail = avail_rows.copy()
+        exist_zone = zone_rows.copy()
+        # bucket the node-batch axis: padded rows have undefined masks and
+        # zero capacity, so they are never packable (exist_cap < 1)
+        N = len(self.state_nodes)
+        Np = _pow2_bucket(N, 16)
+        if Np > N:
+            pad = Np - N
+            zero = enc.encode_requirements(vocab, Requirements())
+            exist_enc = enc.stack_encoded(
+                encs + [zero] * pad)
+            exist_avail = np.concatenate(
+                [exist_avail, np.zeros((pad,) + exist_avail.shape[1:],
+                                       exist_avail.dtype)])
+            exist_zone = np.concatenate(
+                [exist_zone, np.full(pad, -1, np.int32)])
+            tol_exist = np.concatenate(
+                [tol_exist, np.zeros((G, pad), bool)], axis=1)
+        return exist_enc, exist_avail, exist_zone, tol_exist
 
     def _drought_arrays(self, ce: _CatalogEncoding):
         """Registry-masked (off_available, off_price, it_price,
@@ -881,7 +923,13 @@ class TensorScheduler:
 
     def _encode_catalog(self, catalog, templates, groups) -> _CatalogEncoding:
         """Fresh vocabulary + catalog-side tensors (the cacheable part of
-        build_problem)."""
+        build_problem). Only COLD solves reach this — its span's absence is
+        how a delta pass shows up in a trace."""
+        with TRACER.span("encode.catalog", instance_types=len(catalog)):
+            return self._encode_catalog_inner(catalog, templates, groups)
+
+    def _encode_catalog_inner(self, catalog, templates, groups
+                              ) -> _CatalogEncoding:
         vocab = enc.Vocab()
         zone_key = vocab.add_key(api_labels.LABEL_TOPOLOGY_ZONE)
         captype_key = vocab.add_key(api_labels.CAPACITY_TYPE_LABEL_KEY)
@@ -1060,7 +1108,8 @@ class TensorScheduler:
         vocab = problem.vocab
         zone_key = problem.zone_key
 
-        tensors = self.precompute(problem)
+        with TRACER.span("precompute"):
+            tensors = self.precompute(problem)
 
         # nodepool limits (scaled), minus existing node capacity per pool
         limits: List[Optional[dict]] = []
@@ -1079,25 +1128,29 @@ class TensorScheduler:
         Z = len(problem.zone_values)
         zone_names = vocab.values[zone_key]
         exist_counts = host_total = None
-        if self.initial_zone_counts is not None:
-            izc = np.zeros((len(groups), Z), dtype=np.int64)
-            for gi, g in enumerate(groups):
-                counts = self.initial_zone_counts(g, zone_names)
-                for z, cnt in enumerate(counts):
-                    izc[gi, z] = cnt
-        elif self.problem_state is not None:
-            # per-group counts memoized against Cluster.topo_revision: the
-            # scheduled-pod selector scans run only for groups the revision
-            # can no longer vouch for
-            izc, exist_counts, host_total = \
-                self.problem_state.topology_counts(self, groups, zone_names,
-                                                   pods)
-        else:
-            # default: count scheduled cluster pods matching each group's
-            # topology selectors so a deployment scale-up spreads against its
-            # existing replicas exactly like the host path does
-            izc, exist_counts, host_total = self.cluster_topology_counts(
-                groups, zone_names, {p.uid for p in pods})
+        with TRACER.span("topo.counts", groups=len(groups)) as tsp:
+            if self.initial_zone_counts is not None:
+                izc = np.zeros((len(groups), Z), dtype=np.int64)
+                for gi, g in enumerate(groups):
+                    counts = self.initial_zone_counts(g, zone_names)
+                    for z, cnt in enumerate(counts):
+                        izc[gi, z] = cnt
+            elif self.problem_state is not None:
+                # per-group counts memoized against Cluster.topo_revision:
+                # the scheduled-pod selector scans run only for groups the
+                # revision can no longer vouch for
+                izc, exist_counts, host_total = \
+                    self.problem_state.topology_counts(self, groups,
+                                                       zone_names, pods)
+                tsp.set(counted=self.problem_state.last[
+                    "topo_groups_counted"])
+            else:
+                # default: count scheduled cluster pods matching each
+                # group's topology selectors so a deployment scale-up
+                # spreads against its existing replicas exactly like the
+                # host path does
+                izc, exist_counts, host_total = self.cluster_topology_counts(
+                    groups, zone_names, {p.uid for p in pods})
 
         sn_order = sorted(range(len(self.state_nodes)),
                           key=lambda i: (not self.state_nodes[i].initialized(),
@@ -1126,20 +1179,27 @@ class TensorScheduler:
             warm = self.problem_state.warm_start(
                 self, vocab, groups, templates, limits,
                 izc, exist_counts, host_total, problem.exist_token)
-        packer = binpack.Packer(problem, tensors, groups, limits, limit_resources,
-                                initial_zone_counts=izc, exist_order=sn_order,
-                                exist_counts=exist_counts,
-                                host_match_total=host_total,
-                                vol_group_counts=vol_group_counts,
-                                vol_node_remaining=vol_node_remaining,
-                                group_ports=group_ports,
-                                exist_port_block=exist_port_block,
-                                warm=warm)
-        pr = packer.pack()
-        if self.problem_state is not None:
-            self.problem_state.finish_pack(warm)
-        return self._materialize(pr, problem, groups, templates, catalog,
-                                 vocab, zone_key)
+        with TRACER.span("pack", groups=len(groups)) as psp:
+            packer = binpack.Packer(problem, tensors, groups, limits,
+                                    limit_resources,
+                                    initial_zone_counts=izc,
+                                    exist_order=sn_order,
+                                    exist_counts=exist_counts,
+                                    host_match_total=host_total,
+                                    vol_group_counts=vol_group_counts,
+                                    vol_node_remaining=vol_node_remaining,
+                                    group_ports=group_ports,
+                                    exist_port_block=exist_port_block,
+                                    warm=warm)
+            pr = packer.pack()
+            if self.problem_state is not None:
+                self.problem_state.finish_pack(warm)
+                psp.set(warm=self.problem_state.last["warm"],
+                        warm_restored=self.problem_state.last[
+                            "warm_restored"])
+        with TRACER.span("materialize"):
+            return self._materialize(pr, problem, groups, templates, catalog,
+                                     vocab, zone_key)
 
     def _volume_limit_state(self, groups):
         """CSI attach-limit inputs for the packer's existing-node pass

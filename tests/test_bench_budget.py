@@ -191,6 +191,101 @@ class TestFlightRecorderBudget:
             "BENCH_MODE=replay missing from the unknown-mode error list"
 
 
+class TestTracingBudget:
+    """ISSUE 7 guard: the BENCH_MODE=trace budget at test scale. The 5%
+    tracing-on bound is asserted at 50k in bench_trace; at 2,000 pods the
+    absolute span cost is what a regression would trip — so this pins the
+    mechanism directly: spans stay per-STAGE (a per-pod/per-group span
+    regression multiplies the count by 1000x and fails the hard count
+    check), the tracing-disabled path stays a no-op, and the dumped trace
+    stays valid Chrome JSON covering the measured wall clock."""
+
+    MAX_SPANS_PER_SOLVE = 40
+    RELATIVE_FACTOR = 1.25
+    RELATIVE_GRACE_SECONDS = 0.10
+
+    def test_span_count_and_overhead(self, solved):
+        from karpenter_tpu.obs.tracer import TRACER
+        pods, _, _, _ = solved
+
+        def best_of(n=3):
+            best = float("inf")
+            for _ in range(n):
+                ts = bench._scheduler(0)
+                t0 = time.perf_counter()
+                ts.solve(pods)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        saved = TRACER.enabled
+        try:
+            TRACER.enabled = False
+            best_off = best_of()
+            TRACER.enabled = True
+            best_on = best_of()
+            trace = TRACER.last()
+        finally:
+            TRACER.enabled = saved
+        assert trace is not None and trace.name == "solve"
+        assert len(trace.spans) <= self.MAX_SPANS_PER_SOLVE, (
+            f"{len(trace.spans)} spans in one solve — a per-pod/per-group "
+            "span slipped into the hot path")
+        assert best_on <= best_off * self.RELATIVE_FACTOR \
+            + self.RELATIVE_GRACE_SECONDS, (
+            f"tracing-on solve {best_on:.3f}s vs off {best_off:.3f}s — "
+            "span overhead regressed")
+
+    def test_trace_covers_wall_clock_and_is_valid_chrome(self, solved):
+        import json
+
+        from karpenter_tpu.obs.tracer import TRACER, dumps_chrome
+        pods, _, _, _ = solved
+        ts = bench._scheduler(0)
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        wall = time.perf_counter() - t0
+        trace = TRACER.last()
+        assert trace.name == "solve"
+        assert trace.duration >= 0.95 * wall or wall - trace.duration < 0.010
+        doc = json.loads(dumps_chrome([trace]))
+        assert all(e["ph"] == "X" and "dur" in e and "ts" in e
+                   and e["args"]["trace_id"] == trace.trace_id
+                   for e in doc["traceEvents"])
+
+    def test_disabled_tracer_records_nothing(self, solved):
+        from karpenter_tpu.obs.tracer import TRACER
+        pods, _, _, _ = solved
+        saved = TRACER.enabled
+        try:
+            TRACER.enabled = False
+            TRACER.clear()
+            ts = bench._scheduler(0)
+            ts.solve(pods)
+            assert TRACER.traces() == []
+            assert ts.last_trace_id == ""
+        finally:
+            TRACER.enabled = saved
+
+    def test_headline_bench_emits_phase_breakdown(self, capsys):
+        saved = (bench.N_PODS, bench.N_DEPLOYS)
+        bench.N_PODS, bench.N_DEPLOYS = 500, 12
+        try:
+            line = bench.bench_provisioning(bench._pods(), 0, repeats=1)
+        finally:
+            bench.N_PODS, bench.N_DEPLOYS = saved
+        assert "phases" in line
+        assert line["phases"].get("pack", 0) > 0
+        assert "build_problem" in line["phases"]
+
+    def test_bench_mode_trace_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "trace" in m.group(0), \
+            "BENCH_MODE=trace missing from the unknown-mode error list"
+
+
 class TestDroughtBudget:
     """ISSUE 5 guard: the BENCH_MODE=drought line at test scale. The 5%
     masked-vs-unmasked bound is asserted at 50k in bench_drought (10 ms
