@@ -39,8 +39,19 @@ N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
 N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 # provisioning|consolidation|single|spot|mesh|mesh-local|mesh-headroom|
-# sidecar|minvalues|faults|replay|drought|churn|trace|all
+# sidecar|service|minvalues|faults|replay|drought|churn|trace|all
 MODE = os.environ.get("BENCH_MODE", "all")
+# BENCH_MODE=service knobs: concurrent tenant clusters driving ONE sidecar,
+# timed warm-delta windows per tenant, % of each tenant's pods replaced per
+# window, and the warm-delta round-trip ceiling the single-tenant headline
+# must hold (ISSUE 8 acceptance: <=0.5s at 50k x 2k vs the 1.411s
+# full-session baseline). Each tenant additionally runs one parity-probed
+# solve OUTSIDE the timed windows (the probe re-solves cold server-side).
+SERVICE_TENANTS = int(os.environ.get("BENCH_SERVICE_TENANTS", "4"))
+SERVICE_WINDOWS = int(os.environ.get("BENCH_SERVICE_WINDOWS", "6"))
+SERVICE_CHURN_PCT = float(os.environ.get("BENCH_SERVICE_CHURN_PCT", "1.2"))
+SERVICE_WARM_BUDGET = float(os.environ.get("BENCH_SERVICE_WARM_BUDGET",
+                                           "0.5"))
 # BENCH_MODE=churn knobs: windows in the timed stream, pod arrivals per
 # window, bound pods per warm node, minimum sustained arrival rate the
 # line must hold (pods/sec over summed time-to-decision)
@@ -1174,11 +1185,8 @@ nodepool = NodePool(
     metadata=ObjectMeta(name="default"),
     spec=NodePoolSpec(template=NodeClaimTemplate(
         spec=NodeClaimTemplateSpec())))
-session = SolverSession(f"127.0.0.1:{port}")
-rs = RemoteScheduler(f"127.0.0.1:{port}", [nodepool], {"default": catalog},
-                     session=session)
 
-def one():
+def one(rs):
     r = rs.solve(pods)
     assert rs.fallback_reason == "", rs.fallback_reason
     assert len(pods) - len(r.pod_errors) > 0
@@ -1186,12 +1194,29 @@ def one():
     assert all(nc.api_nodeclaim is not None for nc in r.new_nodeclaims)
     return r
 
-one()  # warm jit + session catalog on the server
+def fresh():
+    # a NEW session per timed solve: this line measures the FULL-state
+    # round trip (snapshot encode + wire + cold server solve + decode) —
+    # a reused session would ride the delta wire and the server's warm
+    # ProblemState instead (that steady-state number is BENCH_MODE=
+    # service's line, not this one). The CreateSession RPC (catalog
+    # bootstrap) is issued HERE, outside the timed window, matching the
+    # pre-delta line's once-per-session cost.
+    session = SolverSession(f"127.0.0.1:{port}")
+    session._ensure_session([nodepool], {"default": catalog})
+    return RemoteScheduler(f"127.0.0.1:{port}", [nodepool],
+                           {"default": catalog}, session=session), session
+
+rs, session = fresh()
+one(rs)  # warm jit + catalog encoding on the server
+session.close()
 best = float("inf")
 for _ in range(max(1, repeats)):
+    rs, session = fresh()
     t0 = time.perf_counter()
-    one()
+    one(rs)
     best = min(best, time.perf_counter() - t0)
+    session.close()
 print(json.dumps({"n_pods": len(pods), "n_its": len(catalog),
                   "seconds": best}), flush=True)
 """
@@ -1238,6 +1263,227 @@ def bench_sidecar():
         }), flush=True)
     finally:
         server.stop(0)
+
+
+_SERVICE_CLIENT = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import numpy as np
+import bench
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.api.nodepool import (NodeClaimTemplate,
+                                        NodeClaimTemplateSpec, NodePool,
+                                        NodePoolSpec)
+from karpenter_tpu.sidecar.client import RemoteScheduler, SolverSession
+
+port = int(os.environ["BENCH_SIDECAR_PORT"])
+n_its = int(os.environ["BENCH_SIDECAR_ITS"])
+tenants = int(os.environ["BENCH_SERVICE_TENANTS"])
+windows = int(os.environ["BENCH_SERVICE_WINDOWS"])
+churn_pct = float(os.environ["BENCH_SERVICE_CHURN_PCT"])
+
+catalog = bench._catalog(n_its)
+
+
+def nodepool():
+    return NodePool(metadata=ObjectMeta(name="default"),
+                    spec=NodePoolSpec(template=NodeClaimTemplate(
+                        spec=NodeClaimTemplateSpec())))
+
+
+def refresh(p, tag):
+    # a deployment replacement: fresh name/uid, SAME spec sub-objects (the
+    # template-dedup tokens keep it on the existing wire template)
+    return Pod(metadata=ObjectMeta(name=f"{p.metadata.name}.{tag}",
+                                   namespace=p.namespace,
+                                   labels=p.metadata.labels,
+                                   annotations=p.metadata.annotations,
+                                   creation_timestamp=
+                                       p.metadata.creation_timestamp),
+               spec=p.spec, container_requests=p.container_requests,
+               init_container_requests=p.init_container_requests,
+               is_daemonset_pod=p.is_daemonset_pod)
+
+
+def drive(name, pods, out):
+    session = SolverSession(f"127.0.0.1:{port}", tenant=name)
+    rs = RemoteScheduler(f"127.0.0.1:{port}", [nodepool()],
+                         {"default": catalog}, session=session)
+    t0 = time.perf_counter()
+    r = rs.solve(pods)
+    t_full = time.perf_counter() - t0
+    assert rs.fallback_reason == "", rs.fallback_reason
+    assert len(pods) - len(r.pod_errors) > 0
+    n_churn = max(1, int(len(pods) * churn_pct / 100.0))
+    times, kinds = [], []
+    for w in range(windows):
+        for k in range(n_churn):
+            i = (w * 9973 + k * 7919) % len(pods)
+            pods[i] = refresh(pods[i], f"{w}.{k}")
+        t0 = time.perf_counter()
+        r = rs.solve(pods)
+        times.append(time.perf_counter() - t0)
+        kinds.append(session.last_encode_kind)
+        assert all(nc.api_nodeclaim is not None for nc in r.new_nodeclaims)
+    # one explicit parity-probed solve OUTSIDE the timed windows (the
+    # probe re-runs the whole solve cold server-side)
+    session.parity_every = 1
+    r = rs.solve(pods)
+    session.parity_every = 0
+    parity = session.last_parity
+    out[name] = {"full": t_full, "times": times, "kinds": kinds,
+                 "parity": parity, "resyncs": session.resyncs}
+    return session, rs, pods
+
+
+# phase A: ONE tenant at headline scale — the warm-delta round-trip line
+pods0 = bench._pods()
+a_stats = {}
+session0, rs0, pods0 = drive("svc-0", pods0, a_stats)
+# the full-resync line: drop every client mirror, re-ship the snapshot
+session0.force_resync()
+t0 = time.perf_counter()
+rs0.solve(pods0)
+t_resync = time.perf_counter() - t0
+
+# phase B: N concurrent tenant clusters sharing the device
+saved = (bench.N_PODS, bench.N_DEPLOYS)
+bench.N_PODS = max(200, saved[0] // max(1, tenants))
+bench.N_DEPLOYS = max(6, saved[1] // max(1, tenants))
+try:
+    tenant_pods = {f"svc-{i + 1}": bench._pods() for i in range(tenants)}
+finally:
+    bench.N_PODS, bench.N_DEPLOYS = saved
+b_stats = {}
+tenant_errors = []
+
+
+def drive_guarded(name, pods):
+    # a bare Thread swallows assertion failures: a dead tenant would just
+    # be missing from phase_b and the bench would report success for the
+    # survivors — collect and re-raise in the main thread instead
+    try:
+        drive(name, pods, b_stats)
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        tenant_errors.append((name, repr(e)))
+
+
+threads = [threading.Thread(target=drive_guarded, args=(name, pods))
+           for name, pods in tenant_pods.items()]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not tenant_errors, tenant_errors
+assert len(b_stats) == tenants, (sorted(b_stats), tenants)
+
+print(json.dumps({
+    "n_pods": len(pods0), "n_its": len(catalog),
+    "phase_a": a_stats["svc-0"], "resync_seconds": t_resync,
+    "phase_b": b_stats,
+}), flush=True)
+"""
+
+
+def bench_service():
+    """ISSUE 8 acceptance line (BENCH_MODE=service): the delta-aware,
+    multi-tenant sidecar. One server process (this one) owns the device;
+    a separate client process drives it — first a single tenant at
+    headline scale (50k x 2k), timing the FULL session bootstrap solve,
+    then warm DELTA windows (a few % of pods replaced per window), then a
+    forced full resync; then N concurrent tenant clusters share the device
+    through the admission queue, each reporting per-tenant p50/p99. Pins
+    the tentpole's claims: (1) the warm delta round trip holds the <=0.5s
+    budget vs the 1.411s full-session baseline; (2) every steady window is
+    DELTA-resident server-side (response-header encode_kind) with zero
+    resyncs; (3) a sampled solve re-runs cold from full state server-side
+    and the decisions are byte-identical; (4) the admission queue serves
+    every tenant (per-tenant wait metrics populated)."""
+    import subprocess
+
+    import numpy as _np
+
+    from karpenter_tpu.sidecar.server import serve
+
+    n_its = N_ITS or 2000
+    _scheduler(n_its).solve(_pods())  # warm the jit cache at bench shapes
+    server, port = serve()
+    try:
+        env = dict(os.environ,
+                   BENCH_REPO=os.path.dirname(os.path.abspath(__file__)),
+                   BENCH_SIDECAR_PORT=str(port),
+                   BENCH_SIDECAR_ITS=str(n_its),
+                   BENCH_PODS=str(N_PODS), BENCH_DEPLOYS=str(N_DEPLOYS),
+                   BENCH_SERVICE_TENANTS=str(SERVICE_TENANTS),
+                   BENCH_SERVICE_WINDOWS=str(SERVICE_WINDOWS),
+                   BENCH_SERVICE_CHURN_PCT=str(SERVICE_CHURN_PCT),
+                   JAX_PLATFORMS="cpu")  # client does no device compute
+        out = subprocess.run(
+            [sys.executable, "-c", _SERVICE_CLIENT], env=env,
+            capture_output=True, text=True, timeout=1500)
+        assert out.returncode == 0, out.stderr[-4000:]
+        stats = json.loads(
+            [l for l in out.stdout.splitlines() if l.startswith("{")][-1])
+    finally:
+        server.stop(0)
+
+    a = stats["phase_a"]
+    warm = a["times"]
+    warm_p50 = float(_np.percentile(warm, 50))
+    warm_p99 = float(_np.percentile(warm, 99))
+    best_warm = min(warm)
+    # delta residency: after the bootstrap solve every window rode the
+    # delta wire AND the server's ProblemState (no cold re-encode)
+    assert all(k == "delta" for k in a["kinds"]), a["kinds"]
+    assert a["resyncs"] == 0, a
+    assert a["parity"] == "byte-identical", a["parity"]
+    assert best_warm <= SERVICE_WARM_BUDGET, (
+        f"warm delta round trip {best_warm:.3f}s exceeds the "
+        f"{SERVICE_WARM_BUDGET}s budget (full session {a['full']:.3f}s)")
+    tenant_p50, tenant_p99 = {}, {}
+    delta_solves = len(warm)
+    parity_samples = 1
+    assert len(stats["phase_b"]) == SERVICE_TENANTS, stats["phase_b"].keys()
+    for name, b in sorted(stats["phase_b"].items()):
+        assert all(k == "delta" for k in b["kinds"]), (name, b["kinds"])
+        assert b["resyncs"] == 0, (name, b)
+        assert b["parity"] == "byte-identical", (name, b["parity"])
+        tenant_p50[name] = round(
+            float(_np.percentile(b["times"], 50)) * 1000, 1)
+        tenant_p99[name] = round(
+            float(_np.percentile(b["times"], 99)) * 1000, 1)
+        delta_solves += len(b["times"])
+        parity_samples += 1
+    # the admission queue saw every tenant: per-tenant wait metrics exist
+    # (the server runs in THIS process, so its registry is readable here)
+    from karpenter_tpu.metrics.registry import SIDECAR_QUEUE_WAIT
+    for name in stats["phase_b"]:
+        assert SIDECAR_QUEUE_WAIT.count({"tenant": name}) > 0, (
+            f"no admission-queue samples for tenant {name}")
+    print(json.dumps({
+        "metric": (f"sidecar service: warm DELTA solve round trip, "
+                   f"{stats['n_pods']} pods x {stats['n_its']} instance "
+                   f"types, then {SERVICE_TENANTS} concurrent tenant "
+                   f"clusters sharing one device ({SERVICE_WINDOWS} "
+                   f"windows, {SERVICE_CHURN_PCT}% pod churn/window; "
+                   "delta-resident, parity-sampled vs cold full-state "
+                   "solve, client in a separate process)"),
+        "value": round(stats["n_pods"] / warm_p50, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(stats["n_pods"] / warm_p50 / 100.0, 2),
+        "seconds": round(warm_p50, 3),
+        "warm_p50_ms": round(warm_p50 * 1000, 1),
+        "warm_p99_ms": round(warm_p99 * 1000, 1),
+        "best_warm_seconds": round(best_warm, 3),
+        "full_session_seconds": round(a["full"], 3),
+        "resync_seconds": round(stats["resync_seconds"], 3),
+        "tenants": SERVICE_TENANTS,
+        "tenant_p50_ms": tenant_p50,
+        "tenant_p99_ms": tenant_p99,
+        "delta_solves": delta_solves,
+        "parity_samples": parity_samples,
+        "resyncs": 0,
+    }), flush=True)
 
 
 def bench_mesh_local():
@@ -1456,6 +1702,9 @@ def main():
     if MODE == "sidecar":
         bench_sidecar()
         return
+    if MODE == "service":
+        bench_service()
+        return
     if MODE == "minvalues":
         bench_minvalues()
         return
@@ -1478,8 +1727,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
-            "mesh-headroom|sidecar|minvalues|faults|replay|drought|churn|"
-            "trace")
+            "mesh-headroom|sidecar|service|minvalues|faults|replay|drought|"
+            "churn|trace")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

@@ -321,19 +321,46 @@ FLIGHTREC_DROPPED = REGISTRY.counter(
     "Decision records dropped (ring eviction or capture failure)",
     ("reason",))
 
+# -- bounded tenant label ---------------------------------------------------
+# The sidecar serves many tenant clusters from one process; tenant-labeled
+# series (queue depth/wait, phase histograms) must stay bounded no matter
+# what tenant names clients send. First-come tenants keep their name; past
+# the cap every new tenant maps to the shared overflow value, so a
+# tenant-per-request caller can't explode series cardinality (the PR-7
+# max_series cap then never has to silently drop real phase series).
+
+TENANT_LABEL_CAP = 32
+TENANT_OVERFLOW = "_other"
+_TENANT_LABELS: set = set()
+
+
+def tenant_label(tenant) -> str:
+    """Bounded tenant label value (see TENANT_LABEL_CAP above)."""
+    t = str(tenant)
+    if t in _TENANT_LABELS:
+        return t
+    if len(_TENANT_LABELS) < TENANT_LABEL_CAP:
+        _TENANT_LABELS.add(t)
+        return t
+    return TENANT_OVERFLOW
+
+
 # -- pass-level tracing + end-to-end SLO layer (obs/) ----------------------
 
 SOLVER_PHASE_DURATION = REGISTRY.histogram(
     "karpenter_solver_phase_duration_seconds",
     "Per-phase solver wall clock, derived from the pass tracer's span data "
     "(phase = span name: encode.catalog, encode.groups, encode.nodes, "
-    "device.upload, compile, device.execute, pack, materialize, ...)",
-    ("phase", "encode_kind"),
+    "device.upload, compile, device.execute, pack, materialize, ...); "
+    "sidecar-served solves add a bounded tenant label",
+    ("phase", "encode_kind", "tenant"),
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5, 5.0, 10.0),
-    # phases are a fixed vocabulary x {cold, delta, ""}; the cap is a
-    # backstop against a dynamic span name leaking into the label
-    max_series=256)
+    # phases are a fixed vocabulary (~40 span names) x {cold, delta, ""} x
+    # bounded tenants (TENANT_LABEL_CAP + overflow + the in-process "") —
+    # worst case ~4k legitimate series, so the cap is sized as a backstop
+    # against a DYNAMIC span name leaking in, not a lid real tenants hit
+    max_series=8192)
 PODS_TIME_TO_SCHEDULE = REGISTRY.histogram(
     "karpenter_pods_time_to_schedule_seconds",
     "First seen pending to capacity decision (NodeClaim created or "
@@ -351,3 +378,24 @@ SERIES_DROPPED = REGISTRY.counter(
     "karpenter_metrics_series_dropped_total",
     "Label sets dropped by a metric's cardinality cap (max_series)",
     ("metric",))
+
+# -- multi-tenant solver sidecar (sidecar/server.py admission layer) -------
+
+SIDECAR_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_sidecar_queue_depth",
+    "Solve requests waiting in the sidecar's admission queue, per tenant "
+    "(bounded tenant label)",
+    ("tenant",), max_series=64)
+SIDECAR_QUEUE_WAIT = REGISTRY.histogram(
+    "karpenter_sidecar_queue_wait_seconds",
+    "Admission-queue wait before a sidecar solve reaches the device, per "
+    "tenant (bounded tenant label)",
+    ("tenant",),
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0),
+    max_series=64)
+SIDECAR_RESYNCS = REGISTRY.counter(
+    "karpenter_sidecar_session_resyncs_total",
+    "Delta-session resync triggers: content-digest mismatches, LRU/idle "
+    "session evictions, unknown-session hits from stale clients",
+    ("reason",), max_series=16)

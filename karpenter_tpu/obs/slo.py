@@ -58,16 +58,19 @@ def parse_budgets(raw: str) -> Dict[str, float]:
 
 
 class Breach:
-    __slots__ = ("slo", "trace_id", "duration", "budget", "at", "dump_path")
+    __slots__ = ("slo", "trace_id", "duration", "budget", "at", "dump_path",
+                 "tenant")
 
     def __init__(self, slo: str, trace_id: str, duration: float,
-                 budget: float, at: float, dump_path: str):
+                 budget: float, at: float, dump_path: str,
+                 tenant: str = ""):
         self.slo = slo
         self.trace_id = trace_id
         self.duration = duration
         self.budget = budget
         self.at = at
         self.dump_path = dump_path
+        self.tenant = tenant
 
 
 class SLOWatcher:
@@ -99,6 +102,12 @@ class SLOWatcher:
 
     def observe(self, trace) -> None:
         """Called by the tracer for every completed PassTrace."""
+        from ..metrics.registry import tenant_label
+        # sidecar-served passes stamp a tenant on the root span: rolling
+        # windows and breaches key on (span, tenant) so /debug/slo can
+        # answer "whose p99 moved" — in-process passes key on tenant ""
+        tenant = trace.root.attrs.get("tenant")
+        tenant = "" if tenant is None else tenant_label(tenant)
         with self._lock:
             if trace.trace_id in self._seen_set:
                 return
@@ -113,7 +122,8 @@ class SLOWatcher:
                 budget = self.budgets.get(sp.name)
                 if budget is not None:
                     self._durations.setdefault(
-                        sp.name, deque(maxlen=WINDOW)).append(sp.duration)
+                        (sp.name, tenant),
+                        deque(maxlen=WINDOW)).append(sp.duration)
                     cur = worst.get(sp.name)
                     if cur is None or sp.duration > cur.duration:
                         worst[sp.name] = sp
@@ -124,14 +134,15 @@ class SLOWatcher:
             # one dump per breaching pass, shared by every breached budget
             dump_path = self._dump(trace)
             for sp, budget in breached:
-                self._breach(trace, sp, budget, dump_path)
+                self._breach(trace, sp, budget, dump_path, tenant)
 
-    def _breach(self, trace, sp, budget: float, dump_path: str) -> None:
+    def _breach(self, trace, sp, budget: float, dump_path: str,
+                tenant: str = "") -> None:
         from ..logging import get_logger
         from ..metrics.registry import SLO_BREACHES
         SLO_BREACHES.inc({"slo": sp.name})
         breach = Breach(sp.name, trace.trace_id, sp.duration, budget,
-                        self.clock.now(), dump_path)
+                        self.clock.now(), dump_path, tenant=tenant)
         self.breaches.append(breach)
         if self.recorder is not None:
             from ..events import catalog as events_catalog
@@ -177,12 +188,19 @@ class SLOWatcher:
         s = sorted(values)
         return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.999999))]
 
-    def snapshot(self) -> dict:
+    def snapshot(self, tenant: Optional[str] = None) -> dict:
+        """Budgets with rolling p50/p99 plus recent breaches. With no
+        `tenant`, windows aggregate across every tenant (the pre-tenant
+        report shape, breaches annotated); with one, both views narrow to
+        that tenant's samples/breaches."""
         with self._lock:
             durations = {k: list(v) for k, v in self._durations.items()}
         spans = {}
         for name, budget in sorted(self.budgets.items()):
-            vals = durations.get(name, [])
+            vals: List[float] = []
+            for (span, t), samples in durations.items():
+                if span == name and (tenant is None or t == tenant):
+                    vals.extend(samples)
             spans[name] = {
                 "budget_seconds": budget,
                 "observed": len(vals),
@@ -191,9 +209,11 @@ class SLOWatcher:
             }
         return {
             "budgets": spans,
+            "tenant": tenant,
             "breaches": [
                 {"slo": b.slo, "trace_id": b.trace_id,
                  "duration": round(b.duration, 6), "budget": b.budget,
-                 "at": b.at, "dump": b.dump_path}
-                for b in list(self.breaches)],
+                 "at": b.at, "dump": b.dump_path, "tenant": b.tenant}
+                for b in list(self.breaches)
+                if tenant is None or b.tenant == tenant],
         }

@@ -245,12 +245,19 @@ class Tracer:
     @staticmethod
     def _derive_metrics(trace: PassTrace) -> None:
         """Per-phase histograms FROM the span data — one measurement, two
-        views. encode_kind labels ride from the root attrs (annotate())."""
-        from ..metrics.registry import SOLVER_PHASE_DURATION
+        views. encode_kind labels ride from the root attrs (annotate());
+        sidecar-served passes stamp a tenant on the root, which rides as a
+        BOUNDED extra label (in-process passes keep the two-label series
+        they always had, so existing dashboards/queries see no change)."""
+        from ..metrics.registry import SOLVER_PHASE_DURATION, tenant_label
         kind = str(trace.root.attrs.get("encode_kind", ""))
+        labels = {"phase": "", "encode_kind": kind}
+        tenant = trace.root.attrs.get("tenant")
+        if tenant is not None:
+            labels["tenant"] = tenant_label(tenant)
         for sp in trace.spans:
-            SOLVER_PHASE_DURATION.observe(
-                sp.duration, {"phase": sp.name, "encode_kind": kind})
+            labels["phase"] = sp.name
+            SOLVER_PHASE_DURATION.observe(sp.duration, dict(labels))
 
     # -- trace context -------------------------------------------------------
 

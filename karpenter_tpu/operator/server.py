@@ -228,6 +228,13 @@ def _debug_traces_factory(tracer):
             traces = [t]
         else:
             traces = tracer.traces(n)
+        # multi-tenant narrowing: sidecar-served passes stamp tenant +
+        # session onto the root span; ?tenant= / ?session= filter on them
+        for key in ("tenant", "session"):
+            want = query.get(key, [""])[0]
+            if want:
+                traces = [t for t in traces
+                          if str(t.root.attrs.get(key, "")) == want]
         if query.get("format", [""])[0] == "chrome":
             from ..obs.tracer import dumps_chrome
             return 200, "application/json", dumps_chrome(traces)
@@ -241,13 +248,15 @@ def _debug_traces_factory(tracer):
 def _debug_slo_factory(slo):
     """The SLO watcher's operator surface: configured budgets with their
     rolling p50/p99, and the recent breaches (trace_id + flight-recorder
-    dump path) — the first stop when karpenter_slo_breaches_total moves."""
-    def fn():
+    dump path) — the first stop when karpenter_slo_breaches_total moves.
+    ?tenant= narrows the windows and breaches to one sidecar tenant."""
+    def fn(query: dict):
         import json
         if slo is None:
             return 404, "text/plain", "no SLO watcher attached"
+        tenant = query.get("tenant", [""])[0] or None
         return (200, "application/json",
-                json.dumps(slo.snapshot(), indent=1) + "\n")
+                json.dumps(slo.snapshot(tenant=tenant), indent=1) + "\n")
     return fn
 
 

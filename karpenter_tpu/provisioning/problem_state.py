@@ -267,11 +267,14 @@ class ProblemState:
         """cluster_topology_counts with a per-group memo proven by
         Cluster.topo_revision: the scheduled-pod selector scans run only
         for groups whose counts the revision can no longer vouch for."""
-        excl = {p.uid for p in pods}
         cl = getattr(ts.cluster, "cluster", None)
         rev = getattr(cl, "topo_revision", None)
         if rev is None:
-            return ts.cluster_topology_counts(groups, zone_names, excl)
+            return ts.cluster_topology_counts(groups, zone_names,
+                                              {p.uid for p in pods})
+        # (the 50k-element uid exclusion set is only consumed by the
+        # selector scans — built in the miss branch so fully-memoized
+        # solves never pay it)
         # the memo excludes scheduled batch pods by identity (deleting-node
         # pods are both scheduled and in the batch), so the token carries
         # them; pending pods never count either way
@@ -290,6 +293,7 @@ class ProblemState:
                 # the wiped hit entries dangling for the assembly below
                 self._topo_memo = {}
                 miss = list(range(len(groups)))
+            excl = {p.uid for p in pods}
             sub_izc, sub_exist, sub_host = ts.cluster_topology_counts(
                 [groups[i] for i in miss], zone_names, excl)
             for j, i in enumerate(miss):

@@ -226,6 +226,29 @@ def catalog_cache_token(nodepools, instance_types) -> tuple:
     return _catalog_cache_key(catalog)
 
 
+def catalog_encoding_pin(token):
+    """Strong reference to the live CatalogEncoding for `token` (None when
+    nothing is cached yet). Multi-tenant sidecar sessions pin their
+    tenant's encoding: vocab IDENTITY gates every ProblemState row cache,
+    so an LRU eviction forced by ANOTHER tenant's catalog traffic would
+    silently demote this tenant's next solve to a cold re-encode."""
+    with _CATALOG_CACHE_LOCK:
+        return _CATALOG_CACHE.get(token)
+
+
+def restore_catalog_encoding(token, ce) -> None:
+    """Reinstate a pinned encoding the LRU evicted under other tenants'
+    traffic — the PINNED object, never a re-encode, so vocab identity (and
+    with it every delta cache keyed on it) survives. May briefly push the
+    cache past its LRU cap; bounded by the sidecar's session cap."""
+    if ce is None:
+        return
+    with _CATALOG_CACHE_LOCK:
+        if token not in _CATALOG_CACHE:
+            _CATALOG_CACHE[token] = ce
+        _CATALOG_CACHE.move_to_end(token)
+
+
 class TensorNodeClaim:
     """A launch decision produced by the tensor packer; interface-compatible
     with provisioning.scheduler.InFlightNodeClaim for downstream consumers."""

@@ -12,6 +12,7 @@ specific IDL.
 from __future__ import annotations
 
 import json
+import threading
 from typing import Dict, List, Optional
 
 from ..api import labels as api_labels
@@ -410,10 +411,175 @@ def encode_pod_rows(pods):
     return templates, tmpl_idx, ts
 
 
+# -- delta session protocol (wire v1) ----------------------------------------
+#
+# A steady-state SolveSession ships only what changed since the session's
+# last ACKED solve:
+#
+#   header["v"]             delta schema version (absent = legacy full-batch)
+#   header["templates_new"] [[tid, template_dict], ...] — the session's
+#                           template table is persistent and append-only;
+#                           ids are assigned client-side in registration
+#                           order and MUST be contiguous
+#   blobs["pod_remove"]     u32 row indices into the server's CURRENT batch
+#                           (strictly ascending), applied first
+#   blobs["pod_add_tid"]/["pod_add_ts"]
+#                           appended rows: template id + creation timestamp
+#   header["pods_full"]     full batch resync: drop every row, then apply
+#                           the adds (the template table survives)
+#   header["state_upsert"]/["state_remove"]/["state_revs"]
+#                           node deltas as before, plus the client's opaque
+#                           per-node revision token (StateNode identity +
+#                           revision) so the digest can cover node state
+#                           without re-serializing unchanged nodes
+#   header["daemonset"]/["ds_token"], header["cluster"]/["cluster_token"]
+#                           content snapshots sent only on token change
+#   header["digest"]        content digest of the client's view of the
+#                           POST-apply session state; the server recomputes
+#                           it from its own state and aborts with
+#                           FAILED_PRECONDITION on mismatch — the client
+#                           falls back to a full snapshot (resync)
+#
+# Decisions stay byte-identical to a fresh full-state solve by contract:
+# the server solves from its reconstructed state, which digest-verifies
+# against the client's, and `header["parity_check"]` samples re-solve the
+# identical state cold (no ProblemState) server-side and compare canonical
+# decision digests (flightrec.decision_digest) — the DEVIATIONS-19 audit
+# shape applied to the wire.
+
+DELTA_SCHEMA_VERSION = 1
+
+
+class DeltaVersionError(ValueError):
+    """An unknown delta-session schema version: refuse loudly instead of
+    misparsing half-understood delta fields into a silently-wrong solve
+    (the flightrec TraceVersionError contract, applied to the wire)."""
+
+
+class DigestMismatchError(ValueError):
+    """Server/client session state diverged (the content-digest handshake
+    failed): the client must resync with a full snapshot."""
+
+
+def check_delta_version(header: dict) -> None:
+    v = header.get("v")
+    if v != DELTA_SCHEMA_VERSION:
+        raise DeltaVersionError(
+            f"unknown delta session schema version {v!r} (this end speaks "
+            f"v{DELTA_SCHEMA_VERSION}); refusing to guess at the fields")
+
+
+def template_content_key(d: dict) -> str:
+    """Canonical content key of one pod template dict — the identity the
+    persistent template table dedups on. Identity-keyed client templates
+    that carry equal content collapse onto one server id here."""
+    return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+
+def templates_digest(keys) -> str:
+    """Running digest of the session's template table (content keys in id
+    order): covers the per-id CONTENT, which the row digest alone cannot —
+    a client/server disagreement about what template 7 means would
+    otherwise solve the wrong specs with a clean row digest."""
+    from . import wire
+    return wire.content_digest(*keys)
+
+
+def batch_digest(tids, ts, tmpl_digest: str, state_tokens: dict,
+                 ds_token: str, cluster_token: str) -> str:
+    """Content digest of the full delta-session state: pod rows (template
+    id + timestamp columns), the template-table digest, the per-node
+    revision tokens, and the daemonset/cluster snapshot tokens."""
+    import numpy as _np
+
+    from . import wire
+    return wire.content_digest(
+        _np.asarray(tids, dtype="<u4").tobytes(),
+        _np.asarray(ts, dtype="<f8").tobytes(),
+        tmpl_digest,
+        ";".join(f"{name}={tok}" for name, tok
+                 in sorted(state_tokens.items())),
+        str(ds_token), str(cluster_token))
+
+
+def diff_pod_rows(prev_rows, new_rows):
+    """Client-side pod-batch diff. Rows are (uid, tid, ts) tuples; returns
+    (removals, additions, merged) where `removals` are strictly-ascending
+    indices into prev_rows, `additions` are the new rows to append, and
+    `merged` is the post-apply server batch order the client must mirror:
+    survivors in previous order, then additions. A pod whose template or
+    timestamp changed is a remove+add."""
+    prev_index = {r[0]: i for i, r in enumerate(prev_rows)}
+    keep = set()
+    additions = []
+    for r in new_rows:
+        i = prev_index.get(r[0])
+        if i is not None and prev_rows[i][1] == r[1] \
+                and prev_rows[i][2] == r[2]:
+            keep.add(i)
+        else:
+            additions.append(r)
+    removals = [i for i in range(len(prev_rows)) if i not in keep]
+    merged = [prev_rows[i] for i in sorted(keep)] + additions
+    return removals, additions, merged
+
+
+def apply_pod_delta(rows, header: dict, blobs) -> list:
+    """Server-side pod-batch delta application, mirroring diff_pod_rows:
+    removals against the CURRENT row indices first, then appends. `rows`
+    is the session's [(tid, ts)] list; returns the new list. Raises
+    ValueError on malformed deltas (out-of-range/unsorted removals,
+    mismatched add columns) — the caller maps that to INVALID_ARGUMENT."""
+    from . import wire
+    if header.get("pods_full"):
+        rows = []
+    elif "pod_remove" in blobs:
+        removes = wire.unpack_u32(blobs["pod_remove"])
+        n = len(rows)
+        keep = [True] * n
+        prev = -1
+        for i in removes.tolist():
+            if i <= prev or i >= n:
+                raise ValueError(
+                    f"pod_remove index {i} invalid for a batch of {n} "
+                    "(indices must be strictly ascending and in range)")
+            prev = i
+            keep[i] = False
+        rows = [r for r, k in zip(rows, keep) if k]
+    else:
+        rows = list(rows)
+    if "pod_add_tid" in blobs:
+        tids = wire.unpack_u32(blobs["pod_add_tid"]).tolist()
+        tss = wire.unpack_f64(blobs["pod_add_ts"]).tolist()
+        if len(tids) != len(tss):
+            raise ValueError(
+                f"pod_add column length mismatch: {len(tids)} template ids "
+                f"vs {len(tss)} timestamps")
+        rows.extend(zip(tids, tss))
+    return rows
+
+
 _SHARED_POD_STATUS = None
 
+# interned "r<row>" identity strings: the delta session renumbers up to the
+# whole batch after a removal, and 50k fresh f-string allocations per solve
+# are measurable on the warm path. Grows to the largest batch seen; growth
+# is locked because concurrent solves (serve(max_concurrent>1)) share it —
+# an interleaved grow would misplace an entry in the table FOREVER.
+_ROW_STRS: List[str] = []
+_ROW_STRS_LOCK = threading.Lock()
 
-def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
+
+def _row_strs(n: int) -> List[str]:
+    if len(_ROW_STRS) < n:
+        with _ROW_STRS_LOCK:
+            while len(_ROW_STRS) < n:
+                _ROW_STRS.append(f"r{len(_ROW_STRS)}")
+    return _ROW_STRS
+
+
+def build_wire_pods(templates: List[dict], tmpl_idx, ts,
+                    proto_cache: Optional[list] = None) -> "List[Pod]":
     """Server-side fast rebuild of a columnar pod batch.
 
     One full prototype Pod is decoded per template; every row then shares
@@ -426,13 +592,25 @@ def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
     reference the batch by row index, and real identities never ride the
     wire (pending pods can't be topology-counted server-side anyway:
     topology.py ignored_for_topology drops node-less pods)."""
-    global _SHARED_POD_STATUS
-    from ..api.objects import PodStatus
-    if _SHARED_POD_STATUS is None:
-        _SHARED_POD_STATUS = PodStatus()
-    status = _SHARED_POD_STATUS
-    protos = []
-    for t in templates:
+    protos = wire_pod_protos(templates, proto_cache)
+    # numpy iteration yields boxed scalars; plain lists are ~3x faster here.
+    # Callers that already hold the list form (server prebucketing) pass it
+    # directly so the 50k-row conversion happens once.
+    tmpl_list = tmpl_idx.tolist() if hasattr(tmpl_idx, "tolist") else tmpl_idx
+    ts_list = ts.tolist() if hasattr(ts, "tolist") else ts
+    out: list = []
+    append_wire_pods(protos, tmpl_list, ts_list, out)
+    return out
+
+
+def wire_pod_protos(templates: List[dict],
+                    proto_cache: Optional[list] = None) -> list:
+    """Decode one prototype Pod per template. `proto_cache` is the
+    delta-session fast path: the session's template table is append-only,
+    so prototypes decoded once live for the session and only NEW templates
+    pay pod_from_dict here."""
+    protos = proto_cache if proto_cache is not None else []
+    for t in templates[len(protos):]:
         full = dict(t)
         full.update(name="", uid="", creation_timestamp=0.0, node_name="")
         pr = pod_from_dict(full)
@@ -441,21 +619,30 @@ def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
             # store); consumed by TensorScheduler._volume_limit_state
             pr.spec._volume_drivers = dict(t["volume_drivers"])
         protos.append(pr)
+    return protos
+
+
+def append_wire_pods(protos: list, tmpl_list, ts_list, out: list) -> None:
+    """Append one wire Pod per (template id, timestamp) row to `out`,
+    numbering rows from len(out) — build_wire_pods' row loop, reusable for
+    the delta session's incremental batch maintenance (only ADDED rows are
+    built; survivors keep their objects, see renumber_wire_pods)."""
+    global _SHARED_POD_STATUS
+    from ..api.objects import PodStatus
+    if _SHARED_POD_STATUS is None:
+        _SHARED_POD_STATUS = PodStatus()
+    status = _SHARED_POD_STATUS
     proto_parts = [(pr.spec, pr.metadata.namespace, pr.metadata.labels,
                     pr.metadata.annotations, pr.container_requests,
                     pr.init_container_requests, pr.is_daemonset_pod)
                    for pr in protos]
-    out = []
     meta_new = ObjectMeta.__new__
     pod_new = Pod.__new__
-    # numpy iteration yields boxed scalars; plain lists are ~3x faster here.
-    # Callers that already hold the list form (server prebucketing) pass it
-    # directly so the 50k-row conversion happens once.
-    tmpl_list = tmpl_idx.tolist() if hasattr(tmpl_idx, "tolist") else tmpl_idx
-    ts_list = ts.tolist() if hasattr(ts, "tolist") else ts
-    for i, (t, created) in enumerate(zip(tmpl_list, ts_list)):
+    i = len(out)
+    rstr = _row_strs(i + len(tmpl_list))
+    for t, created in zip(tmpl_list, ts_list):
         spec, ns, labels, annotations, reqs, ireqs, is_ds = proto_parts[t]
-        uid = f"r{i}"
+        uid = rstr[i]
         m = meta_new(ObjectMeta)
         m.__dict__ = {
             "name": uid, "namespace": ns, "uid": uid, "labels": labels,
@@ -468,7 +655,22 @@ def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
             "container_requests": reqs, "init_container_requests": ireqs,
             "is_daemonset_pod": is_ds, "_row": i}
         out.append(p)
-    return out
+        i += 1
+
+
+def renumber_wire_pods(pods: list) -> None:
+    """Restore the row-index invariant (`_row` == position, uid/name ==
+    "r<row>") after removals shifted survivors — identity on the session
+    wire is synthetic and positional, so a shifted pod must take its new
+    row's identity or result/error row references would point past it."""
+    rstr = _row_strs(len(pods))
+    for i, p in enumerate(pods):
+        if p.__dict__["_row"] != i:
+            p.__dict__["_row"] = i
+            uid = rstr[i]
+            m = p.metadata.__dict__
+            m["name"] = uid
+            m["uid"] = uid
 
 
 # -- row-based results (session protocol) -----------------------------------
@@ -476,7 +678,7 @@ def build_wire_pods(templates: List[dict], tmpl_idx, ts) -> "List[Pod]":
 
 def encode_solve_response_rows(results, fallback_reason: str,
                                it_idx_by_id: dict, it_idx_by_name: dict,
-                               ) -> bytes:
+                               extra_header: Optional[dict] = None) -> bytes:
     """Interned, row-referencing response frame. Claims from one packer
     cohort share everything but their pods, so the full NodeClaim shape
     (labels/taints/requirements + the surviving instance-type set as catalog
@@ -563,6 +765,8 @@ def encode_solve_response_rows(results, fallback_reason: str,
         "errors": errors,
         "its_u16": its_u16,
     }
+    if extra_header:
+        header.update(extra_header)
     return wire.pack(header, {
         "rows": wire.pack_u32(all_rows),
         "its": (wire.pack_u16(all_its) if its_u16
@@ -880,11 +1084,12 @@ def union_catalog(instance_types: Dict[str, List[InstanceType]]) -> list:
 
 
 def encode_session_request(nodepools,
-                           instance_types: Dict[str, List[InstanceType]]
-                           ) -> bytes:
+                           instance_types: Dict[str, List[InstanceType]],
+                           tenant: str = "") -> bytes:
     """Session bootstrap: the heavy slow-changing inputs, sent once and then
     referenced by session id (state nodes/daemonset pods ride as deltas on
-    each solve instead)."""
+    each solve instead). `tenant` labels the session for the server's
+    admission fairness and per-tenant metrics."""
     catalog: Dict[str, dict] = {}
     per_pool: Dict[str, List[str]] = {}
     for pool, its in instance_types.items():
@@ -897,6 +1102,8 @@ def encode_session_request(nodepools,
         "catalog": list(catalog.values()),
         "pool_instance_types": per_pool,
     }
+    if tenant:
+        payload["tenant"] = tenant
     return json.dumps(payload).encode()
 
 
@@ -906,7 +1113,8 @@ def decode_session_request(data: bytes):
     instance_types = {pool: [catalog[n] for n in names]
                       for pool, names in d["pool_instance_types"].items()}
     return ([nodepool_from_dict(np) for np in d["nodepools"]],
-            instance_types)
+            instance_types,
+            d.get("tenant", ""))
 
 
 def encode_solve_request(nodepools, instance_types: Dict[str, List[InstanceType]],

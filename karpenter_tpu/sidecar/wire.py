@@ -87,3 +87,17 @@ def unpack_strs(blob) -> List[str]:
     if len(blob) == 0:
         return []
     return bytes(blob).decode().split(_SEP)
+
+
+def content_digest(*parts) -> str:
+    """sha256 hex over byte/str parts — the delta-session handshake digest
+    primitive. Both ends of the wire hash through this ONE function so a
+    representation tweak can never make the two sides disagree about
+    identical state (it would instead fail loudly as a permanent mismatch
+    in tests)."""
+    import hashlib
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode() if isinstance(p, str) else bytes(p))
+        h.update(b"\x1f")
+    return h.hexdigest()

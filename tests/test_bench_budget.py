@@ -442,6 +442,66 @@ class TestChurnBudget:
         assert "churn" in msg and "drought" in msg and "replay" in msg
 
 
+class TestServiceBudget:
+    """ISSUE 8 guard: the BENCH_MODE=service line at test scale. The 0.5s
+    warm-delta round-trip budget is asserted at 50k x 2k inside
+    bench_service; here the bench's own shape runs small (2k pods x the
+    kwok 144-type catalog, 2 tenants) so tier-1 pins what a regression
+    would trip: every timed window DELTA-resident server-side with zero
+    resyncs (asserted in-bench from the response headers), the sampled
+    byte-identical cold-parity probes, per-tenant admission metrics, and a
+    wall-clock budget a return of full-batch re-encodes (or a resync loop)
+    would blow."""
+
+    BUDGET_SECONDS = 240.0
+    WARM_BUDGET_SECONDS = 20.0
+
+    def test_service_bench_shape_within_budget(self, capsys):
+        import json
+
+        saved = (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+                 bench.SERVICE_TENANTS, bench.SERVICE_WINDOWS,
+                 bench.SERVICE_WARM_BUDGET)
+        (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+         bench.SERVICE_TENANTS, bench.SERVICE_WINDOWS,
+         bench.SERVICE_WARM_BUDGET) = (
+            N_PODS, N_DEPLOYS, 144, 2, 3, self.WARM_BUDGET_SECONDS)
+        try:
+            t0 = time.perf_counter()
+            bench.bench_service()
+            elapsed = time.perf_counter() - t0
+        finally:
+            (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+             bench.SERVICE_TENANTS, bench.SERVICE_WINDOWS,
+             bench.SERVICE_WARM_BUDGET) = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"service bench took {elapsed:.1f}s at {N_PODS} pods — the "
+            "delta wire likely fell back to full-batch re-encodes")
+        line = json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert line["unit"] == "pods/sec"
+        assert "sidecar service" in line["metric"]
+        # delta residency + handshake health, from the in-bench asserts'
+        # reported evidence: every timed window rode the delta wire, no
+        # session ever resynced, every parity probe came back identical
+        assert line["resyncs"] == 0
+        assert line["delta_solves"] == 3 + 2 * 3  # phase A + B windows
+        assert line["parity_samples"] == 3        # 1 + one per tenant
+        assert line["tenants"] == 2
+        assert line["seconds"] < self.WARM_BUDGET_SECONDS
+        assert line["full_session_seconds"] > 0
+        assert line["resync_seconds"] > 0
+
+    def test_bench_mode_service_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "service" in m.group(0), \
+            "BENCH_MODE=service missing from the unknown-mode error list"
+
+
 @pytest.mark.parametrize("kind", [0, 1, 2, 4, 5, 6, 7, 8])
 def test_node_count_parity_vs_host_oracle_per_kind(kind):
     pods = [p for p in _mix()
