@@ -59,6 +59,12 @@ CHURN_WINDOWS = int(os.environ.get("BENCH_CHURN_WINDOWS", "20"))
 CHURN_ARRIVALS = int(os.environ.get("BENCH_CHURN_ARRIVALS", "600"))
 CHURN_PODS_PER_NODE = int(os.environ.get("BENCH_CHURN_PODS_PER_NODE", "10"))
 CHURN_MIN_RATE = float(os.environ.get("BENCH_CHURN_MIN_RATE", "1000"))
+# BENCH_MODE=sim knobs: clip the mixed-day scenario to the first N
+# simulated seconds (0 = the full 24 h; TestSimBudget clips for tier-1),
+# and the wall-clock compression floor the replay must hold
+SIM_CLIP_SECONDS = float(os.environ.get("BENCH_SIM_CLIP", "0"))
+SIM_MIN_COMPRESSION = float(os.environ.get("BENCH_SIM_MIN_COMPRESSION",
+                                           "100"))
 # minValues benchmark line (the reference benchmarks minValues explicitly,
 # scheduling_benchmark_test.go:97-101): opt-in via BENCH_MINVALUES=1 in the
 # default run, or BENCH_MODE=minvalues alone; requirement floor knob below
@@ -704,6 +710,120 @@ def bench_churn():
         "nodes_churned": churned_total,
         "warm_restored_groups": ps.stats["warm_restored_groups"],
         "delta_encodes": ps.stats["delta_encodes"],
+    }), flush=True)
+
+
+def bench_sim():
+    """ISSUE 9 acceptance line (BENCH_MODE=sim): replay the seeded
+    mixed-day scenario — rolling deploy + traffic spike + spot-reclaim
+    wave + zonal outage/drought with recovery + PDB-constrained drains +
+    an induced SLO-breach window — through the FULL operator loop
+    (provisioner, disruption controller, nodeclaim lifecycle, termination
+    drains, kwok fleet under ChaosCloudProvider) on the accelerated
+    FakeClock, twice with the same seed. Pins the tentpole's claims:
+
+    (1) COMPRESSION — the 24h-equivalent timeline replays at >=
+        SIM_MIN_COMPRESSION x wall-clock (default 100x);
+    (2) DETERMINISM — the second run's event-ledger digest is
+        byte-identical to the first (same seed + scenario => same run);
+    (3) SLO REPORT — p99 time-to-schedule, cost per pod-hour, and
+        disruption churn all come out finite and positive;
+    (4) BREACH PATH — the induced SLO window yields EXACTLY ONE
+        flight-recorder dump whose records join the ledger's solve
+        entries by trace_id."""
+    import math
+    import shutil
+    import tempfile
+
+    import karpenter_tpu.sim as sim_pkg
+    from karpenter_tpu.sim import FleetSimulator, load_scenario
+
+    scenario_path = os.path.join(os.path.dirname(sim_pkg.__file__),
+                                 "scenarios", "mixed-day.yaml")
+
+    def load():
+        sc = load_scenario(scenario_path)
+        if SIM_CLIP_SECONDS:
+            # clip only: a value past the file's own duration must not
+            # EXTEND the run with dead timeline, which would inflate the
+            # headline compression number at near-zero wall cost
+            clip = min(SIM_CLIP_SECONDS, sc.duration)
+            sc.events = [e for e in sc.events if e.at <= clip]
+            sc.duration = clip
+        return sc
+
+    def run_once():
+        dumps = tempfile.mkdtemp(prefix="bench-sim-dumps-")
+        sim = FleetSimulator(load(), flightrec_dir=dumps)
+        return sim, sim.run(), dumps
+
+    # the exactly-one-breach asserts need the FULL timeline (the induced
+    # slo window AND the canary pass inside it); any clip short of the
+    # scenario's own duration may cut either, so the threshold is read
+    # from the file, never hardcoded against its current event times
+    clipped = bool(SIM_CLIP_SECONDS) and \
+        SIM_CLIP_SECONDS < load_scenario(scenario_path).duration
+    sim1, r1, dumps1 = run_once()
+    sim2, r2, dumps2 = run_once()
+    try:
+        assert r1["ledger_digest"] == r2["ledger_digest"], (
+            "same seed + scenario produced different ledgers:\n"
+            f"  run1 {r1['ledger_digest']}\n  run2 {r2['ledger_digest']}")
+        assert r1["compression"] >= SIM_MIN_COMPRESSION, (
+            f"compression {r1['compression']:.0f}x under the "
+            f"{SIM_MIN_COMPRESSION:.0f}x floor "
+            f"({r1['sim_seconds']:.0f}s sim in {r1['wall_seconds']:.1f}s)")
+        tts = r1["time_to_schedule"]
+        assert tts["samples"] > 0
+        for v in (tts["p50_s"], tts["p99_s"], r1["cost"]["per_pod_hour"],
+                  r1["cost"]["pod_hours"]):
+            assert math.isfinite(v) and v > 0, r1
+        churn = r1["churn"]
+        assert churn["claims_created"] > 0
+        assert math.isfinite(churn["nodes_per_hour"])
+        if not clipped:
+            # the induced nanosecond provisioner.pass window covers exactly
+            # one canary pass => exactly one breach, one dump on disk, and
+            # every dumped record joins the ledger by trace_id
+            assert len(r1["breaches"]) == 1, r1["breaches"]
+            breach = r1["breaches"][0]
+            files = os.listdir(dumps1)
+            assert len(files) == 1, files
+            with open(os.path.join(dumps1, files[0])) as f:
+                lines = [json.loads(line) for line in f if line.strip()]
+            assert lines, "breach dump is empty"
+            assert all(rec["meta"]["trace_id"] == breach["trace_id"]
+                       for rec in lines)
+            solve_traces = {e.get("trace_id") for e in sim1.ledger.entries
+                            if e["kind"] == "solve"}
+            assert breach["trace_id"] in solve_traces, (
+                "breach trace_id not joinable against the ledger")
+    finally:
+        shutil.rmtree(dumps1, ignore_errors=True)
+        shutil.rmtree(dumps2, ignore_errors=True)
+    print(json.dumps({
+        "metric": (f"fleet simulator: mixed-day scenario "
+                   f"({r1['sim_seconds'] / 3600.0:.1f}h simulated: rolling "
+                   "deploy + spot-reclaim wave + zonal drought with "
+                   "recovery + PDB drain) through the full operator loop; "
+                   "second same-seed run byte-identical, induced SLO "
+                   "breach -> one flight dump joined by trace_id"),
+        "value": r1["compression"],
+        "unit": "x wall-clock compression",
+        "seconds": r1["wall_seconds"],
+        "sim_hours": round(r1["sim_seconds"] / 3600.0, 2),
+        "p50_tts_s": tts["p50_s"],
+        "p99_tts_s": tts["p99_s"],
+        "cost_per_pod_hour": r1["cost"]["per_pod_hour"],
+        "claims_created": churn["claims_created"],
+        "claims_terminated": churn["claims_terminated"],
+        "pods_evicted": churn["pods_evicted"],
+        "fallback_fraction": r1["solver"]["fallback_fraction"],
+        "passes": r1["solver"]["passes"],
+        "breaches": len(r1["breaches"]),
+        "ledger_entries": r1["ledger_entries"],
+        "ledger_digest": r1["ledger_digest"][:16],
+        "deterministic": True,
     }), flush=True)
 
 
@@ -1723,12 +1843,15 @@ def main():
     if MODE == "trace":
         bench_trace()
         return
+    if MODE == "sim":
+        bench_sim()
+        return
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
             "mesh-headroom|sidecar|service|minvalues|faults|replay|drought|"
-            "churn|trace")
+            "churn|trace|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

@@ -403,6 +403,18 @@ class Manager:
         log.warning("manager did not quiesce", max_rounds=max_rounds)
         return False
 
+    def next_timer_at(self) -> Optional[float]:
+        """Earliest LIVE requeue-timer fire time (None when no timer is
+        armed). The fleet simulator's adaptive stepping asks this before
+        each clock jump so an accelerated advance never overshoots a
+        controller's scheduled recheck — eviction backoffs, liveness TTLs,
+        kubelet ready delays all fire at their exact simulated instant."""
+        # every deferred intent re-arms only after its key's LIVE timer
+        # fires (and is never earlier than it), so the pending map alone
+        # carries the earliest fire time
+        pending = self._timer_pending.values()
+        return min(pending) if pending else None
+
     def advance(self, seconds: float) -> None:
         """Step a FakeClock and fire due timers (test helper)."""
         step = getattr(self.clock, "step", None)

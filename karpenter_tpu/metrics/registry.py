@@ -399,3 +399,33 @@ SIDECAR_RESYNCS = REGISTRY.counter(
     "Delta-session resync triggers: content-digest mismatches, LRU/idle "
     "session evictions, unknown-session hits from stale clients",
     ("reason",), max_series=16)
+
+# -- trace-driven fleet simulator (sim/) -----------------------------------
+# The simulator's own aggregate truth lives in its report/ledger (those are
+# digested for determinism); these families exist so a sim run serves the
+# SAME /metrics surface an operator does — dashboards built against a live
+# cluster read identically against a replay.
+
+SIM_EVENTS_APPLIED = REGISTRY.counter(
+    "karpenter_sim_events_applied_total",
+    "Scenario timeline events the fleet simulator has actuated, by event "
+    "kind (deploy, scale, rolling_update, pdb, spot_reclaim, zonal_outage, "
+    "drought, drain, flaky, slo)",
+    ("kind",), max_series=32)
+SIM_TICKS = REGISTRY.counter(
+    "karpenter_sim_ticks_total",
+    "Simulator loop iterations (one full operator quiesce per tick; the "
+    "adaptive stepper jumps straight to the next scenario event, manager "
+    "timer, or batcher deadline)")
+SIM_CLOCK_SECONDS = REGISTRY.gauge(
+    "karpenter_sim_clock_seconds",
+    "Simulated seconds elapsed since scenario start (the accelerated "
+    "FakeClock's progress through the timeline)")
+SIM_POD_HOURS = REGISTRY.counter(
+    "karpenter_sim_pod_hours_total",
+    "Bound-pod hours integrated over simulated time (the denominator of "
+    "the cost-per-pod-hour SLO)")
+SIM_FLEET_COST = REGISTRY.counter(
+    "karpenter_sim_fleet_cost_dollars_total",
+    "Fleet cost integrated from per-node offering prices over simulated "
+    "time (the numerator of the cost-per-pod-hour SLO)")

@@ -35,6 +35,16 @@ from ..utils.clock import Clock
 WINDOW = 512  # rolling durations kept per watched span for p50/p99
 
 
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (ceiling index) over an unsorted sample;
+    0.0 on empty. Shared by /debug/slo and the fleet simulator's report
+    so the two p99s can never disagree on identical samples."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.999999))]
+
+
 def parse_budgets(raw: str) -> Dict[str, float]:
     """'provisioner.pass=2.0,pack=0.5' -> {span: seconds}; bad entries
     raise ValueError (a typo'd SLO silently misbehaving is worse than a
@@ -88,6 +98,11 @@ class SLOWatcher:
         self.clock = clock or Clock()
         self.dump_dir = dump_dir
         self.breaches: "deque[Breach]" = deque(maxlen=keep_breaches)
+        # optional callback fired once per Breach as it happens: consumers
+        # that must see EVERY breach (the fleet simulator's ledger) hook
+        # this instead of polling `breaches`, whose maxlen drops the
+        # oldest entries once a long run accumulates more than it keeps
+        self.on_breach = None
         self._durations: Dict[str, deque] = {}
         self._seen: "deque[str]" = deque(maxlen=1024)
         self._seen_set: set = set()
@@ -144,6 +159,11 @@ class SLOWatcher:
         breach = Breach(sp.name, trace.trace_id, sp.duration, budget,
                         self.clock.now(), dump_path, tenant=tenant)
         self.breaches.append(breach)
+        if self.on_breach is not None:
+            try:
+                self.on_breach(breach)
+            except Exception:  # noqa: BLE001 — an observer never costs a pass
+                pass
         if self.recorder is not None:
             from ..events import catalog as events_catalog
             self.recorder.publish(events_catalog.slo_breached(
@@ -181,12 +201,7 @@ class SLOWatcher:
 
     # -- read side (/debug/slo) ---------------------------------------------
 
-    @staticmethod
-    def _pct(values: List[float], q: float) -> float:
-        if not values:
-            return 0.0
-        s = sorted(values)
-        return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.999999))]
+    _pct = staticmethod(percentile)
 
     def snapshot(self, tenant: Optional[str] = None) -> dict:
         """Budgets with rolling p50/p99 plus recent breaches. With no

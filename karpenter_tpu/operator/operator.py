@@ -9,7 +9,6 @@ fake clock for tests and simulations.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from ..cloudprovider.kwok import KwokCloudProvider
@@ -176,10 +175,13 @@ class Operator:
             controllers.append(NodeHealth(self.store, self.cluster,
                                           self.cloud_provider, self.clock,
                                           recorder=self.recorder))
-        if self.options.kwok_kubelet and (
-                isinstance(self.cloud_provider, KwokCloudProvider)
-                or isinstance(getattr(self.cloud_provider, "_delegate", None),
-                              KwokCloudProvider)):
+        kwok_delegate = self.cloud_provider
+        while kwok_delegate is not None and \
+                not isinstance(kwok_delegate, KwokCloudProvider):
+            # unwrap the whole decorator stack (metrics over chaos over
+            # kwok, sim/engine.py's shape), not just one level
+            kwok_delegate = getattr(kwok_delegate, "_delegate", None)
+        if self.options.kwok_kubelet and kwok_delegate is not None:
             # the simulated fleet needs a kubelet analog to clear startup/
             # ephemeral taints and stamp Ready (out-of-band machinery in the
             # reference's kwok environment); --kwok-kubelet=false for
@@ -391,7 +393,10 @@ class Operator:
                 if leading:
                     self.manager.run_until_quiet()
                     self.checkpoint()
-                time.sleep(tick_seconds)
+                # the injected clock paces the loop: real Clock sleeps wall
+                # time; a FakeClock parks on its condition variable until a
+                # simulator thread advances it (sim/ drives run() this way)
+                self.clock.sleep(tick_seconds)
         finally:
             self._stop_renewal()
             try:

@@ -105,7 +105,7 @@ class Vocab:
         for key in reqs:
             r = reqs.get(key)
             self.add_key(key)
-            for v in r.values:
+            for v in sorted(r.values):
                 self.add_value(key, v)
 
     def observe_resources(self, rl: dict) -> None:

@@ -207,6 +207,12 @@ class Provisioner(SingletonController):
         self._observed_first_seen: "OrderedDict[str, float]" = OrderedDict()
         self.last_results = None
         self.last_scheduler = None
+        # optional hook called after EVERY live provisioning pass with
+        # (scheduler, results): the fleet simulator (sim/engine.py) rides
+        # it for per-pass ledger entries and fallback-fraction accounting —
+        # run_until_quiet can fire several passes per simulator tick, so
+        # polling last_scheduler would miss all but the final one
+        self.solve_observer = None
         # --enable-profiling analog (operator.go:159-175): jax profiler trace
         # captured around each solve when set
         self.profile_dir: Optional[str] = None
@@ -321,6 +327,11 @@ class Provisioner(SingletonController):
         if results.pod_errors:
             for uid, err in list(results.pod_errors.items())[:10]:
                 log.debug("pod failed to schedule", pod_uid=uid, error=err)
+        if self.solve_observer is not None:
+            try:
+                self.solve_observer(ts, results)
+            except Exception:  # noqa: BLE001 — an observer never costs a pass
+                pass
         return self._handle_exhausted(results, deleting_pods)
 
     def _pod_by_uid(self, uid: str) -> Optional[Pod]:

@@ -1,0 +1,635 @@
+"""FleetSimulator: replay a scenario against the FULL operator loop.
+
+One simulator run boots a complete Operator — provisioner, disruption
+controller, nodeclaim lifecycle, termination drains, the kwok fabricated
+fleet wrapped in ChaosCloudProvider — on an accelerated FakeClock, and
+actuates the scenario's event timeline at its simulated instants. The
+chaos substrate is REUSED, never reimplemented: capacity droughts are
+``utils.chaos.CapacityDrought`` windows installed through
+``ChaosCloudProvider.exhaust()``, flaky windows move the seeded
+``FaultInjector`` rate, and SLO breaches ride the PR-7
+``SLOWatcher``/``FlightRecorder.dump_matching`` path so every breach
+lands as a replayable flight dump.
+
+Time is advanced ADAPTIVELY: after each operator quiesce the clock jumps
+straight to the next interesting instant — the next scenario event, the
+manager's earliest requeue timer (eviction backoffs, kubelet ready
+delays, liveness TTLs), the provisioner's batch deadline, a paced
+controller's next slot — capped by the scenario ``tick``. A 24-hour
+timeline replays in minutes (the BENCH_MODE=sim line asserts >= 100x
+compression) without skipping a single scheduled reconcile.
+
+Determinism: same seed + same scenario => byte-identical ledger digest
+(report.Ledger strips the process-volatile join fields). Everything the
+ledger digests derives from the FakeClock, the seeded RNGs, and the
+manager's deterministic single-dispatch ordering.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import math
+import random
+import time
+from collections import Counter as _Counter
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..api import labels as api_labels
+from ..api.nodeclaim import NodeClaim
+from ..api.nodepool import (Disruption, NodeClaimTemplate,
+                            NodeClaimTemplateSpec, NodePool, NodePoolSpec)
+from ..api.objects import (LabelSelector, Node, ObjectMeta, Pod, PodSpec,
+                           TopologySpreadConstraint)
+from ..api.policy import PDBSpec, PodDisruptionBudget
+from ..cloudprovider.chaos import ChaosCloudProvider
+from ..cloudprovider.kwok import (KwokCloudProvider, construct_catalog,
+                                  construct_instance_types)
+from ..controllers.manager import SingletonController
+from ..logging import get_logger
+from ..metrics import registry as metrics
+from ..operator.operator import Operator
+from ..operator.options import Options
+from ..utils import resources as res
+from ..utils.chaos import FaultInjector
+from ..utils.clock import FakeClock
+from .report import Ledger, build_report
+from .scenario import Scenario
+
+log = get_logger("sim")
+
+# smallest simulated advance per loop iteration: a zero-progress wake
+# target (a timer armed for "now") must never stall the timeline
+MIN_STEP_SECONDS = 0.01
+
+
+class _PacedSingleton(SingletonController):
+    """Cadence gate around an expensive singleton (the disruption
+    controller): under the accelerated clock the manager runs singletons
+    once per simulator tick, so an unpaced disruption pass would run
+    thousands of consolidation solves per simulated day. The gate holds
+    the inner controller to ``interval`` simulated seconds — the
+    reference's poll cadence — while still honoring the SHORTER requeues
+    the controller itself asks for (the 15 s consolidation-TTL
+    revalidation, the 1 s not-synced retry). DEVIATIONS 21."""
+
+    def __init__(self, inner, clock, interval: float):
+        self.inner = inner
+        self.clock = clock
+        self.interval = interval
+        self.name = inner.name
+        self.next_due = -math.inf
+
+    def reconcile(self):
+        from ..disruption.controller import POLL_INTERVAL_SECONDS
+        now = self.clock.now()
+        if now < self.next_due:
+            return None
+        result = self.inner.reconcile()
+        # the controller's NORMAL cadence answer (the reference 10 s poll)
+        # maps to the scenario interval; genuinely urgent requeues — the
+        # not-synced 1 s retry, and ANY wait while a command awaits its
+        # consolidation-TTL revalidation — keep their own clock
+        wait = self.interval
+        if result is not None and result.requeue_after is not None:
+            if getattr(self.inner, "pending", None) is not None \
+                    or result.requeue_after < POLL_INTERVAL_SECONDS:
+                wait = min(wait, result.requeue_after)
+        self.next_due = now + wait
+        return result
+
+
+class _Workload:
+    """Sim-side deployment controller: the reference relies on real
+    workload controllers to keep replicas alive; the simulator plays that
+    role with deterministic pod naming (name-g<generation>-<seq>)."""
+
+    def __init__(self, name: str, replicas: int, cpu: str, memory: str,
+                 spread: Optional[str], capacity_type: Optional[str],
+                 zone: Optional[str]):
+        self.name = name
+        self.replicas = replicas
+        self.cpu = cpu
+        self.memory = memory
+        self.spread = spread
+        self.capacity_type = capacity_type
+        self.zone = zone
+        self.generation = 1
+        self._seq = itertools.count(1)
+
+    def make_pod(self) -> Pod:
+        labels = {"app": self.name, "sim/gen": str(self.generation)}
+        selector = {}
+        if self.capacity_type:
+            ct = (api_labels.CAPACITY_TYPE_SPOT
+                  if self.capacity_type == "spot"
+                  else api_labels.CAPACITY_TYPE_ON_DEMAND)
+            selector[api_labels.CAPACITY_TYPE_LABEL_KEY] = ct
+        if self.zone:
+            selector[api_labels.LABEL_TOPOLOGY_ZONE] = self.zone
+        spread = []
+        if self.spread:
+            key = (api_labels.LABEL_TOPOLOGY_ZONE if self.spread == "zone"
+                   else api_labels.LABEL_HOSTNAME)
+            spread = [TopologySpreadConstraint(
+                topology_key=key, max_skew=1,
+                label_selector=LabelSelector(
+                    match_labels={"app": self.name}))]
+        return Pod(
+            metadata=ObjectMeta(
+                name=f"{self.name}-g{self.generation}-{next(self._seq):05d}",
+                namespace="default", labels=labels),
+            spec=PodSpec(node_selector=selector,
+                         topology_spread_constraints=spread),
+            container_requests=[res.parse_list(
+                {"cpu": self.cpu, "memory": self.memory})])
+
+    @staticmethod
+    def pod_generation(pod: Pod) -> int:
+        try:
+            return int(pod.metadata.labels.get("sim/gen", "0"))
+        except ValueError:
+            return 0
+
+
+class FleetSimulator:
+    """Replay one Scenario. ``run()`` returns the SLO report dict; the
+    deterministic ledger is on ``self.ledger``."""
+
+    def __init__(self, scenario: Scenario, flightrec_dir: Optional[str] = None,
+                 options: Optional[Options] = None):
+        self.scenario = scenario
+        self.clock = FakeClock()
+        self.t0 = self.clock.now()
+        self.rng = random.Random(scenario.seed)
+        self.injector = FaultInjector(seed=scenario.seed, rate=0.0)
+        catalog = (construct_catalog(scenario.catalog) if scenario.catalog
+                   else construct_instance_types())
+        self.kwok = KwokCloudProvider(instance_types=catalog)
+        self.chaos = ChaosCloudProvider(self.kwok, self.injector)
+        # offering price per (instance type, capacity type): the kwok
+        # formula prices every zone identically, so one entry per pair
+        self._price: Dict[tuple, float] = {}
+        for it in catalog:
+            for off in it.offerings:
+                self._price[(it.name, off.capacity_type)] = off.price
+        opts = options or Options()
+        opts.slo_budgets = scenario.slo_budgets
+        opts.batch_idle_duration = scenario.batch_idle
+        opts.batch_max_duration = scenario.batch_max
+        opts.kwok_ready_delay = scenario.ready_delay
+        self.op = Operator(options=opts, cloud_provider=self.chaos,
+                           clock=self.clock)
+        self.kwok.store = self.op.store
+        # pre-install the drought schedule CLOCK so duration'd windows
+        # (zonal outages) expire at their simulated instant
+        from ..utils.chaos import CapacityDrought
+        self.kwok.drought = CapacityDrought(clock=self.clock)
+        self.flightrec_dir = flightrec_dir
+        if scenario.needs_slo_watcher and self.op.slo is None:
+            # `slo` events open budget windows mid-run; boot an (initially
+            # budget-less, hence inert) watcher on the operator's wiring
+            from ..obs.slo import SLOWatcher
+            from ..obs.tracer import TRACER
+            self.op.slo = SLOWatcher({}, recorder=self.op.recorder,
+                                     flightrec=self.op.flightrec,
+                                     clock=self.clock)
+            TRACER.watcher = self.op.slo
+        if self.op.slo is not None and flightrec_dir:
+            self.op.slo.dump_dir = flightrec_dir
+        # breaches arrive through the watcher's on_breach hook, not by
+        # slicing its `breaches` deque: that ring keeps only the last 64,
+        # so a long scenario breaching every pass would silently drop
+        # entry #65+ from the ledger and report
+        self._fresh_breaches: list = []
+        if self.op.slo is not None:
+            self.op.slo.on_breach = self._fresh_breaches.append
+        # `slo` events are WINDOWS over these baseline budgets: effective
+        # budgets are the most recently opened still-active window's, the
+        # baseline again once every window has closed (a per-window
+        # saved-previous snapshot would resurrect an overlapping earlier
+        # window's budgets at the later window's close)
+        self._slo_baseline: dict = (dict(self.op.slo.budgets)
+                                    if self.op.slo is not None else {})
+        self._slo_windows: List[dict] = []
+        self._flaky_windows: List[dict] = []
+        # pace the disruption pass to the scenario's cadence
+        self._paced: List[_PacedSingleton] = []
+        singles = self.op.manager.singletons
+        for i, s in enumerate(singles):
+            if s is self.op.disruption:
+                paced = _PacedSingleton(s, self.clock,
+                                        scenario.disruption_interval)
+                singles[i] = paced
+                self._paced.append(paced)
+        self.op.provisioner.solve_observer = self._on_solve
+
+        # -- run state -------------------------------------------------------
+        self.ledger = Ledger()
+        self.tts_samples: List[float] = []
+        self.counts = _Counter(claims_created=0, claims_terminated=0,
+                               nodes_created=0, nodes_terminated=0,
+                               pods_evicted=0, pods_replaced=0)
+        self.solver_stats = _Counter(passes=0, tensor_pods=0, host_pods=0,
+                                     pod_errors=0)
+        self.events_applied: "_Counter[str]" = _Counter()
+        self.breaches: list = []
+        self.workloads: Dict[str, _Workload] = {}
+        self._pending_since: Dict[str, float] = {}
+        self._bound: Dict[str, str] = {}   # pod name -> node name
+        self._bound_count = 0
+        self._cost_rate = 0.0              # $/hour across live nodes
+        self.fleet_cost = 0.0
+        self.pod_hours = 0.0
+        self.sim_seconds = 0.0
+        self.wall_seconds = 0.0
+        self.final_state: dict = {}
+        # internal action heap: (fire_at_abs, seq, fn) — rolling-update
+        # steps, flaky/slo window closings
+        self._actions: list = []
+        self._action_seq = itertools.count(1)
+        self._running = False
+        self.op.store.watch(self._on_store_event)
+
+    # -- sim-time helpers ----------------------------------------------------
+
+    def _rel(self) -> float:
+        return self.clock.now() - self.t0
+
+    def _after(self, delay: float, fn) -> None:
+        heapq.heappush(self._actions,
+                       (self.clock.now() + delay, next(self._action_seq), fn))
+
+    # -- observers -----------------------------------------------------------
+
+    def _on_store_event(self, ev) -> None:
+        if not self._running:
+            return
+        kind = ev.kind.__name__
+        obj = ev.obj
+        t = self._rel()
+        if kind == "Pod":
+            name = obj.metadata.name
+            node = obj.spec.node_name or ""
+            if ev.type == "ADDED":
+                if node:
+                    self._bound[name] = node
+                    self._bound_count += 1
+                else:
+                    self._pending_since.setdefault(name, self.clock.now())
+            elif ev.type == "MODIFIED":
+                was = self._bound.get(name, "")
+                if node and not was:
+                    since = self._pending_since.pop(name, self.clock.now())
+                    wait = self.clock.now() - since
+                    self.tts_samples.append(wait)
+                    self._bound[name] = node
+                    self._bound_count += 1
+                    self.ledger.append(t, "pod_bound", pod=name, node=node,
+                                       wait=round(wait, 3))
+                elif was and not node:
+                    self._bound.pop(name, None)
+                    self._bound_count -= 1
+                    self.counts["pods_evicted"] += 1
+                    self._pending_since[name] = self.clock.now()
+                    self.ledger.append(t, "pod_unbound", pod=name, node=was)
+            elif ev.type == "DELETED":
+                if self._bound.pop(name, None):
+                    self._bound_count -= 1
+                self._pending_since.pop(name, None)
+        elif kind == "Node":
+            labels = obj.metadata.labels
+            price = self._price.get(
+                (labels.get(api_labels.LABEL_INSTANCE_TYPE, ""),
+                 labels.get(api_labels.CAPACITY_TYPE_LABEL_KEY, "")), 0.0)
+            if ev.type == "ADDED":
+                self._cost_rate += price
+                self.counts["nodes_created"] += 1
+                self.ledger.append(
+                    t, "node_added", node=obj.metadata.name,
+                    instance_type=labels.get(
+                        api_labels.LABEL_INSTANCE_TYPE, ""),
+                    zone=labels.get(api_labels.LABEL_TOPOLOGY_ZONE, ""),
+                    capacity_type=labels.get(
+                        api_labels.CAPACITY_TYPE_LABEL_KEY, ""),
+                    price=round(price, 5))
+            elif ev.type == "DELETED":
+                self._cost_rate -= price
+                self.counts["nodes_terminated"] += 1
+                self.ledger.append(t, "node_gone", node=obj.metadata.name)
+        elif kind == "NodeClaim":
+            if ev.type == "ADDED":
+                self.counts["claims_created"] += 1
+            elif ev.type == "DELETED":
+                self.counts["claims_terminated"] += 1
+
+    def _on_solve(self, ts, results) -> None:
+        part = getattr(ts, "partition", (0, 0)) or (0, 0)
+        self.solver_stats["passes"] += 1
+        self.solver_stats["tensor_pods"] += part[0]
+        self.solver_stats["host_pods"] += part[1]
+        self.solver_stats["pod_errors"] += len(results.pod_errors)
+        self.ledger.append(
+            self._rel(), "solve",
+            pods=part[0] + part[1],
+            claims=len(results.new_nodeclaims),
+            existing=sum(1 for en in results.existing_nodes if en.pods),
+            errors=len(results.pod_errors),
+            encode_kind=getattr(ts, "encode_kind", "cold"),
+            fallback=getattr(ts, "fallback_reason", ""),
+            trace_id=getattr(ts, "last_trace_id", ""))
+
+    def _collect_breaches(self) -> None:
+        # drain IN PLACE: the watcher's on_breach hook holds a reference
+        # to this exact list's append — rebinding would orphan it
+        fresh = self._fresh_breaches[:]
+        del self._fresh_breaches[:]
+        for b in fresh:
+            self.breaches.append(b)
+            self.ledger.append(b.at - self.t0, "breach", slo=b.slo,
+                               budget=b.budget, trace_id=b.trace_id,
+                               dump=b.dump_path)
+
+    # -- workload model ------------------------------------------------------
+
+    def _live_pods(self, w: _Workload) -> List[Pod]:
+        from ..utils import pod as pod_utils
+        return [p for p in self.op.store.list(Pod, namespace="default")
+                if p.metadata.labels.get("app") == w.name
+                and pod_utils.is_active(p)]
+
+    def _reconcile_workloads(self) -> None:
+        store = self.op.store
+        for w in self.workloads.values():
+            live = self._live_pods(w)
+            # a pod bound to a VANISHED node (spot reclaim, zonal outage)
+            # lost its kubelet: the workload controller replaces it
+            for p in list(live):
+                nn = p.spec.node_name
+                if nn and store.get(Node, nn) is None:
+                    store.delete(p)
+                    live.remove(p)
+                    self.counts["pods_replaced"] += 1
+            if len(live) < w.replicas:
+                for _ in range(w.replicas - len(live)):
+                    store.create(w.make_pod())
+            elif len(live) > w.replicas:
+                # scale-down kills the newest generation/sequence first
+                doomed = sorted(
+                    live, key=lambda p: (w.pod_generation(p),
+                                         p.metadata.name))
+                for p in doomed[w.replicas:]:
+                    store.delete(p)
+
+    # -- event actuators -----------------------------------------------------
+
+    def _apply_event(self, ev) -> None:
+        t = self._rel()
+        self.events_applied[ev.kind] += 1
+        metrics.SIM_EVENTS_APPLIED.inc({"kind": ev.kind})
+        getattr(self, f"_ev_{ev.kind}")(ev, t)
+
+    def _ev_deploy(self, ev, t: float) -> None:
+        w = _Workload(ev.name, ev.replicas, ev.cpu, ev.memory,
+                      ev.params.get("spread"),
+                      ev.params.get("capacity_type"), ev.params.get("zone"))
+        self.workloads[ev.name] = w
+        self.ledger.append(t, "event", event="deploy", name=ev.name,
+                           replicas=ev.replicas)
+
+    def _ev_scale(self, ev, t: float) -> None:
+        self.workloads[ev.name].replicas = ev.replicas
+        self.ledger.append(t, "event", event="scale", name=ev.name,
+                           replicas=ev.replicas)
+
+    def _ev_rolling_update(self, ev, t: float) -> None:
+        w = self.workloads[ev.name]
+        w.generation += 1
+        target = w.generation
+        batch, interval = ev.params["batch"], ev.params["interval"]
+        self.ledger.append(t, "event", event="rolling_update", name=ev.name,
+                           generation=target, batch=batch)
+
+        def step():
+            if w.generation != target:
+                return  # superseded by a newer rollout
+            old = sorted(
+                (p for p in self._live_pods(w)
+                 if w.pod_generation(p) < target),
+                key=lambda p: (w.pod_generation(p), p.metadata.name))
+            for p in old[:batch]:
+                self.op.store.delete(p)
+                self.counts["pods_replaced"] += 1
+            if len(old) > batch:
+                self._after(interval, step)
+            else:
+                self.ledger.append(self._rel(), "rollout_done", name=w.name,
+                                   generation=target)
+
+        step()
+
+    def _ev_pdb(self, ev, t: float) -> None:
+        self.op.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name=ev.name, namespace="default"),
+            spec=PDBSpec(
+                selector=LabelSelector(match_labels={"app": ev.app}),
+                max_unavailable=ev.params.get("max_unavailable"),
+                min_available=ev.params.get("min_available"))))
+        self.ledger.append(t, "event", event="pdb", name=ev.name, app=ev.app)
+
+    def _sim_nodes(self, zone: Optional[str] = None,
+                   capacity_type: Optional[str] = None) -> List[Node]:
+        out = []
+        for n in self.op.store.list(Node):
+            if not (n.spec.provider_id or "").startswith("kwok://"):
+                continue
+            labels = n.metadata.labels
+            if zone and labels.get(api_labels.LABEL_TOPOLOGY_ZONE) != zone:
+                continue
+            if capacity_type and labels.get(
+                    api_labels.CAPACITY_TYPE_LABEL_KEY) != capacity_type:
+                continue
+            out.append(n)
+        return sorted(out, key=lambda n: n.metadata.name)
+
+    def _reclaim_node(self, node: Node, reason: str) -> None:
+        """Abrupt instance loss (spot interruption / zonal outage): the
+        cloud takes the VM, the kubelet vanishes — no graceful drain. The
+        claim is reaped by the garbage collector, the pods by the workload
+        reconciler."""
+        self.kwok.created.pop(node.spec.provider_id, None)
+        node.metadata.finalizers = []
+        self.op.store.delete(node)
+        self.ledger.append(self._rel(), "reclaim", node=node.metadata.name,
+                           reason=reason)
+
+    def _ev_spot_reclaim(self, ev, t: float) -> None:
+        spot = self._sim_nodes(zone=ev.params.get("zone"),
+                               capacity_type=api_labels.CAPACITY_TYPE_SPOT)
+        n = ev.params.get("count")
+        if n is None:
+            n = int(math.ceil(ev.params["fraction"] * len(spot)))
+        doomed = self.rng.sample(spot, min(n, len(spot)))
+        self.ledger.append(t, "event", event="spot_reclaim",
+                           nodes=len(doomed))
+        for node in sorted(doomed, key=lambda x: x.metadata.name):
+            self._reclaim_node(node, "spot")
+
+    def _ev_zonal_outage(self, ev, t: float) -> None:
+        zone, duration = ev.zone, ev.params["duration"]
+        self.chaos.exhaust(zone=zone, duration=duration, clock=self.clock)
+        victims = self._sim_nodes(zone=zone) if ev.params["reclaim"] else []
+        self.ledger.append(t, "event", event="zonal_outage", zone=zone,
+                           duration=duration, nodes=len(victims))
+        for node in victims:
+            self._reclaim_node(node, "zonal_outage")
+
+    def _ev_drought(self, ev, t: float) -> None:
+        self.chaos.exhaust(instance_type=ev.params["instance_type"],
+                           zone=ev.params["zone"],
+                           capacity_type=ev.params["capacity_type"],
+                           duration=ev.params["duration"], clock=self.clock)
+        self.ledger.append(t, "event", event="drought",
+                           pattern="/".join((ev.params["instance_type"],
+                                             ev.params["zone"],
+                                             ev.params["capacity_type"])),
+                           duration=ev.params["duration"])
+
+    def _ev_drain(self, ev, t: float) -> None:
+        nodes = [n for n in self._sim_nodes(zone=ev.params.get("zone"))
+                 if n.metadata.deletion_timestamp is None]
+        nodes.sort(key=lambda n: (n.metadata.creation_timestamp,
+                                  n.metadata.name))
+        doomed = nodes[:ev.params["count"]]
+        self.ledger.append(t, "event", event="drain",
+                           nodes=[n.metadata.name for n in doomed])
+        for node in doomed:
+            # graceful: deletionTimestamp only — the termination
+            # controller taints, drains under PDB limits, then releases
+            # the finalizer
+            self.op.store.delete(node)
+
+    def _ev_flaky(self, ev, t: float) -> None:
+        rate, duration = ev.params["rate"], ev.params["duration"]
+        # window stack, the _ev_slo shape: an earlier window's close must
+        # restore the most recently opened still-active window's rates,
+        # not unconditionally calm a timeline another window still owns
+        window = {"rate": rate, "terminal_rate": ev.params["terminal_rate"]}
+        self._flaky_windows.append(window)
+        self.injector.rate = window["rate"]
+        self.injector.terminal_rate = window["terminal_rate"]
+        self.ledger.append(t, "event", event="flaky", rate=rate,
+                           duration=duration)
+
+        def calm():
+            self._flaky_windows.remove(window)
+            live = (self._flaky_windows[-1] if self._flaky_windows
+                    else {"rate": 0.0, "terminal_rate": 0.0})
+            self.injector.rate = live["rate"]
+            self.injector.terminal_rate = live["terminal_rate"]
+            self.ledger.append(self._rel(), "flaky_end")
+
+        self._after(duration, calm)
+
+    def _ev_slo(self, ev, t: float) -> None:
+        watcher = self.op.slo
+        budgets = dict(ev.params["budgets"])
+        window = {"budgets": budgets}
+        self._slo_windows.append(window)
+        watcher.budgets = dict(budgets)
+        self.ledger.append(t, "event", event="slo",
+                           budgets={k: budgets[k] for k in sorted(budgets)})
+        duration = ev.params.get("duration")
+        if duration is not None:
+            def close():
+                self._slo_windows.remove(window)
+                watcher.budgets = dict(
+                    self._slo_windows[-1]["budgets"] if self._slo_windows
+                    else self._slo_baseline)
+                self.ledger.append(self._rel(), "slo_end")
+            self._after(duration, close)
+
+    # -- main loop -----------------------------------------------------------
+
+    def _boot(self) -> None:
+        for pool in self.scenario.nodepools:
+            self.op.store.create(NodePool(
+                metadata=ObjectMeta(name=pool.name),
+                spec=NodePoolSpec(
+                    template=NodeClaimTemplate(spec=NodeClaimTemplateSpec()),
+                    disruption=Disruption(
+                        consolidate_after=pool.consolidate_after),
+                    weight=pool.weight)))
+
+    def run(self) -> dict:
+        wall0 = time.perf_counter()
+        self._boot()
+        self._running = True
+        sc = self.scenario
+        timeline = deque(sorted(
+            ((e.at, i, e) for i, e in enumerate(sc.events)),
+            key=lambda x: (x[0], x[1])))
+        end = self.t0 + sc.duration
+        while True:
+            now = self.clock.now()
+            while timeline and self.t0 + timeline[0][0] <= now:
+                self._apply_event(timeline.popleft()[2])
+            while self._actions and self._actions[0][0] <= now:
+                heapq.heappop(self._actions)[2]()
+            self._reconcile_workloads()
+            self.op.step()
+            self._collect_breaches()
+            metrics.SIM_TICKS.inc()
+            metrics.SIM_CLOCK_SECONDS.set(now - self.t0)
+            if now >= end:
+                break
+            # adaptive stepping: jump to the next interesting instant
+            nxt = now + sc.tick
+            if timeline:
+                nxt = min(nxt, self.t0 + timeline[0][0])
+            if self._actions:
+                nxt = min(nxt, self._actions[0][0])
+            mt = self.op.manager.next_timer_at()
+            if mt is not None:
+                nxt = min(nxt, mt)
+            for paced in self._paced:
+                if paced.next_due > now:
+                    nxt = min(nxt, paced.next_due)
+            batcher = self.op.provisioner.batcher
+            if batcher._first is not None:
+                nxt = min(nxt, now + batcher.time_until_ready())
+            nxt = min(max(nxt, now + MIN_STEP_SECONDS), end)
+            self._integrate(nxt - now)
+            self.clock.set_time(nxt)
+        self._running = False
+        self.sim_seconds = self.clock.now() - self.t0
+        self.wall_seconds = time.perf_counter() - wall0
+        store = self.op.store
+        self.final_state = {
+            "nodes": len(store.list(Node)),
+            "claims": len(store.list(NodeClaim)),
+            "pods_bound": self._bound_count,
+            "pods_pending": sum(1 for p in store.list(Pod)
+                                if not p.spec.node_name),
+        }
+        report = build_report(self)
+        log.info("scenario replayed", scenario=sc.name,
+                 sim_hours=round(self.sim_seconds / 3600.0, 2),
+                 wall_seconds=round(self.wall_seconds, 1),
+                 compression=report["compression"],
+                 ledger_digest=report["ledger_digest"][:16])
+        return report
+
+    def _integrate(self, dt: float) -> None:
+        """Accumulate cost and pod-hours over a constant-state interval
+        (fleet composition only changes at step boundaries)."""
+        hours = dt / 3600.0
+        cost = self._cost_rate * hours
+        pod_hours = self._bound_count * hours
+        self.fleet_cost += cost
+        self.pod_hours += pod_hours
+        if cost:
+            metrics.SIM_FLEET_COST.inc(value=cost)
+        if pod_hours:
+            metrics.SIM_POD_HOURS.inc(value=pod_hours)

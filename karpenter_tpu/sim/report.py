@@ -1,0 +1,165 @@
+"""Simulator outputs: the deterministic event ledger and the SLO report.
+
+The LEDGER is the run's ground truth: one JSONL entry per interesting
+occurrence (scenario event actuated, provisioning pass, claim/node
+created or gone, pod bound/unbound, SLO breach), timestamped in SIMULATED
+seconds since scenario start. Same seed + same scenario => byte-identical
+ledger digest (the flightrec byte-identity pattern): every digested field
+derives from the FakeClock, the seeded RNGs, and the deterministic
+single-dispatch operator loop. Fields that are honest but process-volatile
+(wall-clock durations, tracer-assigned trace ids whose process-global
+counter keeps climbing across runs, dump file paths) are carried under
+keys the digest strips, so the ledger stays joinable without costing the
+determinism contract.
+
+The REPORT aggregates the ledger into the end-to-end SLOs ROADMAP item 5
+names: p50/p99 pod time-to-schedule, cost per pod-hour integrated from
+offering prices, disruption churn, fallback fraction, and any SLO
+breaches (each with its flight-recorder dump path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+from ..obs.slo import percentile as _pct
+
+# ledger-entry keys EXCLUDED from the digest: process-volatile joins
+# (trace ids keep counting across runs in one process; dump paths carry
+# tempdirs; wall durations depend on the host)
+VOLATILE_KEYS = frozenset({"trace_id", "dump", "wall_s"})
+
+
+class Ledger:
+    """Append-only deterministic event ledger."""
+
+    def __init__(self):
+        self.entries: List[dict] = []
+
+    def append(self, t: float, kind: str, **fields) -> None:
+        entry = {"t": round(t, 3), "kind": kind}
+        entry.update(fields)
+        self.entries.append(entry)
+
+    def lines(self) -> List[str]:
+        return [json.dumps(e, sort_keys=True) for e in self.entries]
+
+    def digest(self) -> str:
+        """sha256 over the canonical entry stream, volatile keys stripped."""
+        h = hashlib.sha256()
+        for e in self.entries:
+            canon = {k: v for k, v in e.items() if k not in VOLATILE_KEYS}
+            h.update(json.dumps(canon, sort_keys=True).encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def dump(self, path: str) -> int:
+        lines = self.lines()
+        with open(path, "w") as f:
+            for line in lines:
+                f.write(line + "\n")
+        return len(lines)
+
+
+def build_report(sim) -> dict:
+    """Aggregate a finished FleetSimulator into the SLO report dict."""
+    tts = sim.tts_samples
+    pod_hours = sim.pod_hours
+    cost = sim.fleet_cost
+    sim_seconds = sim.sim_seconds
+    wall = sim.wall_seconds
+    hours = sim_seconds / 3600.0 if sim_seconds else 0.0
+    c = sim.counts
+    solver = sim.solver_stats
+    solved_pods = solver["tensor_pods"] + solver["host_pods"]
+    return {
+        "scenario": sim.scenario.name,
+        "seed": sim.scenario.seed,
+        "sim_seconds": round(sim_seconds, 3),
+        # wall/compression are measurement context, not digested truth
+        "wall_seconds": round(wall, 3),
+        "compression": round(sim_seconds / wall, 1) if wall else 0.0,
+        "time_to_schedule": {
+            "samples": len(tts),
+            "p50_s": round(_pct(tts, 0.50), 3),
+            "p99_s": round(_pct(tts, 0.99), 3),
+            "max_s": round(max(tts), 3) if tts else 0.0,
+        },
+        "cost": {
+            "fleet_dollars": round(cost, 6),
+            "pod_hours": round(pod_hours, 4),
+            "per_pod_hour": round(cost / pod_hours, 6) if pod_hours else 0.0,
+        },
+        "churn": {
+            "claims_created": c["claims_created"],
+            "claims_terminated": c["claims_terminated"],
+            "nodes_created": c["nodes_created"],
+            "nodes_terminated": c["nodes_terminated"],
+            "pods_evicted": c["pods_evicted"],
+            "pods_replaced": c["pods_replaced"],
+            "nodes_per_hour": round(
+                (c["nodes_created"] + c["nodes_terminated"]) / hours, 3)
+            if hours else 0.0,
+        },
+        "solver": {
+            "passes": solver["passes"],
+            "tensor_pods": solver["tensor_pods"],
+            "host_pods": solver["host_pods"],
+            "fallback_fraction": round(
+                solver["host_pods"] / solved_pods, 4) if solved_pods else 0.0,
+            "pod_errors": solver["pod_errors"],
+        },
+        "breaches": [
+            {"slo": b.slo, "trace_id": b.trace_id,
+             "budget": b.budget, "dump": b.dump_path}
+            for b in sim.breaches],
+        "events_applied": dict(sim.events_applied),
+        "final": sim.final_state,
+        "ledger_entries": len(sim.ledger.entries),
+        "ledger_digest": sim.ledger.digest(),
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human-readable rendering of a report dict (the CLI's `report`
+    subcommand and the end of `run`)."""
+    out = []
+    tts = report["time_to_schedule"]
+    cost = report["cost"]
+    churn = report["churn"]
+    solver = report["solver"]
+    out.append(f"scenario    {report['scenario']} (seed {report['seed']})")
+    out.append(f"simulated   {report['sim_seconds'] / 3600.0:.2f} h in "
+               f"{report['wall_seconds']:.1f} s wall "
+               f"({report['compression']:.0f}x compression)")
+    out.append(f"schedule    p50 {tts['p50_s']:.2f} s  p99 {tts['p99_s']:.2f} s  "
+               f"max {tts['max_s']:.2f} s  ({tts['samples']} pods placed)")
+    out.append(f"cost        ${cost['fleet_dollars']:.4f} over "
+               f"{cost['pod_hours']:.1f} pod-hours = "
+               f"${cost['per_pod_hour']:.6f}/pod-hour")
+    out.append(f"churn       {churn['claims_created']} claims created / "
+               f"{churn['claims_terminated']} terminated; "
+               f"{churn['pods_evicted']} evictions, "
+               f"{churn['pods_replaced']} replaced pods "
+               f"({churn['nodes_per_hour']:.2f} node events/h)")
+    out.append(f"solver      {solver['passes']} passes, "
+               f"fallback fraction {solver['fallback_fraction']:.2%}, "
+               f"{solver['pod_errors']} pod errors")
+    if report["breaches"]:
+        out.append(f"breaches    {len(report['breaches'])}:")
+        for b in report["breaches"]:
+            out.append(f"  - {b['slo']} (budget {b['budget']:g}s) "
+                       f"trace={b['trace_id']} dump={b['dump'] or '-'}")
+    else:
+        out.append("breaches    none")
+    ev = ", ".join(f"{k}x{v}" for k, v in
+                   sorted(report["events_applied"].items()))
+    out.append(f"events      {ev}")
+    fin = report["final"]
+    out.append(f"final       {fin['nodes']} nodes, {fin['pods_bound']} bound "
+               f"/ {fin['pods_pending']} pending pods")
+    out.append(f"ledger      {report['ledger_entries']} entries, digest "
+               f"{report['ledger_digest'][:16]}…")
+    return "\n".join(out)

@@ -32,12 +32,24 @@ class Limits:
         # PDB status before the next one, so a one-shot snapshot must track
         # its own grants to avoid over-evicting within a single drain pass
         self._granted: dict = {}
+        # selector-match memo per PDB: a Limits instance snapshots one
+        # pass, and pod labels/namespaces don't move within it — without
+        # the memo a disruption pass over N candidates re-scans every pod
+        # per (candidate pod, PDB), O(pdbs x pods^2) (the fleet simulator
+        # surfaced this at ~90 ms per pass on a 200-pod cluster). Health
+        # is still recomputed per call: in-pass evictions mutate bindings.
+        self._matching: dict = {}
 
     def _matching_pods(self, pdb: PodDisruptionBudget) -> List[Pod]:
+        cached = self._matching.get(id(pdb))
+        if cached is not None:
+            return cached
         sel = pdb.spec.selector
-        return [p for p in self.pods
-                if p.namespace == pdb.namespace
-                and sel is not None and sel.matches(p.labels)]
+        out = [p for p in self.pods
+               if p.namespace == pdb.namespace
+               and sel is not None and sel.matches(p.labels)]
+        self._matching[id(pdb)] = out
+        return out
 
     def disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
         matching = self._matching_pods(pdb)

@@ -34,3 +34,9 @@ def pytest_configure(config):
         "churn: incremental delta-solver tests (persistent ProblemState, "
         "seeded churn streams asserting delta == cold at every step — "
         "deterministic, tier-1 eligible)")
+    config.addinivalue_line(
+        "markers",
+        "sim: fleet-simulator tests (seeded scenario replays through the "
+        "full operator loop on the accelerated FakeClock — deterministic; "
+        "tier-1 eligible EXCEPT multi-minute scenario soaks, which also "
+        "carry `slow`)")

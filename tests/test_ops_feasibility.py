@@ -153,3 +153,27 @@ def test_pack_bits_bit_column_round_trip():
             np.testing.assert_array_equal(enc.bit_column(pa, i), a[..., i])
             np.testing.assert_array_equal(
                 enc.bit_column(pa & pb, i), (a & b)[..., i])
+
+
+def test_vocab_observed_value_indices_are_sorted():
+    """Vocab value indices must not depend on set iteration order
+    (PYTHONHASHSEED): the packer breaks zone-water-fill ties on value
+    INDEX, so hash-ordered indices made the same spread solve pick
+    different zones in different processes. observe_requirements inserts
+    each key's unseen values in sorted order."""
+    reqs = Requirements([
+        Requirement("topology.kubernetes.io/zone", "In",
+                    ["test-zone-c", "test-zone-a", "test-zone-b"]),
+        Requirement("kubernetes.io/arch", "NotIn", ["arm64", "amd64"]),
+    ])
+    v = enc.Vocab()
+    v.observe_requirements(reqs)
+    for k in range(v.K):
+        assert v.values[k] == sorted(v.values[k]), (v.keys[k], v.values[k])
+    # previously-observed values keep their indices; only NEW values append
+    v.observe_requirements(Requirements([
+        Requirement("topology.kubernetes.io/zone", "In",
+                    ["test-zone-d", "test-zone-a"])]))
+    kz = v.key_idx["topology.kubernetes.io/zone"]
+    assert v.values[kz] == ["test-zone-a", "test-zone-b", "test-zone-c",
+                           "test-zone-d"]
