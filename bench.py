@@ -17,6 +17,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -72,6 +73,14 @@ MINVALUES = os.environ.get("BENCH_MINVALUES", "") not in ("", "0")
 MINVALUES_FLOOR = int(os.environ.get("BENCH_MINVALUES_FLOOR", "50"))
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+# BENCH_MODE=meshscale knobs: the million-pod frontier shape (ROADMAP item
+# 2) — pods, deployments (= pod groups), instance types, and the
+# pods/groups shard count for the hierarchical sharded-pack line. Tier-1
+# runs a clipped shape through the same code (TestMeshScaleBudget).
+MESHSCALE_PODS = int(os.environ.get("BENCH_MESHSCALE_PODS", "1000000"))
+MESHSCALE_DEPLOYS = int(os.environ.get("BENCH_MESHSCALE_DEPLOYS", "4000"))
+MESHSCALE_ITS = int(os.environ.get("BENCH_MESHSCALE_ITS", "4000"))
+MESHSCALE_SHARDS = int(os.environ.get("BENCH_MESHSCALE_SHARDS", "4"))
 # soft wall-clock budget for the default multi-line run: once exceeded,
 # remaining AUXILIARY benches are skipped so the headline line (emitted
 # last) always lands before any driver-side timeout
@@ -1683,9 +1692,8 @@ def bench_mesh_headroom_local():
     import jax
 
     from karpenter_tpu.ops import binpack
-    from karpenter_tpu.parallel.mesh import (CATALOG_AXIS, GROUPS_AXIS,
-                                             _arg_shardings, _out_shardings,
-                                             make_solver_mesh, pad_problem)
+    from karpenter_tpu.parallel.mesh import (make_solver_mesh,
+                                             sharded_memory_analysis)
     from karpenter_tpu.provisioning.grouping import group_pods
 
     assert len(jax.devices()) >= MESH_DEVICES, jax.devices()
@@ -1707,14 +1715,7 @@ def bench_mesh_headroom_local():
         lambda *a: binpack.precompute_kernel(*a, **statics)).lower(
         *args).compile()
     single_peak = peak_bytes(single_exe)
-    padded, _, _ = pad_problem(problem, mesh.shape[GROUPS_AXIS],
-                               mesh.shape[CATALOG_AXIS])
-    pargs, pstatics = binpack.device_args(padded)
-    sharded_exe = jax.jit(
-        lambda *a: binpack.precompute_kernel(*a, **pstatics),
-        in_shardings=_arg_shardings(mesh),
-        out_shardings=_out_shardings(mesh)).lower(*pargs).compile()
-    sharded_peak = peak_bytes(sharded_exe)
+    sharded_peak = sharded_memory_analysis(problem, mesh)
 
     def timed(mesh_or_none):
         best, results = float("inf"), None
@@ -1781,6 +1782,138 @@ def bench_mesh_headroom():
             print(line, flush=True)
 
 
+def bench_meshscale_local():
+    """Million-pod frontier (ROADMAP item 2): MESHSCALE_PODS pods x
+    MESHSCALE_ITS instance types x MESHSCALE_DEPLOYS pod groups solved on a
+    MESH_DEVICES-device (pods_groups x catalog) mesh. Three lines of truth
+    in one JSON record:
+
+    - the EXACT mesh solve (sharded precompute, sequential pack): decisions
+      asserted identical to the single-device oracle — full claim-digest
+      multiset + pod-error equality, no sampling shortfall;
+    - the single-device oracle itself (same box, same process);
+    - the hierarchical pods/groups-sharded pack (DEVIATIONS 22): pod errors
+      exact, placed pods exact, node count within the documented envelope;
+    - XLA's own per-device peak-bytes analysis for the sharded program vs
+      the single-device program — the memory ceiling the mesh lifts.
+    """
+    import hashlib
+
+    import jax
+
+    from karpenter_tpu.ops import binpack
+    from karpenter_tpu.parallel.mesh import (make_solver_mesh,
+                                             sharded_memory_analysis)
+    from karpenter_tpu.provisioning.grouping import group_pods
+
+    assert len(jax.devices()) >= MESH_DEVICES, jax.devices()
+    mesh = make_solver_mesh(MESH_DEVICES)
+    global N_PODS, N_DEPLOYS
+    saved = (N_PODS, N_DEPLOYS)
+    N_PODS, N_DEPLOYS = MESHSCALE_PODS, MESHSCALE_DEPLOYS
+    try:
+        pods = _pods()
+    finally:
+        N_PODS, N_DEPLOYS = saved
+    n_its = MESHSCALE_ITS
+
+    def timed(mesh_or_none, shards=0, repeats=2):
+        best, results = float("inf"), None
+        for _ in range(repeats):  # first pass warms the executable cache
+            s = _scheduler(n_its)
+            s.mesh = mesh_or_none
+            s.pack_shards = shards
+            t0 = time.perf_counter()
+            results = s.solve(pods)
+            best = min(best, time.perf_counter() - t0)
+            assert s.fallback_reason == "", s.fallback_reason
+        return best, results
+
+    def claim_digest(nc):
+        names = "\x00".join(it.name for it in nc.instance_type_options)
+        return (nc.template.nodepool_name,
+                tuple(sorted(nc.requirements.get(
+                    api_labels.LABEL_TOPOLOGY_ZONE).values)),
+                hashlib.sha1(names.encode()).hexdigest(),
+                len(nc.pods))
+
+    t_mesh, r_mesh = timed(mesh)
+    t_single, r_single = timed(None)
+    t_sharded, r_sharded = timed(mesh, shards=MESHSCALE_SHARDS)
+
+    # exact path: full decision parity vs the single-device oracle
+    assert sorted(map(claim_digest, r_mesh.new_nodeclaims)) == \
+        sorted(map(claim_digest, r_single.new_nodeclaims)), \
+        "mesh solve decisions diverged from the single-device oracle"
+    assert r_mesh.pod_errors == r_single.pod_errors
+    # hierarchical path: DEVIATIONS 22 envelope
+    assert r_sharded.pod_errors == r_single.pod_errors, \
+        "sharded pack pod errors diverged (contract: exact)"
+    placed_single = sum(len(nc.pods) for nc in r_single.new_nodeclaims)
+    placed_sharded = sum(len(nc.pods) for nc in r_sharded.new_nodeclaims)
+    assert placed_sharded == placed_single, (placed_sharded, placed_single)
+    nodes_single = len(r_single.new_nodeclaims)
+    nodes_sharded = len(r_sharded.new_nodeclaims)
+    assert nodes_sharded <= math.ceil(nodes_single * 1.05) \
+        + MESHSCALE_SHARDS, (
+        f"sharded pack node bloat out of envelope: {nodes_sharded} vs "
+        f"{nodes_single} sequential")
+
+    groups, _ = group_pods(pods)
+    s = _scheduler(n_its)
+    problem, _, _ = s.build_problem(groups)
+    sharded_peak = sharded_memory_analysis(problem, mesh)
+    args, statics = binpack.device_args(problem)
+    single_exe, _ = binpack._get_executable(args, statics)
+    m = single_exe.memory_analysis()
+    single_peak = int(m.temp_size_in_bytes + m.argument_size_in_bytes
+                      + m.output_size_in_bytes)
+
+    print(json.dumps({
+        "metric": (f"mesh scale: provisioning Solve() of {len(pods)} pods "
+                   f"x {n_its} instance types x {len(groups)} groups on a "
+                   f"{MESH_DEVICES}-device (pods_groups x catalog) mesh "
+                   f"[platform={jax.devices()[0].platform}]"),
+        "value": round(len(pods) / t_mesh, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(len(pods) / t_mesh / 100.0, 2),
+        "seconds": round(t_mesh, 3),
+        "single_device_seconds": round(t_single, 3),
+        "sharded_pack_seconds": round(t_sharded, 3),
+        "pack_shards": MESHSCALE_SHARDS,
+        "nodes_single": nodes_single,
+        "nodes_sharded_pack": nodes_sharded,
+        "exact_match_vs_single_device": True,
+        "sharded_pack_errors_exact": True,
+        "per_device_peak_bytes_sharded": sharded_peak,
+        "single_device_peak_bytes": single_peak,
+        "peak_bytes_ratio": round(single_peak / max(1, sharded_peak), 2),
+    }), flush=True)
+
+
+def bench_meshscale():
+    """bench_meshscale_local, re-execing under a virtual MESH_DEVICES-device
+    CPU platform when the host has fewer real chips."""
+    import jax
+
+    from __graft_entry__ import run_under_virtual_devices
+
+    if len(jax.devices()) >= MESH_DEVICES:
+        bench_meshscale_local()
+        return
+    code = (
+        "import bench\n"
+        f"bench.MESHSCALE_PODS = {MESHSCALE_PODS}\n"
+        f"bench.MESHSCALE_DEPLOYS = {MESHSCALE_DEPLOYS}\n"
+        f"bench.MESHSCALE_ITS = {MESHSCALE_ITS}\n"
+        f"bench.MESHSCALE_SHARDS = {MESHSCALE_SHARDS}\n"
+        "bench.bench_meshscale_local()\n")
+    out = run_under_virtual_devices(code, MESH_DEVICES, timeout=3600)
+    for line in out.splitlines():
+        if line.startswith("{"):
+            print(line, flush=True)
+
+
 def bench_mesh():
     """Run bench_mesh_local, re-execing under a virtual MESH_DEVICES-device
     CPU platform when the host has fewer real chips (the driver box has one
@@ -1819,6 +1952,9 @@ def main():
     if MODE == "mesh-headroom":
         bench_mesh_headroom()
         return
+    if MODE == "meshscale":
+        bench_meshscale()
+        return
     if MODE == "sidecar":
         bench_sidecar()
         return
@@ -1850,8 +1986,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
-            "mesh-headroom|sidecar|service|minvalues|faults|replay|drought|"
-            "churn|trace|sim")
+            "mesh-headroom|meshscale|sidecar|service|minvalues|faults|"
+            "replay|drought|churn|trace|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

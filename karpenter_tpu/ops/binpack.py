@@ -278,58 +278,111 @@ def _offering_value_ok(mask_b, key: int, off_val):
     return jnp.where(off_val[None, :, :] >= 0, has == 1, True)
 
 
-def device_args(p: PackProblem):
+class ArgPlacer:
+    """Placement policy for device_args uploads. The default (None) commits
+    every array to the default device and caches the catalog/exist sides
+    under the plain slot names. A sharded placer (parallel/mesh._MeshPlacer)
+    overrides the hooks: group-side arrays stay host numpy (a sharded AOT
+    executable auto-places uncommitted inputs per its compiled shardings),
+    the catalog side is padded + device_put with its NamedSharding once and
+    cached under a device-identity-namespaced slot, and the exist side is
+    replicated. One device_args serves both paths — the single kernel
+    lineage the mesh regression postmortem demanded."""
+
+    #: appended to device_cache slot names so differently-placed uploads of
+    #: the same catalog never collide (a single-device-committed array is
+    #: REJECTED by a sharded executable, and vice versa)
+    cache_ns: tuple = ()
+
+    def enc(self, e) -> feas.Enc:
+        return feas.to_device(e)
+
+    def i32(self, a):
+        return jnp.asarray(np.clip(a, -INT32_MAX - 1,
+                                   INT32_MAX).astype(np.int32))
+
+    def array(self, a):
+        return jnp.asarray(a)
+
+    def put_it_side(self, it_side):
+        """Final placement for the 7 catalog-side leaves (already through
+        enc/i32/array). Sharded placers device_put each with its spec."""
+        return it_side
+
+    def put_exist_side(self, exist, exist_avail):
+        return exist, exist_avail
+
+    def it_side_valid(self, p: "PackProblem", it_side) -> bool:
+        """Guards the cached catalog upload against a differently-padded
+        problem reusing the slot (a mesh-padded catalog must never serve a
+        single-device solve, whose output layout is sized from the
+        problem). Sharded placers key their slot by the padded size
+        instead, so they skip this."""
+        return tuple(it_side[1].shape) == p.it_alloc.shape
+
+
+_DEFAULT_PLACER = ArgPlacer()
+
+
+def device_args(p: PackProblem, placer: Optional[ArgPlacer] = None):
     """Build the positional-array / static-kwarg split for precompute_kernel."""
     from ..obs.tracer import TRACER
     with TRACER.span("device.upload"):
-        return _device_args(p)
+        return _device_args(p, placer or _DEFAULT_PLACER)
 
 
-def _device_args(p: PackProblem):
+def _device_args(p: PackProblem, placer: ArgPlacer):
     has_exist = p.exist_enc is not None and p.exist_enc.mask.shape[0] > 0
-    dev = lambda e: feas.to_device(e)
-    i32 = lambda a: jnp.asarray(np.clip(a, -INT32_MAX - 1, INT32_MAX).astype(np.int32))
+    dev = placer.enc
+    i32 = placer.i32
+    arr = placer.array
     if has_exist:
         # tol_exist is group-dependent and uploads fresh every call; the
         # node-only (exist_enc, exist_avail) pair is cacheable per
         # exist_token (see PackProblem.exist_token)
-        ex_slot = (p.device_cache.get("exist_side")
+        ex_key = ("exist_side",) + placer.cache_ns
+        ex_slot = (p.device_cache.get(ex_key)
                    if p.device_cache is not None and p.exist_token is not None
                    else None)
         if ex_slot is not None and ex_slot[0] == p.exist_token:
             exist, exist_avail = ex_slot[1]
         else:
-            exist, exist_avail = dev(p.exist_enc), i32(p.exist_avail)
+            exist, exist_avail = placer.put_exist_side(
+                dev(p.exist_enc), i32(p.exist_avail))
             if p.device_cache is not None and p.exist_token is not None:
-                p.device_cache["exist_side"] = (p.exist_token,
-                                                (exist, exist_avail))
-        tol_exist = jnp.asarray(p.tol_exist)
+                p.device_cache[ex_key] = (p.exist_token, (exist, exist_avail))
+        tol_exist = arr(p.tol_exist)
     else:
         K, W = p.group_enc.mask.shape[1:]
-        exist = feas.Enc(mask=jnp.zeros((1, K, W), jnp.uint32),
-                         defined=jnp.zeros((1, K), bool),
-                         complement=jnp.zeros((1, K), bool),
-                         exempt=jnp.zeros((1, K), bool),
-                         gt=jnp.zeros((1, K), jnp.int32),
-                         lt=jnp.zeros((1, K), jnp.int32))
-        exist_avail = jnp.zeros((1, p.group_req.shape[1]), jnp.int32)
-        tol_exist = jnp.zeros((p.group_req.shape[0], 1), bool)
+        exist = feas.Enc(mask=np.zeros((1, K, W), np.uint32),
+                         defined=np.zeros((1, K), bool),
+                         complement=np.zeros((1, K), bool),
+                         exempt=np.zeros((1, K), bool),
+                         gt=np.zeros((1, K), np.int32),
+                         lt=np.zeros((1, K), np.int32))
+        exist = feas.Enc(*(arr(x) for x in exist))
+        exist_avail = arr(np.zeros((1, p.group_req.shape[1]), np.int32))
+        tol_exist = arr(np.zeros((p.group_req.shape[0], 1), bool))
     cache = p.device_cache
-    it_side = cache.get("it_side") if cache is not None else None
+    it_key = ("it_side",) + placer.cache_ns
+    it_side = cache.get(it_key) if cache is not None else None
+    if it_side is not None and not placer.it_side_valid(p, it_side):
+        it_side = None
     if it_side is None:
-        it_side = (dev(p.it_enc), i32(p.it_alloc), jnp.asarray(p.off_zone),
-                   jnp.asarray(p.off_captype), jnp.asarray(p.off_available),
-                   jnp.asarray(p.zone_values), jnp.asarray(p.allow_undefined))
+        it_side = placer.put_it_side(
+            (dev(p.it_enc), i32(p.it_alloc), arr(p.off_zone),
+             arr(p.off_captype), arr(p.off_available),
+             arr(p.zone_values), arr(p.allow_undefined)))
         if cache is not None:
-            cache["it_side"] = it_side
+            cache[it_key] = it_side
     (it_enc_d, it_alloc_d, off_zone_d, off_captype_d, off_avail_d,
      zone_values_d, allow_undef_d) = it_side
     args = (dev(p.group_enc), dev(p.template_enc), it_enc_d,
             i32(p.group_req), i32(p.daemon_overhead),
-            it_alloc_d, jnp.asarray(p.template_its),
+            it_alloc_d, arr(p.template_its),
             off_zone_d, off_captype_d,
             off_avail_d, zone_values_d,
-            allow_undef_d, jnp.asarray(p.tol_template),
+            allow_undef_d, arr(p.tol_template),
             exist, exist_avail, tol_exist)
     statics = dict(zone_key=p.zone_key, captype_key=p.captype_key,
                    has_exist=has_exist)
@@ -376,28 +429,53 @@ def _exec_cache_key(args, statics) -> tuple:
             tuple(sorted(statics.items())))
 
 
-def _run_precompute(args, statics):
-    from ..metrics.registry import (SOLVER_COMPILE_CACHE_HITS,
-                                    SOLVER_COMPILE_CACHE_MISSES)
+def _get_executable(args, statics, shard=None):
+    """(compiled executable, cache_hit) for the precompute program, through
+    the ONE persistent executable cache. ``shard=None`` compiles the
+    single-device packed-output kernel; a sharded dispatch (parallel/mesh)
+    passes ``shard=(key_prefix, in_shardings, out_shardings)`` and gets the
+    raw 6-output kernel compiled under GSPMD — same kernel, same cache; the
+    key_prefix carries the device identity + mesh grid + gather mode, NOT
+    the Mesh object, so a recreated mesh over the same devices reuses the
+    executable."""
     from ..obs.tracer import TRACER
     key = _exec_cache_key(args, statics)
+    if shard is not None:
+        key = (shard[0], key)
     with _EXEC_CACHE_LOCK:
         exe = _EXEC_CACHE.get(key)
         if exe is not None:
             _EXEC_CACHE.move_to_end(key)
     if exe is not None:
-        SOLVER_COMPILE_CACHE_HITS.inc()
-        with TRACER.span("device.execute", compile_cache="hit"):
-            return exe(*args)
-    SOLVER_COMPILE_CACHE_MISSES.inc()
+        return exe, True
     with TRACER.span("compile"):
-        exe = _precompute_packed.lower(*args, **statics).compile()
+        if shard is None:
+            exe = _precompute_packed.lower(*args, **statics).compile()
+        else:
+            _, in_sh, out_sh = shard
+            exe = jax.jit(
+                lambda *a: precompute_kernel(*a, **statics),
+                in_shardings=in_sh,
+                out_shardings=out_sh).lower(*args).compile()
     with _EXEC_CACHE_LOCK:
         if key not in _EXEC_CACHE and len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
             _EXEC_CACHE.popitem(last=False)
         _EXEC_CACHE[key] = exe
         _EXEC_CACHE.move_to_end(key)
-    with TRACER.span("device.execute", compile_cache="miss"):
+    return exe, False
+
+
+def _run_precompute(args, statics, shard=None):
+    from ..metrics.registry import (SOLVER_COMPILE_CACHE_HITS,
+                                    SOLVER_COMPILE_CACHE_MISSES)
+    from ..obs.tracer import TRACER
+    exe, hit = _get_executable(args, statics, shard)
+    if hit:
+        SOLVER_COMPILE_CACHE_HITS.inc()
+    else:
+        SOLVER_COMPILE_CACHE_MISSES.inc()
+    with TRACER.span("device.execute",
+                     compile_cache="hit" if hit else "miss"):
         return exe(*args)
 
 
@@ -542,6 +620,21 @@ class CohortSet:
             a[cj] = a[ci]
         self.n[cj] = n_new
         self.pods_by_group.append(dict(self.pods_by_group[ci]))
+        self.C += 1
+        return cj
+
+    def append_row_from(self, other: "CohortSet", ci: int) -> int:
+        """Copy row ``ci`` of ``other`` (built over the same problem,
+        tensors and group count) into this set: the sharded pack's merge
+        step. Row aggregates copy verbatim — they are order-independent
+        AND-folds, so a merged set scans exactly like one that boarded the
+        same groups sequentially."""
+        cj = self.C
+        if cj == self._cap:
+            self._grow()
+        for name in self._ROW_FIELDS:
+            getattr(self, name)[cj] = getattr(other, name)[ci]
+        self.pods_by_group.append(dict(other.pods_by_group[ci]))
         self.C += 1
         return cj
 
@@ -1280,12 +1373,24 @@ class Packer:
 
     # -- main ---------------------------------------------------------------
 
-    def pack(self) -> PackResult:
+    def ffd_order(self) -> List[int]:
+        """The first-fit-decreasing group order the sequential pack walks —
+        exposed so the sharded pack (parallel/mesh.sharded_pack) can carve
+        the SAME order into per-shard blocks."""
         cpu_idx = self.p.vocab.resource_idx.get("cpu", 0)
         mem_idx = self.p.vocab.resource_idx.get("memory", 0)
-        order = sorted(range(self.G), key=lambda g: (
+        return sorted(range(self.G), key=lambda g: (
             -self.p.group_req[g][cpu_idx], -self.p.group_req[g][mem_idx]))
-        warm = self._warm if self._warm_usable() else None
+
+    def pack(self, order: Optional[List[int]] = None) -> PackResult:
+        """Pack every group of ``order`` (default: the full FFD order) into
+        this packer's cohort set. An explicit order is the sharded-pack
+        entry: it packs only that block of groups and never engages the
+        warm-start machinery (per-shard state is not checkpointable)."""
+        explicit = order is not None
+        if order is None:
+            order = self.ffd_order()
+        warm = self._warm if not explicit and self._warm_usable() else None
         start = 0
         cks: List[PackCheckpoint] = []
         if warm is not None:

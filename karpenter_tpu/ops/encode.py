@@ -281,6 +281,17 @@ def pad_stacked(e: EncodedRequirements, total: int,
         gt=rep("gt"), lt=rep("lt"))
 
 
+def pow2_bucket(n: int, minimum: int) -> int:
+    """Next power of two >= max(n, minimum): bounded distinct jit shapes.
+    Shared by the group/node batch-axis buckets (tensor_scheduler) and the
+    mesh's per-shard stack padding (parallel/mesh.pad_problem), so every
+    padded axis in the system rounds the same way."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
 def pack_bits(a: np.ndarray) -> np.ndarray:
     """Little-endian bitpack of a bool array along its LAST axis:
     [..., Z] bool -> [..., ceil(Z/8)] uint8 with bit i of word w standing

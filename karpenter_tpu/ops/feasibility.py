@@ -47,6 +47,20 @@ def to_device(e) -> Enc:
                lt=jnp.asarray(np.clip(e.lt, -2**31, 2**31 - 1).astype(np.int32)))
 
 
+def host_enc(e) -> Enc:
+    """to_device's dtype normalization WITHOUT committing to a device: host
+    numpy leaves, for callers whose placement is decided later (a sharded
+    AOT executable auto-places uncommitted inputs per its compiled
+    shardings; a jnp.asarray here would commit to the default device and be
+    rejected)."""
+    return Enc(mask=np.ascontiguousarray(e.mask.astype(np.uint32)),
+               defined=np.asarray(e.defined, dtype=bool),
+               complement=np.asarray(e.complement, dtype=bool),
+               exempt=np.asarray(e.exempt, dtype=bool),
+               gt=np.clip(e.gt, -2**31, 2**31 - 1).astype(np.int32),
+               lt=np.clip(e.lt, -2**31, 2**31 - 1).astype(np.int32))
+
+
 def _pairwise_nonempty(a: Enc, b: Enc):
     """[A,B,K] mask-AND emptiness + joint bound collapse."""
     # accumulate over words to keep peak memory at [A,B,K]

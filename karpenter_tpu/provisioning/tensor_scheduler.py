@@ -37,11 +37,9 @@ from .topology import ClusterView, Topology
 
 
 def _pow2_bucket(n: int, minimum: int) -> int:
-    """Next power of two >= max(n, minimum): bounded distinct jit shapes."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+    """Next power of two >= max(n, minimum): bounded distinct jit shapes
+    (shared implementation: ops/encode.pow2_bucket)."""
+    return enc.pow2_bucket(n, minimum)
 
 
 @dataclass
@@ -313,7 +311,8 @@ class TensorScheduler:
                  initial_zone_counts=None, force_tensor: bool = False,
                  mesh=None, catalog_token: Optional[tuple] = None,
                  circuit: Optional[SolverCircuitBreaker] = None,
-                 unavailable=None, problem_state=None):
+                 unavailable=None, problem_state=None,
+                 pack_shards: int = 0):
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
         self.state_nodes = list(state_nodes)
@@ -324,6 +323,14 @@ class TensorScheduler:
         # optional jax.sharding.Mesh: run the feasibility precompute sharded
         # over a multi-chip mesh (parallel/mesh.py) instead of single-device
         self.mesh = mesh
+        # > 1: pods/groups-sharded HIERARCHICAL pack (parallel/mesh.
+        # sharded_pack, DEVIATIONS 22) — per-shard packs + cross-shard
+        # remainder reconcile. Opt-in: decisions may differ from the
+        # sequential pack in remainder-node composition (pod errors stay
+        # exact), so the default 0 keeps every caller on the oracle-exact
+        # sequential pack. Engages only when the problem passes the
+        # pack_shardable() gate and no warm-start is in play.
+        self.pack_shards = pack_shards
         # precomputed catalog cache key (catalog_cache_token): ONLY valid
         # when the caller guarantees the catalog is never mutated in place
         self.catalog_token = catalog_token
@@ -1202,19 +1209,33 @@ class TensorScheduler:
             warm = self.problem_state.warm_start(
                 self, vocab, groups, templates, limits,
                 izc, exist_counts, host_total, problem.exist_token)
+        use_sharded = False
+        if self.pack_shards > 1 and warm is None:
+            from ..parallel.mesh import pack_shardable
+            use_sharded = pack_shardable(problem, limits, group_ports,
+                                         vol_group_counts)
         with TRACER.span("pack", groups=len(groups)) as psp:
-            packer = binpack.Packer(problem, tensors, groups, limits,
-                                    limit_resources,
-                                    initial_zone_counts=izc,
-                                    exist_order=sn_order,
-                                    exist_counts=exist_counts,
-                                    host_match_total=host_total,
-                                    vol_group_counts=vol_group_counts,
-                                    vol_node_remaining=vol_node_remaining,
-                                    group_ports=group_ports,
-                                    exist_port_block=exist_port_block,
-                                    warm=warm)
-            pr = packer.pack()
+            if use_sharded:
+                from ..parallel.mesh import sharded_pack
+                psp.set(sharded=self.pack_shards)
+                pr = sharded_pack(problem, tensors, groups,
+                                  self.pack_shards,
+                                  initial_zone_counts=izc,
+                                  exist_counts=exist_counts,
+                                  host_match_total=host_total)
+            else:
+                packer = binpack.Packer(problem, tensors, groups, limits,
+                                        limit_resources,
+                                        initial_zone_counts=izc,
+                                        exist_order=sn_order,
+                                        exist_counts=exist_counts,
+                                        host_match_total=host_total,
+                                        vol_group_counts=vol_group_counts,
+                                        vol_node_remaining=vol_node_remaining,
+                                        group_ports=group_ports,
+                                        exist_port_block=exist_port_block,
+                                        warm=warm)
+                pr = packer.pack()
             if self.problem_state is not None:
                 self.problem_state.finish_pack(warm)
                 psp.set(warm=self.problem_state.last["warm"],

@@ -329,19 +329,26 @@ class TestDroughtBudget:
 
 
 class TestMeshBudget:
-    """8-device mesh regression gate (ISSUE 6 satellite): BENCH_r05 showed
-    the mesh line regress 0.412s -> 0.918s with NO tier-1 gate — it was
-    discovered at re-anchor time. This runs the headline mix on the
-    conftest-provided virtual 8-device CPU mesh at test scale and pins
-    (1) exact decision equality vs the single-device solve and (2) a
-    wall-clock envelope: an absolute budget a host-Python sharding path
-    would blow, plus a relative bound on the mesh's overhead over the
-    single-device solve (r05-style regressions at least double it)."""
+    """8-device mesh regression gate (ISSUE 6 satellite, thresholds
+    re-derived in ISSUE 10): BENCH_r05 showed the mesh line regress
+    0.412s -> 0.918s with NO tier-1 gate — it was discovered at re-anchor
+    time. This runs the ACTUAL headline shape (50k pods x 2k instance
+    types) on the conftest-provided virtual 8-device CPU mesh and pins
+    (1) exact decision equality vs the single-device solve and (2) the
+    recovered wall-clock line as a RATIO against a same-process
+    single-device run measured at test time — no absolute r05-capture
+    constants, which flake on the 2-core driver box (it runs cross-process
+    benches 30-50% slower than the captures).
 
-    N_PODS_MESH = 6000
-    ABSOLUTE_BUDGET_SECONDS = 5.0
-    RELATIVE_FACTOR = 3.0
-    RELATIVE_GRACE_SECONDS = 0.3
+    The bound: mesh <= single x RATIO_BOUND + GRACE. On-box the unified
+    kernel lineage measures ~1.0x (0.385s vs 0.378s); the r05 dual-lineage
+    regression was 2.2x, so 1.35x catches it with margin while absorbing
+    2-core scheduler noise."""
+
+    N_PODS_MESH = 50000
+    N_ITS_MESH = 2000
+    RATIO_BOUND = 1.35
+    RATIO_GRACE_SECONDS = 0.15
 
     def test_mesh_solve_budget_and_parity(self):
         import jax
@@ -358,10 +365,10 @@ class TestMeshBudget:
             bench.N_PODS, bench.N_DEPLOYS = saved
         mesh = make_solver_mesh(8)
 
-        def best_of(mesh_or_none, n=3):
+        def best_of(mesh_or_none, n=2):
             best, results = float("inf"), None
             for _ in range(n + 1):  # first pass warms the jit cache
-                s = bench._scheduler(0)
+                s = bench._scheduler(self.N_ITS_MESH)
                 s.mesh = mesh_or_none
                 t0 = time.perf_counter()
                 results = s.solve(pods)
@@ -374,15 +381,66 @@ class TestMeshBudget:
         assert sorted(map(_claim_key, r_mesh.new_nodeclaims)) == \
             sorted(map(_claim_key, r_single.new_nodeclaims))
         assert r_mesh.pod_errors == r_single.pod_errors
-        assert t_mesh < self.ABSOLUTE_BUDGET_SECONDS, (
-            f"8-device mesh solve took {t_mesh:.2f}s at "
-            f"{self.N_PODS_MESH} pods — the sharded precompute likely "
-            "fell off the compiled path")
-        assert t_mesh <= t_single * self.RELATIVE_FACTOR \
-            + self.RELATIVE_GRACE_SECONDS, (
-            f"mesh overhead regressed: {t_mesh:.3f}s vs single-device "
-            f"{t_single:.3f}s (bound {self.RELATIVE_FACTOR}x + "
-            f"{self.RELATIVE_GRACE_SECONDS}s)")
+        assert t_mesh <= t_single * self.RATIO_BOUND \
+            + self.RATIO_GRACE_SECONDS, (
+            f"8-device mesh line regressed: {t_mesh:.3f}s vs single-device "
+            f"{t_single:.3f}s same-process (bound {self.RATIO_BOUND}x + "
+            f"{self.RATIO_GRACE_SECONDS}s) — the r05 dual-kernel-lineage "
+            "failure mode measured 2.2x")
+
+
+class TestMeshScaleBudget:
+    """BENCH_MODE=meshscale at tier-1 scale: the million-pod frontier bench
+    clipped to 20k pods x 200 ITs x 200 groups x 2 pack shards runs the
+    SAME bench function in-process (the conftest virtual 8-device platform
+    stands in for the re-exec) and must hold every in-bench contract: exact
+    mesh-vs-single-device decision parity, exact sharded-pack pod errors,
+    the reconcile node envelope, and a reported per-device peak-bytes
+    advantage over the single-device program."""
+
+    BUDGET_SECONDS = 120.0
+
+    def test_meshscale_bench_shape_within_budget(self, capsys):
+        import json as _json
+
+        import jax
+
+        if len(jax.devices()) < bench.MESH_DEVICES:
+            pytest.skip("needs the conftest 8-device virtual CPU platform")
+        saved = (bench.MESHSCALE_PODS, bench.MESHSCALE_DEPLOYS,
+                 bench.MESHSCALE_ITS, bench.MESHSCALE_SHARDS)
+        bench.MESHSCALE_PODS, bench.MESHSCALE_DEPLOYS, \
+            bench.MESHSCALE_ITS, bench.MESHSCALE_SHARDS = 20000, 200, 200, 2
+        try:
+            t0 = time.perf_counter()
+            bench.bench_meshscale_local()
+            elapsed = time.perf_counter() - t0
+        finally:
+            (bench.MESHSCALE_PODS, bench.MESHSCALE_DEPLOYS,
+             bench.MESHSCALE_ITS, bench.MESHSCALE_SHARDS) = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"clipped meshscale bench took {elapsed:.1f}s — the sharded "
+            "dispatch likely fell off the compiled path")
+        line = _json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert line["unit"] == "pods/sec"
+        assert "mesh scale" in line["metric"]
+        assert line["value"] > 0
+        assert line["exact_match_vs_single_device"] is True
+        assert line["sharded_pack_errors_exact"] is True
+        assert line["per_device_peak_bytes_sharded"] > 0
+        assert line["per_device_peak_bytes_sharded"] < \
+            line["single_device_peak_bytes"], (
+            "sharding stopped lowering the per-device memory ceiling")
+
+    def test_bench_mode_meshscale_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "meshscale" in m.group(0), \
+            "BENCH_MODE=meshscale missing from the unknown-mode error list"
 
 
 class TestChurnBudget:
