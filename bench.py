@@ -40,7 +40,7 @@ N_DEPLOYS = int(os.environ.get("BENCH_DEPLOYS", "120"))
 N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 # provisioning|consolidation|single|spot|mesh|mesh-local|mesh-headroom|
-# sidecar|service|minvalues|faults|replay|drought|churn|trace|all
+# sidecar|service|svc-faults|minvalues|faults|replay|drought|churn|trace|all
 MODE = os.environ.get("BENCH_MODE", "all")
 # BENCH_MODE=service knobs: concurrent tenant clusters driving ONE sidecar,
 # timed warm-delta windows per tenant, % of each tenant's pods replaced per
@@ -53,6 +53,18 @@ SERVICE_WINDOWS = int(os.environ.get("BENCH_SERVICE_WINDOWS", "6"))
 SERVICE_CHURN_PCT = float(os.environ.get("BENCH_SERVICE_CHURN_PCT", "1.2"))
 SERVICE_WARM_BUDGET = float(os.environ.get("BENCH_SERVICE_WARM_BUDGET",
                                            "0.5"))
+# BENCH_MODE=svc-faults knobs: tenants of warm multi-tenant traffic, timed
+# windows per tenant, the seeded wire-fault rate applied per fault kind
+# (drop/delay/duplicate/disconnect) during the chaos window, the p99
+# round-trip ceiling under faults, and the chaos-OFF overhead budget (the
+# resilient client + disabled chaos channel vs a bare PR-8-style call path)
+SVCFAULTS_TENANTS = int(os.environ.get("BENCH_SVCFAULTS_TENANTS", "4"))
+SVCFAULTS_WINDOWS = int(os.environ.get("BENCH_SVCFAULTS_WINDOWS", "6"))
+SVCFAULTS_RATE = float(os.environ.get("BENCH_SVCFAULTS_RATE", "0.05"))
+SVCFAULTS_P99_BUDGET = float(os.environ.get("BENCH_SVCFAULTS_P99_BUDGET",
+                                            "3.0"))
+SVCFAULTS_OVERHEAD = float(os.environ.get("BENCH_SVCFAULTS_OVERHEAD",
+                                          "0.05"))
 # BENCH_MODE=churn knobs: windows in the timed stream, pod arrivals per
 # window, bound pods per warm node, minimum sustained arrival rate the
 # line must hold (pods/sec over summed time-to-decision)
@@ -1615,6 +1627,228 @@ def bench_service():
     }), flush=True)
 
 
+def bench_svc_faults():
+    """ISSUE 11 acceptance line (BENCH_MODE=svc-faults): the fault-tolerant
+    service path. One in-process sidecar owns the device; tenant threads
+    drive warm delta sessions through seeded chaos-wrapped channels.
+
+    Phase A (overhead): one tenant at headline scale runs warm delta
+    windows over a BARE channel with the fault machinery off (no deadline,
+    no retries — the PR-8 call path), then the same session's channel is
+    swapped for a disabled ChaosChannel with the full deadline/backoff/
+    budget policy on; best-window ratio must stay within
+    SVCFAULTS_OVERHEAD (<=5%): resilience must be free when the wire is
+    healthy.
+
+    Phase B (faults): SVCFAULTS_TENANTS tenants each churn
+    SVCFAULTS_WINDOWS warm windows while their injector fires
+    drop/delay/duplicate/disconnect at SVCFAULTS_RATE each. In-bench
+    asserts pin the tentpole: every window completes (zero wedged
+    sessions) and stays DELTA-resident with ZERO resyncs (lost requests
+    retry, lost responses recover from the request-digest dedupe cache —
+    the session never falls back to a snapshot), p99 round trip holds
+    SVCFAULTS_P99_BUDGET, faults actually fired, and a final
+    parity-probed solve per tenant re-solves the faulted session's state
+    COLD server-side byte-identically (the session state survived the
+    chaos uncorrupted)."""
+    import threading
+
+    import grpc as _grpc
+    import numpy as _np
+
+    from karpenter_tpu.sidecar.client import (RemoteScheduler, RetryPolicy,
+                                              SolverSession)
+    from karpenter_tpu.sidecar.server import GRPC_OPTIONS, serve
+    from karpenter_tpu.sidecar.wire_chaos import ChaosChannel
+    from karpenter_tpu.utils.chaos import WireFaultInjector
+
+    n_its = N_ITS or 2000
+    catalog = _catalog(n_its)
+    _scheduler(n_its).solve(_pods())  # warm the jit cache at bench shapes
+    server, port = serve()
+    addr = f"127.0.0.1:{port}"
+
+    def nodepool():
+        return NodePool(metadata=ObjectMeta(name="default"),
+                        spec=NodePoolSpec(template=NodeClaimTemplate(
+                            spec=NodeClaimTemplateSpec())))
+
+    def refresh(p, tag):
+        return Pod(metadata=ObjectMeta(name=f"{p.metadata.name}.{tag}",
+                                       namespace=p.namespace,
+                                       labels=p.metadata.labels,
+                                       annotations=p.metadata.annotations,
+                                       creation_timestamp=p.metadata
+                                       .creation_timestamp),
+                   spec=p.spec, container_requests=p.container_requests,
+                   init_container_requests=p.init_container_requests,
+                   is_daemonset_pod=p.is_daemonset_pod)
+
+    def windows(rs, session, pods, n, record):
+        for w in range(n):
+            n_churn = max(1, int(len(pods) * 1.2 / 100.0))
+            for k in range(n_churn):
+                i = (w * 9973 + k * 7919) % len(pods)
+                pods[i] = refresh(pods[i], f"{record['tag']}.{w}.{k}")
+            t0 = time.perf_counter()
+            r = rs.solve(pods)
+            record["times"].append(time.perf_counter() - t0)
+            record["kinds"].append(session.last_encode_kind)
+            record["retries"] += r.retries
+            assert all(nc.api_nodeclaim is not None
+                       for nc in r.new_nodeclaims)
+
+    policy = RetryPolicy(deadline=15.0, max_attempts=6, backoff_base=0.02,
+                         backoff_cap=0.25, retry_budget=64.0, refund=1.0)
+
+    try:
+        # -- phase A: chaos-off overhead at headline scale -------------------
+        # alternating windows on ONE session — bare call path, then the
+        # full fault machinery over a disabled chaos channel, repeated —
+        # so host drift lands on both arms and best-window mins compare
+        # like with like
+        pods0 = _pods()
+        bare_policy = RetryPolicy(deadline=0.0, max_attempts=1)
+        raw_channel = None
+        bare = SolverSession(addr, tenant="svc-base", retry=bare_policy)
+        raw_channel = bare._channel
+        off_inj = WireFaultInjector(seed=1)
+        off_inj.enabled = False
+        chaos_channel = ChaosChannel(raw_channel, off_inj)
+        rs0 = RemoteScheduler(addr, [nodepool()], {"default": catalog},
+                              session=bare)
+        rs0.solve(pods0)  # bootstrap outside any timed window
+        a_bare = {"tag": "a0", "times": [], "kinds": [], "retries": 0}
+        a_off = {"tag": "a1", "times": [], "kinds": [], "retries": 0}
+        for _ in range(max(5, SVCFAULTS_WINDOWS)):
+            bare._channel, bare.retry = raw_channel, bare_policy
+            windows(rs0, bare, pods0, 1, a_bare)
+            bare._channel, bare.retry = chaos_channel, policy
+            bare._retry_tokens = policy.retry_budget
+            windows(rs0, bare, pods0, 1, a_off)
+        overhead = min(a_off["times"]) / min(a_bare["times"]) - 1.0
+        assert overhead <= SVCFAULTS_OVERHEAD, (
+            f"chaos-off service path costs {overhead:+.1%} vs the bare "
+            f"call path (budget {SVCFAULTS_OVERHEAD:.0%}): the fault "
+            "machinery is taxing the healthy wire")
+        bare.close()
+
+        # -- phase B: multi-tenant warm traffic under seeded wire faults -----
+        saved = (N_PODS, N_DEPLOYS)
+        globals()["N_PODS"] = max(200, saved[0] // max(1, SVCFAULTS_TENANTS))
+        globals()["N_DEPLOYS"] = max(6, saved[1] // max(1, SVCFAULTS_TENANTS))
+        try:
+            tenant_pods = {f"svcf-{i}": _pods()
+                           for i in range(SVCFAULTS_TENANTS)}
+        finally:
+            globals()["N_PODS"], globals()["N_DEPLOYS"] = saved
+        stats, errors = {}, []
+
+        def drive(idx, name, pods):
+            try:
+                inj = WireFaultInjector(seed=4000 + idx)
+                raw = _grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+                session = SolverSession(
+                    addr, channel=ChaosChannel(raw, inj), tenant=name,
+                    retry=policy)
+                rs = RemoteScheduler(addr, [nodepool()],
+                                     {"default": catalog}, session=session)
+                rs.solve(pods)  # bootstrap, fault-free
+                rec = {"tag": f"b{idx}", "times": [], "kinds": [],
+                       "retries": 0}
+                inj.set_rates(drop=SVCFAULTS_RATE, delay=SVCFAULTS_RATE,
+                              duplicate=SVCFAULTS_RATE,
+                              disconnect=SVCFAULTS_RATE,
+                              delay_seconds=0.02)
+                # every tenant deterministically exercises each recovery
+                # path at least once, on top of the seeded background
+                # rates: a lost REQUEST (backoff retry), a lost RESPONSE
+                # (retry served by the dedupe cache), and a retransmit
+                # duplicate (second delivery deduped)
+                inj.inject_next("drop")
+                inj.inject_next("disconnect")
+                inj.inject_next("duplicate")
+                windows(rs, session, pods, SVCFAULTS_WINDOWS, rec)
+                inj.enabled = False
+                # the chaos-churned session must re-solve COLD from full
+                # state byte-identically: state survived uncorrupted
+                session.parity_every = 1
+                rs.solve(pods)
+                session.parity_every = 0
+                rec["parity"] = session.last_parity
+                rec["resyncs"] = session.resyncs
+                rec["faults"] = dict(inj.counts)
+                stats[name] = rec
+                session.close()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append((name, repr(e)))
+
+        threads = [threading.Thread(target=drive, args=(i, name, pods))
+                   for i, (name, pods) in enumerate(tenant_pods.items())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(stats) == SVCFAULTS_TENANTS, (sorted(stats),
+                                                 SVCFAULTS_TENANTS)
+    finally:
+        server.stop(0)
+
+    from collections import Counter as _Counter
+    fault_times, faults_total, retries_total = [], _Counter(), 0
+    for name, rec in sorted(stats.items()):
+        assert all(k == "delta" for k in rec["kinds"]), (name, rec["kinds"])
+        assert rec["resyncs"] == 0, (
+            f"tenant {name} resynced {rec['resyncs']}x under wire faults — "
+            "the dedupe/retry path failed to keep the session delta-"
+            "resident")
+        assert rec["parity"] == "byte-identical", (name, rec["parity"])
+        fault_times += rec["times"]
+        retries_total += rec["retries"]
+        for k, v in rec["faults"].items():
+            faults_total[k] += v
+    assert sum(faults_total.values()) >= 3 * SVCFAULTS_TENANTS, (
+        f"only {dict(faults_total)} wire faults fired — the forced "
+        "drop/disconnect/duplicate per tenant did not land")
+    assert retries_total >= 2 * SVCFAULTS_TENANTS, (
+        f"{retries_total} retries across {SVCFAULTS_TENANTS} tenants: the "
+        "forced drop+disconnect should cost two retries per tenant")
+    p50 = float(_np.percentile(fault_times, 50))
+    p99 = float(_np.percentile(fault_times, 99))
+    assert p99 <= SVCFAULTS_P99_BUDGET, (
+        f"p99 round trip {p99:.3f}s under {SVCFAULTS_RATE:.0%} wire faults "
+        f"exceeds the {SVCFAULTS_P99_BUDGET}s budget")
+    from karpenter_tpu.metrics.registry import SIDECAR_DEDUP_HITS
+    dedup_hits = sum(SIDECAR_DEDUP_HITS._values.values())
+    assert dedup_hits >= SVCFAULTS_TENANTS, (
+        f"{dedup_hits} dedupe hits: every tenant's forced disconnect "
+        "should recover its lost response from the request-digest cache")
+    n_pods = len(next(iter(tenant_pods.values())))
+    print(json.dumps({
+        "metric": (f"sidecar service under wire faults: {SVCFAULTS_TENANTS} "
+                   f"tenants x {SVCFAULTS_WINDOWS} warm delta windows at "
+                   f"{n_pods} pods x {n_its} instance types each, seeded "
+                   f"{SVCFAULTS_RATE:.0%} drop/delay/duplicate/disconnect; "
+                   "zero wedged sessions, zero resyncs, cold parity "
+                   "byte-identical, chaos-off overhead asserted in-bench"),
+        "value": round(n_pods / p99, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(n_pods / p99 / 100.0, 2),
+        "seconds": round(p99, 3),
+        "fault_p50_ms": round(p50 * 1000, 1),
+        "fault_p99_ms": round(p99 * 1000, 1),
+        "overhead_pct": round(overhead * 100, 2),
+        "faults": dict(faults_total),
+        "retries": retries_total,
+        "dedup_hits": int(dedup_hits),
+        "resyncs": 0,
+        "parity_samples": SVCFAULTS_TENANTS,
+        "zero_wedged": True,
+        "tenants": SVCFAULTS_TENANTS,
+    }), flush=True)
+
+
 def bench_mesh_local():
     """North-star config solved over a MESH_DEVICES-device mesh (VERDICT r2
     #9): the full solve with the feasibility precompute sharded (groups x
@@ -1961,6 +2195,9 @@ def main():
     if MODE == "service":
         bench_service()
         return
+    if MODE == "svc-faults":
+        bench_svc_faults()
+        return
     if MODE == "minvalues":
         bench_minvalues()
         return
@@ -1986,8 +2223,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
-            "mesh-headroom|meshscale|sidecar|service|minvalues|faults|"
-            "replay|drought|churn|trace|sim")
+            "mesh-headroom|meshscale|sidecar|service|svc-faults|minvalues|"
+            "faults|replay|drought|churn|trace|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

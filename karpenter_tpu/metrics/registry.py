@@ -400,6 +400,42 @@ SIDECAR_RESYNCS = REGISTRY.counter(
     "session evictions, unknown-session hits from stale clients",
     ("reason",), max_series=16)
 
+# -- fault-tolerant service path (ISSUE 11): crash-safe server + resilient
+# client. Server side: tenant-fair load shedding, drain state, and the
+# request-digest dedupe cache that makes retries/hedges idempotent. Client
+# side: deadline/backoff retries and hedged solves. ---------------------------
+
+SIDECAR_SHED = REGISTRY.counter(
+    "karpenter_sidecar_shed_total",
+    "Solve requests shed from the sidecar admission queue: 'fairness' = a "
+    "burst tenant's newest waiter evicted so an under-share tenant could "
+    "enqueue, 'overload' = rejected at the saturated bound, 'draining' = "
+    "NACKed during graceful drain (all retryable client-side)",
+    ("tenant", "reason"), max_series=128)
+SIDECAR_DEDUP_HITS = REGISTRY.counter(
+    "karpenter_sidecar_dedup_hits_total",
+    "Session solve requests served from the request-digest response cache "
+    "(a retry or hedge of a request the server already applied — the "
+    "at-most-once-apply guarantee), per tenant (bounded label)",
+    ("tenant",), max_series=64)
+SIDECAR_DRAINING = REGISTRY.gauge(
+    "karpenter_sidecar_draining",
+    "1 while the sidecar is draining (new RPCs NACKed UNAVAILABLE, "
+    "in-flight solves finishing), 0 otherwise")
+SIDECAR_CLIENT_RETRIES = REGISTRY.counter(
+    "karpenter_sidecar_client_retries_total",
+    "Client-side RPC retries by status code that triggered them "
+    "(unavailable, deadline_exceeded, resource_exhausted; jittered "
+    "exponential backoff under a token retry budget)",
+    ("code",), max_series=16)
+SIDECAR_CLIENT_HEDGES = REGISTRY.counter(
+    "karpenter_sidecar_client_hedges_total",
+    "Hedged solve RPCs: 'fired' = a second identical request launched "
+    "after hedge_delay with no response, 'won' = the hedge answered first "
+    "(safe: solves are pure functions of session state and the server "
+    "dedupes by request digest)",
+    ("outcome",), max_series=8)
+
 # -- trace-driven fleet simulator (sim/) -----------------------------------
 # The simulator's own aggregate truth lives in its report/ledger (those are
 # digested for determinism); these families exist so a sim run serves the

@@ -260,6 +260,32 @@ def _debug_slo_factory(slo):
     return fn
 
 
+def _debug_sessions_factory(sessions):
+    """The sidecar's session-table surface (ISSUE 11 satellite, the
+    /debug/offerings snapshot pattern): per-tenant session digest, queue
+    depth, in-flight count, last-solve age and resync/dedupe counters —
+    the first stop when karpenter_sidecar_session_resyncs_total moves or a
+    tenant reports slow solves. `sessions` is a snapshot callable
+    (sidecar.server.sessions_snapshot) so the HTTP thread never walks live
+    state."""
+    def fn():
+        if sessions is None:
+            return 404, "text/plain", "no sidecar session table attached"
+        entries = sessions()
+        lines = [f"sessions {len(entries)}"]
+        for e in entries:
+            lines.append(
+                f"{e['session']} tenant={e['tenant']} digest={e['digest']} "
+                f"rows={e['rows']} nodes={e['nodes']} "
+                f"templates={e['templates']} in_flight={e['in_flight']} "
+                f"queue_depth={e['queue_depth']} "
+                f"last_solve_age_s={e['last_solve_age_s']} "
+                f"solves={e['solves']} resyncs={e['resyncs']} "
+                f"dedup_hits={e['dedup_hits']}")
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    return fn
+
+
 def _debug_timers_factory(manager):
     def fn():
         if manager is None:
@@ -288,7 +314,8 @@ class ServingGroup:
                  healthy: Callable[[], bool] = lambda: True,
                  ready: Callable[[], bool] = lambda: True,
                  registry=REGISTRY, profiling: bool = False, manager=None,
-                 flightrec=None, unavailable=None, tracer=None, slo=None):
+                 flightrec=None, unavailable=None, tracer=None, slo=None,
+                 sessions=None):
         def probe(check: Callable[[], bool]):
             def fn():
                 if check():
@@ -317,6 +344,11 @@ class ServingGroup:
             metrics_routes["/debug/traces"] = _debug_traces_factory(tracer)
         if slo is not None:
             metrics_routes["/debug/slo"] = _debug_slo_factory(slo)
+        if sessions is not None:
+            # the sidecar's session table (sidecar.server.sessions_snapshot
+            # callable): operational like /debug/offerings
+            metrics_routes["/debug/sessions"] = \
+                _debug_sessions_factory(sessions)
         if profiling:
             metrics_routes["/debug/stacks"] = _debug_stacks
             metrics_routes["/debug/timers"] = _debug_timers_factory(manager)

@@ -8,6 +8,10 @@ star.
 
 from __future__ import annotations
 
+import os
+import random
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -16,6 +20,111 @@ import grpc
 from ..api.objects import Pod
 from . import codec
 from .server import SERVICE
+
+# gRPC codes the resilient client treats as RETRYABLE: the request (or
+# its response) plausibly never made it, or the server shed it from the
+# admission queue BEFORE applying it (RESOURCE_EXHAUSTED — the "back off
+# and retry here" contract the shed reasons document). A retry of the
+# identical bytes is safe because the server dedupes session solves by
+# request digest (at-most-once apply) and the one-shot Solve is stateless.
+_RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED,
+              grpc.StatusCode.RESOURCE_EXHAUSTED)
+_RETRY_LABELS = {
+    grpc.StatusCode.UNAVAILABLE: "unavailable",
+    grpc.StatusCode.DEADLINE_EXCEEDED: "deadline_exceeded",
+    grpc.StatusCode.RESOURCE_EXHAUSTED: "resource_exhausted",
+}
+
+
+@dataclass
+class RetryPolicy:
+    """Fault policy for every sidecar RPC (ISSUE 11): a per-RPC deadline
+    so a stalled server can never hang the caller, jittered exponential
+    backoff between retries of retryable codes (UNAVAILABLE /
+    DEADLINE_EXCEEDED), a token retry BUDGET so a down server gets a
+    bounded retry storm instead of max_attempts per caller forever
+    (retries spend a token, successes refund `refund` up to the budget —
+    the SRE retry-budget shape), and optional HEDGING: after
+    ``hedge_delay`` seconds with no response a second identical request
+    races the first (safe: a solve is a pure function of session state
+    and the server dedupes by request digest). ``sleep`` is injectable so
+    tests and the simulator never wait wall-clock backoff."""
+
+    deadline: float = 120.0      # per-RPC seconds; <= 0 disables. Sized
+    #                              well above the worst legitimate
+    #                              service-path solve (headline 50k-pod
+    #                              bootstrap is ~2s; the repo's largest
+    #                              solver runs are ~2min) — a deadline a
+    #                              slow-but-healthy solve can exceed turns
+    #                              it into a hard failure that re-solving
+    #                              cannot fix
+    max_attempts: int = 4        # total attempts per RPC (1 = no retry)
+    backoff_base: float = 0.05
+    backoff_mult: float = 2.0
+    backoff_cap: float = 2.0
+    jitter: float = 0.5          # +/- fraction of the delay
+    hedge_delay: float = 0.0     # seconds; <= 0 disables hedging
+    retry_budget: float = 8.0    # token bucket ceiling
+    refund: float = 0.5          # tokens refunded per successful RPC
+    sleep: "object" = time.sleep
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            deadline=float(os.environ.get("KARPENTER_SIDECAR_DEADLINE",
+                                          "120")),
+            max_attempts=int(os.environ.get(
+                "KARPENTER_SIDECAR_MAX_ATTEMPTS", "4")),
+            hedge_delay=float(os.environ.get(
+                "KARPENTER_SIDECAR_HEDGE_DELAY", "0")))
+
+
+def _retry_attempts(attempt, rp: RetryPolicy, rng: random.Random,
+                    spend_token, refund_token):
+    """The one attempt loop both client surfaces share: retryable wire
+    faults (UNAVAILABLE / DEADLINE_EXCEEDED) back off with jitter and
+    resend the IDENTICAL bytes until max_attempts or the token retry
+    budget runs dry; every other status propagates to the caller's
+    structural handling. Returns (response, retries_taken)."""
+    from ..metrics.registry import SIDECAR_CLIENT_RETRIES
+    delay = rp.backoff_base
+    attempt_no = 1
+    retries = 0
+    while True:
+        try:
+            response = attempt()
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            if code not in _RETRYABLE or attempt_no >= rp.max_attempts \
+                    or not spend_token():
+                raise
+            SIDECAR_CLIENT_RETRIES.inc({"code": _RETRY_LABELS[code]})
+            retries += 1
+            jittered = delay * (1.0 + rp.jitter
+                                * (2.0 * rng.random() - 1.0))
+            rp.sleep(max(0.0, jittered))
+            delay = min(delay * rp.backoff_mult, rp.backoff_cap)
+            attempt_no += 1
+            continue
+        refund_token()
+        return response, retries
+
+
+class _RetryBudgetMixin:
+    """The token retry budget both client surfaces hang off `self.retry`:
+    retries spend a token, successes refund `retry.refund` up to the
+    `retry.retry_budget` ceiling (`_retry_tokens` is the live level —
+    harnesses reset it directly when swapping policies)."""
+
+    def _spend_retry_token(self) -> bool:
+        if self._retry_tokens < 1.0:
+            return False
+        self._retry_tokens -= 1.0
+        return True
+
+    def _refund_retry_token(self) -> None:
+        self._retry_tokens = min(self.retry.retry_budget,
+                                 self._retry_tokens + self.retry.refund)
 
 
 @dataclass
@@ -80,12 +189,19 @@ class RemoteResults:
     parity: str = ""             # parity_check samples: "byte-identical"
     queue_wait_ms: float = 0.0   # admission-queue wait server-side
     warm: str = ""               # warm-pack outcome (ProblemState.last)
+    # fault-path riders (ISSUE 11): how this answer survived the wire
+    degraded: str = ""           # "host_oracle" when the circuit breaker
+    #                              forced the fallback path server-side
+    partition: tuple = (0, 0)    # (tensor_pods, host_pods) server-side
+    deadline_s: float = 0.0      # per-RPC deadline this solve ran under
+    retries: int = 0             # wire retries this solve needed
+    hedged: bool = False         # a hedged request produced this answer
 
     def all_pods_scheduled(self) -> bool:
         return not self.pod_errors
 
 
-class SolverSession:
+class SolverSession(_RetryBudgetMixin):
     """Persistent DELTA solver session over one gRPC channel.
 
     The heavy, slow-changing inputs — nodepools, the instance-type catalog,
@@ -106,10 +222,20 @@ class SolverSession:
     hands over fresh objects with unchanged content."""
 
     def __init__(self, address: str, channel: Optional[grpc.Channel] = None,
-                 tenant: str = "", parity_every: int = 0):
+                 tenant: str = "", parity_every: int = 0,
+                 retry: Optional[RetryPolicy] = None):
         from .server import GRPC_OPTIONS
         self.address = address
         self.tenant = tenant
+        # fault policy: deadline + jittered backoff + retry budget +
+        # optional hedging for every RPC this session issues. The jitter
+        # RNG is entropy-seeded: identical replicas retrying the same
+        # outage must NOT share a schedule (synchronized retry waves are
+        # what jitter exists to prevent). Jitter only shapes wall-clock
+        # sleeps, so the simulator's ledger digest is unaffected.
+        self.retry = retry if retry is not None else RetryPolicy.from_env()
+        self._rng = random.Random()
+        self._retry_tokens = self.retry.retry_budget
         # every Nth solve carries parity_check: the server re-solves the
         # identical session state COLD (no ProblemState) and compares
         # canonical decision digests — the sampled delta-vs-cold audit
@@ -140,11 +266,18 @@ class SolverSession:
         self._ds_token = ""
         self._cluster_token = ""
         self._solve_seq = 0
+        import itertools
+        self._req_seq = itertools.count(1)  # idempotency nonce sequence
         # -- observability ---------------------------------------------------
         self.resyncs = 0             # error-driven full resyncs
+        self.retries = 0             # wire-fault retries (UNAVAILABLE/
+        #                              DEADLINE_EXCEEDED, backoff path)
+        self.hedges = 0              # hedged requests fired
+        self.hedges_won = 0          # hedges that answered first
         self.last_encode_kind = ""
         self.last_parity = ""
         self.last_queue_wait_ms = 0.0
+        self._hedged_last = False
 
     def close(self) -> None:
         self._channel.close()
@@ -170,10 +303,62 @@ class SolverSession:
     # -- session management --------------------------------------------------
 
     def _call(self, method: str, payload: bytes) -> bytes:
+        """One raw RPC attempt under the per-RPC deadline."""
         call = self._channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=None, response_deserializer=None)
-        return call(payload)
+        rp = self.retry
+        timeout = rp.deadline if rp.deadline and rp.deadline > 0 else None
+        return call(payload, timeout=timeout)
+
+    def _call_hedged(self, method: str, payload: bytes) -> bytes:
+        """One attempt, optionally hedged: if the primary hasn't answered
+        within hedge_delay, fire an identical request and take whichever
+        answers first (the server's request-digest dedupe makes the
+        duplicate free — at most one delta apply + solve happens)."""
+        rp = self.retry
+        if not rp.hedge_delay or rp.hedge_delay <= 0:
+            return self._call(method, payload)
+        call = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=None, response_deserializer=None)
+        timeout = rp.deadline if rp.deadline and rp.deadline > 0 else None
+        f1 = call.future(payload, timeout=timeout)
+        try:
+            return f1.result(timeout=rp.hedge_delay)
+        except grpc.FutureTimeoutError:
+            pass  # no answer yet: hedge
+        from ..metrics.registry import SIDECAR_CLIENT_HEDGES
+        SIDECAR_CLIENT_HEDGES.inc({"outcome": "fired"})
+        self.hedges += 1
+        f2 = call.future(payload, timeout=timeout)
+        done = threading.Event()
+        f1.add_done_callback(lambda _f: done.set())
+        f2.add_done_callback(lambda _f: done.set())
+        while True:
+            done.wait()
+            done.clear()
+            for f, other in ((f1, f2), (f2, f1)):
+                if f.done() and f.exception() is None:
+                    other.cancel()
+                    if f is f2:
+                        SIDECAR_CLIENT_HEDGES.inc({"outcome": "won"})
+                        self.hedges_won += 1
+                        self._hedged_last = True
+                    return f.result()
+            if f1.done() and f2.done():
+                raise f1.exception()  # both failed: surface the primary's
+
+    def _call_resilient(self, method: str, payload: bytes) -> bytes:
+        """Shared attempt loop (_retry_attempts) over the hedged call;
+        non-retryable statuses propagate to the structural handler in
+        solve() (NOT_FOUND -> session recreate, FAILED_PRECONDITION ->
+        resync)."""
+        response, retries = _retry_attempts(
+            lambda: self._call_hedged(method, payload), self.retry,
+            self._rng, self._spend_retry_token, self._refund_retry_token)
+        self.retries += retries
+        return response
 
     def _catalog_signature(self, nodepools, instance_types):
         ids = tuple(id(np_) for np_ in nodepools) + tuple(
@@ -202,7 +387,8 @@ class SolverSession:
             payload = codec.encode_session_request(nodepools, instance_types,
                                                    tenant=self.tenant)
             import json as _json
-            resp = _json.loads(self._call("CreateSession", payload).decode())
+            resp = _json.loads(
+                self._call_resilient("CreateSession", payload).decode())
             self._session_id = resp["session"]
             self._content_key = (key if key is not None else
                                  self._content_digest(nodepools,
@@ -451,37 +637,68 @@ class SolverSession:
         self._solve_seq += 1
         parity = bool(self.parity_every
                       and self._solve_seq % self.parity_every == 0)
-        header, blobs, commit, order = self._delta_request(
-            pods, state_nodes, daemonset_pods, cluster, store, parity)
-        try:
-            response = self._call("SolveSession", wire.pack(header, blobs))
-        except grpc.RpcError as e:
-            code = getattr(e, "code", lambda: None)()
-            if code == grpc.StatusCode.NOT_FOUND:
-                # server restarted / session evicted: recreate the session
-                # and resync transparently
-                self._session_id = None
-                self.resyncs += 1
-                self._ensure_session(nodepools, instance_types)
-            elif code in (grpc.StatusCode.FAILED_PRECONDITION,
-                          grpc.StatusCode.INVALID_ARGUMENT):
-                # FAILED_PRECONDITION = content-digest mismatch;
-                # INVALID_ARGUMENT = a malformed delta the server rejected
-                # BEFORE the handshake (e.g. a lost response left our
-                # template/row mirrors behind the server's, so re-sent
-                # registrations violate contiguity). Both mean the mirrors
-                # can't be trusted: full-snapshot resync, retry ONCE — a
-                # genuinely broken request fails again and raises.
-                self.resyncs += 1
-                self.force_resync()
-            else:
-                raise
+        retries_before = self.retries
+        # structural-recovery budget: each entry is a mirror rebuild, not a
+        # wire retry (those live inside _call_resilient). Two covers the
+        # worst healthy chain — a server restart (NOT_FOUND -> recreate)
+        # whose fresh session then still needs a digest-driven resync; a
+        # third structural failure means something is genuinely broken.
+        rebuilds_left = 2
+        while True:
             header, blobs, commit, order = self._delta_request(
                 pods, state_nodes, daemonset_pods, cluster, store, parity)
-            response = self._call("SolveSession", wire.pack(header, blobs))
+            # idempotency nonce: every LOGICAL request gets a fresh id;
+            # wire retries and hedges resend the identical bytes (same
+            # id), so the server's dedupe cache recognizes them — while
+            # two logically distinct requests that happen to carry the
+            # same state bytes (a resync rebuilding the exact bootstrap
+            # snapshot) can never collide into a stale cached response
+            header["req"] = f"q{next(self._req_seq)}"
+            # reset HERE, not before the loop: a hedged CreateSession
+            # inside a NOT_FOUND recovery also sets the flag, and the
+            # rider must report whether THIS solve's answer came from a
+            # hedge, not whether any RPC on the way did
+            self._hedged_last = False
+            try:
+                # retryable wire faults (UNAVAILABLE / DEADLINE_EXCEEDED)
+                # are retried INSIDE _call_resilient with the identical
+                # bytes: the server's request-digest dedupe makes that
+                # at-most-once apply, so a lost RESPONSE is recovered from
+                # the cache instead of desyncing the session
+                response = self._call_resilient("SolveSession",
+                                                wire.pack(header, blobs))
+                break
+            except grpc.RpcError as e:
+                code = getattr(e, "code", lambda: None)()
+                if rebuilds_left <= 0:
+                    raise
+                rebuilds_left -= 1
+                if code == grpc.StatusCode.NOT_FOUND:
+                    # server restarted / session evicted: recreate the
+                    # session and resync transparently
+                    self._session_id = None
+                    self.resyncs += 1
+                    self._ensure_session(nodepools, instance_types)
+                elif code in (grpc.StatusCode.FAILED_PRECONDITION,
+                              grpc.StatusCode.INVALID_ARGUMENT):
+                    # FAILED_PRECONDITION = content-digest mismatch;
+                    # INVALID_ARGUMENT = a malformed delta the server
+                    # rejected BEFORE the handshake (e.g. a retry-budget
+                    # exhaustion left our template/row mirrors behind the
+                    # server's, so re-sent registrations violate
+                    # contiguity). Both mean the mirrors can't be trusted:
+                    # full-snapshot resync and rebuild — a genuinely
+                    # broken request fails again and raises.
+                    self.resyncs += 1
+                    self.force_resync()
+                else:
+                    raise
         commit()
         results = decode_results_rows(response, order,
                                       codec.union_catalog(instance_types))
+        results.deadline_s = self.retry.deadline
+        results.retries = self.retries - retries_before
+        results.hedged = self._hedged_last
         self.last_encode_kind = results.encode_kind
         self.last_parity = results.parity
         self.last_queue_wait_ms = results.queue_wait_ms
@@ -536,6 +753,8 @@ def decode_results_rows(data: bytes, pods: List[Pod], catalog: list
     results.parity = header.get("parity", "")
     results.queue_wait_ms = float(header.get("queue_wait_ms", 0.0))
     results.warm = header.get("warm", "")
+    results.degraded = header.get("degraded", "")
+    results.partition = tuple(header.get("partition", (0, 0)))
     shape_protos = []
     shape_reqs = []
     shape_its = []
@@ -570,11 +789,12 @@ def decode_results_rows(data: bytes, pods: List[Pod], catalog: list
     return results
 
 
-class RemoteScheduler:
+class RemoteScheduler(_RetryBudgetMixin):
     def __init__(self, address: str, nodepools, instance_types,
                  state_nodes=(), daemonset_pods=(), cluster=None,
                  channel: Optional[grpc.Channel] = None,
-                 session: Optional[SolverSession] = None):
+                 session: Optional[SolverSession] = None,
+                 retry: Optional[RetryPolicy] = None):
         self.address = address
         self.nodepools = list(nodepools)
         self.instance_types = instance_types
@@ -586,12 +806,36 @@ class RemoteScheduler:
         self.cluster = cluster
         self.fallback_reason = ""
         self.session = session
+        self._last: Optional[RemoteResults] = None
         if session is not None:
             self._channel = session._channel
+            if retry is not None:
+                # the session issues every RPC on this path, so the
+                # caller's policy must land ON the session — stored only
+                # here it would silently never apply
+                session.retry = retry
+                session._retry_tokens = retry.retry_budget
+            self.retry = session.retry
         else:
             from .server import GRPC_OPTIONS
             self._channel = channel or grpc.insecure_channel(
                 address, options=GRPC_OPTIONS)
+            self.retry = retry if retry is not None else \
+                RetryPolicy.from_env()
+        self._rng = random.Random()  # entropy-seeded: see SolverSession
+        self._retry_tokens = self.retry.retry_budget
+
+    # observer-facing mirrors of the TensorScheduler surface, so a solve
+    # observer (the fleet simulator) reads the same fields either way
+    @property
+    def encode_kind(self) -> str:
+        return self._last.encode_kind if self._last is not None else ""
+
+    @property
+    def partition(self) -> tuple:
+        if self._last is not None and any(self._last.partition):
+            return tuple(self._last.partition)
+        return (0, 0)
 
     def solve(self, pods: List[Pod]) -> RemoteResults:
         if self.session is not None:
@@ -600,8 +844,11 @@ class RemoteScheduler:
                 state_nodes=self.state_nodes,
                 daemonset_pods=self.daemonset_pods, cluster=self.cluster)
             self.fallback_reason = results.fallback_reason
+            self._last = results
             return results
-        return self._solve_oneshot(pods)
+        results = self._solve_oneshot(pods)
+        self._last = results
+        return results
 
     def _solve_oneshot(self, pods: List[Pod]) -> RemoteResults:
         request = codec.encode_solve_request(
@@ -611,7 +858,15 @@ class RemoteScheduler:
         call = self._channel.unary_unary(
             f"/{SERVICE}/Solve",
             request_serializer=None, response_deserializer=None)
-        response = call(request)
+        # the one-shot contract is stateless and pure, so retrying the
+        # identical bytes under the deadline/backoff policy needs no
+        # server-side dedupe to be safe; the token budget still bounds a
+        # long-lived scheduler's total retry storm against a down server
+        rp = self.retry
+        timeout = rp.deadline if rp.deadline and rp.deadline > 0 else None
+        response, retries = _retry_attempts(
+            lambda: call(request, timeout=timeout), rp, self._rng,
+            self._spend_retry_token, self._refund_retry_token)
         d = codec.decode_solve_response(response)
         self.fallback_reason = d["fallback_reason"]
         by_uid = {p.uid: p for p in pods}
@@ -630,4 +885,6 @@ class RemoteScheduler:
             results.existing_nodes.append(RemoteExistingNode(
                 name=item["name"],
                 pods=[by_uid[u] for u in item["pod_uids"] if u in by_uid]))
+        results.deadline_s = rp.deadline
+        results.retries = retries
         return results

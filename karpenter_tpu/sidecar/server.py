@@ -115,6 +115,23 @@ class _Session:
         # reap) must never tear state out from under a live request
         self.active = 0
         self.last_used = time.monotonic()
+        # request-digest response cache: a retry or hedge of request bytes
+        # the server ALREADY applied must be served the original response,
+        # never re-applied (re-applying a pod delta would corrupt the
+        # session; a solve is a pure function of session state, so the
+        # cached response IS the correct answer). Two entries cover the
+        # worst interleaving (a hedge racing a retry of the prior solve).
+        self.response_cache: "OrderedDict[str, bytes]" = OrderedDict()
+        # highest idempotency nonce applied: a cache-missing request with
+        # a LOWER nonce is a zombie (hedge/retry loser of a superseded
+        # solve) and must be rejected, never re-applied
+        self.last_req_seq = 0
+        # -- /debug/sessions counters -----------------------------------------
+        self.solves = 0              # completed delta solves
+        self.resyncs = 0             # full_state applies after bootstrap
+        self.dedup_hits = 0
+        self.last_digest = ""        # post-apply state digest of the last solve
+        self.last_solve_at = 0.0     # monotonic stamp of the last solve
 
 
 _SESSIONS: "OrderedDict[str, _Session]" = OrderedDict()
@@ -135,21 +152,57 @@ class QueueFullError(Exception):
     pass
 
 
+class ShedError(QueueFullError):
+    """A waiter (or would-be waiter) was shed from the admission queue;
+    ``reason`` picks the gRPC status the handler NACKs with: 'draining'
+    maps to UNAVAILABLE (retry against the replacement server), everything
+    else to RESOURCE_EXHAUSTED (back off and retry here)."""
+
+    def __init__(self, message: str, reason: str = "overload"):
+        super().__init__(message)
+        self.reason = reason
+
+
+def _shed_status(e: QueueFullError) -> grpc.StatusCode:
+    """The one shed-to-status mapping both handlers NACK with: a drain
+    shed is UNAVAILABLE (retry the replacement server), an overload or
+    fairness shed is RESOURCE_EXHAUSTED (back off, retry here)."""
+    return (grpc.StatusCode.UNAVAILABLE
+            if getattr(e, "reason", "") == "draining"
+            else grpc.StatusCode.RESOURCE_EXHAUSTED)
+
+
+class _Waiter:
+    __slots__ = ("event", "shed_reason")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.shed_reason: Optional[str] = None
+
+
 class AdmissionQueue:
     """Bounded admission in front of the device with round-robin tenant
     fairness: at most `max_concurrent` solves run (the device is serial, so
     the default is 1 — concurrency above that only helps multi-device
     hosts), at most `max_queued` wait, and when a slot frees the next grant
     rotates across tenants with waiters — one tenant's burst can never
-    head-of-line-block another's steady stream. Queue depth and wait time
-    are published per tenant (bounded label) on the karpenter_sidecar_*
+    head-of-line-block another's steady stream.
+
+    Saturation sheds by TENANT FAIRNESS, not globally: when the queue is
+    full, a tenant still under its fair share (max_queued / tenants with
+    waiters) evicts the NEWEST waiter of the tenant furthest over its
+    share instead of being bounced — a burst tenant absorbs its own
+    overload, a steady tenant keeps flowing. Only when every tenant sits
+    at fair share (the queue is fairly saturated) does the requester get
+    the RESOURCE_EXHAUSTED bounce. Queue depth, wait time and sheds are
+    published per tenant (bounded label) on the karpenter_sidecar_*
     families."""
 
     def __init__(self, max_concurrent: int = 1, max_queued: int = 64):
         self.max_concurrent = max(1, int(max_concurrent))
         self.max_queued = max(1, int(max_queued))
         self._lock = threading.Lock()
-        # tenant -> deque of waiter Events, in round-robin rotation order:
+        # tenant -> deque of _Waiters, in round-robin rotation order:
         # a granted tenant's (possibly emptied) queue moves to the back
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._active = 0
@@ -161,9 +214,44 @@ class AdmissionQueue:
         SIDECAR_QUEUE_DEPTH.set(float(len(q) if q else 0),
                                 {"tenant": tenant_label(tenant)})
 
+    def depth(self, tenant: str) -> int:
+        with self._lock:
+            q = self._queues.get(tenant)
+            return len(q) if q else 0
+
+    def _count_shed(self, tenant: str, reason: str) -> None:
+        from ..metrics.registry import SIDECAR_SHED, tenant_label
+        SIDECAR_SHED.inc({"tenant": tenant_label(tenant), "reason": reason})
+
+    def _shed_for(self, tenant: str) -> bool:
+        """Called under self._lock with the queue at its bound: try to make
+        room for `tenant` by evicting the newest waiter of the tenant
+        furthest over fair share. Returns False when the requester is at or
+        over its own share, or nobody is over share (fair saturation) —
+        the requester is the one shed then."""
+        tenants = set(self._queues) | {tenant}
+        share = max(1, self.max_queued // len(tenants))
+        mine = len(self._queues.get(tenant, ()))
+        if mine + 1 > share:
+            return False
+        victim_tenant, victim_len = None, share
+        for t, q in self._queues.items():
+            if len(q) > victim_len:
+                victim_tenant, victim_len = t, len(q)
+        if victim_tenant is None:
+            return False
+        w = self._queues[victim_tenant].pop()  # newest waiter
+        self._queued -= 1
+        w.shed_reason = "fairness"
+        w.event.set()
+        self._count_shed(victim_tenant, "fairness")
+        self._set_depth(victim_tenant)
+        return True
+
     def acquire(self, tenant: str) -> float:
         """Block until a device slot is granted; returns the wait in
-        seconds. Raises QueueFullError past the queue bound."""
+        seconds. Raises ShedError (a QueueFullError) when shed: at the
+        saturated bound, by a fairness eviction, or by a drain."""
         from ..metrics.registry import SIDECAR_QUEUE_WAIT, tenant_label
         t0 = time.monotonic()
         with self._lock:
@@ -172,18 +260,41 @@ class AdmissionQueue:
                 SIDECAR_QUEUE_WAIT.observe(
                     0.0, {"tenant": tenant_label(tenant)})
                 return 0.0
-            if self._queued >= self.max_queued:
-                raise QueueFullError(
+            if self._queued >= self.max_queued and not self._shed_for(tenant):
+                self._count_shed(tenant, "overload")
+                raise ShedError(
                     f"solver admission queue full ({self._queued} waiting, "
-                    f"bound {self.max_queued})")
-            ev = threading.Event()
-            self._queues.setdefault(tenant, deque()).append(ev)
+                    f"bound {self.max_queued}) and tenant {tenant!r} is at "
+                    "fair share", reason="overload")
+            w = _Waiter()
+            self._queues.setdefault(tenant, deque()).append(w)
             self._queued += 1
             self._set_depth(tenant)
-        ev.wait()
+        w.event.wait()
         wait = time.monotonic() - t0
+        if w.shed_reason is not None:
+            raise ShedError(
+                f"solve request shed from the admission queue after "
+                f"{wait:.3f}s ({w.shed_reason})", reason=w.shed_reason)
         SIDECAR_QUEUE_WAIT.observe(wait, {"tenant": tenant_label(tenant)})
         return wait
+
+    def shed_all(self, reason: str) -> int:
+        """NACK every queued waiter (graceful drain: stop accepting,
+        finish in-flight, bounce the queue with a retryable code)."""
+        with self._lock:
+            shed = 0
+            for tenant, q in list(self._queues.items()):
+                while q:
+                    w = q.pop()
+                    w.shed_reason = reason
+                    w.event.set()
+                    shed += 1
+                    self._count_shed(tenant, reason)
+                del self._queues[tenant]
+                self._set_depth(tenant)
+            self._queued = 0
+        return shed
 
     def release(self) -> None:
         with self._lock:
@@ -206,7 +317,7 @@ class AdmissionQueue:
             if granted is None:
                 self._active -= 1
         if granted is not None:
-            granted.set()  # the slot is handed over, _active unchanged
+            granted.event.set()  # the slot is handed over, _active unchanged
 
 
 ADMISSION = AdmissionQueue(
@@ -308,8 +419,7 @@ def _solve_session(request: bytes, context=None) -> bytes:
                 wait = ADMISSION.acquire(session.tenant)
             except QueueFullError as e:
                 if context is not None:
-                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                                  str(e))
+                    context.abort(_shed_status(e), str(e))
                 raise
             try:
                 if context is not None and not context.is_active():
@@ -326,9 +436,56 @@ def _solve_session(request: bytes, context=None) -> bytes:
         if legacy:
             return admitted(lambda wait: _solve_session_legacy(
                 session, header, blobs))
+        # dedupe keys on the request's idempotency nonce + full bytes: a
+        # retry or hedge resends IDENTICAL bytes (same nonce) and must be
+        # served the original response without re-applying; a logically
+        # fresh request always carries a fresh nonce, so identical state
+        # bytes (a resync rebuilding the exact bootstrap snapshot) can
+        # never alias into a stale answer. Nonce-less requests (older
+        # clients) skip the cache entirely — their retry semantics are
+        # the pre-ISSUE-11 resync path.
+        req_digest = (wire.content_digest(request)
+                      if header.get("req") else None)
+        req_seq = 0
+        if req_digest is not None:
+            try:
+                req_seq = int(str(header["req"]).lstrip("q"))
+            except ValueError:
+                req_seq = 0
         with session.lock:
-            return admitted(lambda wait: _solve_session_delta(
+            if req_digest is not None:
+                cached = session.response_cache.get(req_digest)
+                if cached is not None:
+                    from ..metrics.registry import SIDECAR_DEDUP_HITS, \
+                        tenant_label
+                    SIDECAR_DEDUP_HITS.inc(
+                        {"tenant": tenant_label(session.tenant)})
+                    session.dedup_hits += 1
+                    return cached
+                if req_seq and req_seq <= session.last_req_seq:
+                    # a ZOMBIE: a hedge/retry loser of an OLDER logical
+                    # request arriving after later solves evicted its
+                    # response from the cache. The client long since took
+                    # the winner's answer, so nobody reads this response —
+                    # the only wrong move is applying the stale delta on
+                    # top of newer state (corrupting the session and
+                    # forcing the resync DEVIATIONS 23 promises cannot
+                    # happen). Reject WITHOUT touching state.
+                    if context is not None:
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            f"stale request nonce q{req_seq} (session is "
+                            f"at q{session.last_req_seq}): hedge/retry "
+                            "loser of a superseded solve")
+                    raise ValueError("stale request nonce")
+            response = admitted(lambda wait: _solve_session_delta(
                 session, header, blobs, context, wait))
+            if req_digest is not None:
+                session.response_cache[req_digest] = response
+                session.last_req_seq = max(session.last_req_seq, req_seq)
+                while len(session.response_cache) > 2:
+                    session.response_cache.popitem(last=False)
+            return response
     finally:
         _release_session(session)
 
@@ -344,6 +501,8 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
         # longer tracks can't fail the handshake forever. The ProblemState
         # and the pinned catalog encoding survive — their caches are
         # content/identity-keyed and simply go dirty where the state did.
+        if session.solves:
+            session.resyncs += 1  # bootstrap full_state is not a resync
         session.template_list = []
         session.template_keys = []
         session.proto_cache = []
@@ -536,10 +695,20 @@ def _solve_session_delta(session: _Session, header: dict, blobs,
             "digest": digest,
             "queue_wait_ms": round(queue_wait * 1e3, 3),
             "warm": session.problem_state.last.get("warm", ""),
+            "partition": list(ts_sched.partition),
         }
+        if ts_sched.fallback_reason == "circuit_open":
+            # the PR-2 circuit breaker forced the host oracle: say so on
+            # the wire — a client must see `degraded=host_oracle`, not a
+            # silently slower answer (the breaker state is server-process
+            # truth the client has no other window into)
+            extra["degraded"] = "host_oracle"
         if header.get("parity_check"):
             extra["parity"] = _parity_probe(session, results, ts_sched,
                                             pods)
+        session.solves += 1
+        session.last_digest = digest
+        session.last_solve_at = time.monotonic()
         with TRACER.span("sidecar.encode"):
             return codec.encode_solve_response_rows(
                 results, ts_sched.fallback_reason,
@@ -590,7 +759,7 @@ def _solve(request: bytes, context=None) -> bytes:
         ADMISSION.acquire("")
     except QueueFullError as e:
         if context is not None:
-            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            context.abort(_shed_status(e), str(e))
         raise
     try:
         if context is not None and not context.is_active():
@@ -613,12 +782,30 @@ _METHODS = {
 
 
 class SolverServicer(grpc.GenericRpcHandler):
+    """Byte-level servicer; with a `draining` event set, every new RPC is
+    NACKed UNAVAILABLE before touching any session state — the retryable
+    code the resilient client backs off on and re-aims at the replacement
+    server (in-flight requests entered before the drain and finish)."""
+
+    def __init__(self, draining: Optional[threading.Event] = None):
+        self.draining = draining if draining is not None \
+            else threading.Event()
+
     def service(self, handler_call_details):
         fn = _METHODS.get(handler_call_details.method)
         if fn is not None:
             def handler(request, context, fn=fn):
+                # count the request BEFORE the draining check: a request
+                # that passes the check is already visible to drain()'s
+                # in-flight wait, so drain can never sample zero and
+                # return while an admitted solve is still starting
                 _request_started()
                 try:
+                    if self.draining.is_set():
+                        context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            "sidecar draining: not accepting new solves; "
+                            "retry against the replacement server")
                     return fn(request, context)
                 finally:
                     _request_finished()
@@ -678,12 +865,59 @@ def _idle_gc_loop(stop: threading.Event) -> None:
             gc.collect()
 
 
+def sessions_snapshot() -> List[dict]:
+    """Point-in-time view of every live session for /debug/sessions (the
+    /debug/offerings snapshot pattern: HTTP threads race the solve
+    threads, so the session list is copied under the lock and per-session
+    fields read as GIL-atomic scalars afterwards)."""
+    with _SESSIONS_LOCK:
+        sessions = list(_SESSIONS.values())
+    now = time.monotonic()
+    out = []
+    for s in sessions:
+        out.append({
+            "session": s.id,
+            "tenant": s.tenant,
+            "digest": (s.last_digest[:12] if s.last_digest else ""),
+            "rows": len(s.rows),
+            "nodes": len(s.state_nodes),
+            "templates": len(s.template_list),
+            "in_flight": s.active,
+            "queue_depth": ADMISSION.depth(s.tenant),
+            "last_solve_age_s": (round(now - s.last_solve_at, 3)
+                                 if s.last_solve_at else -1.0),
+            "solves": s.solves,
+            "resyncs": s.resyncs,
+            "dedup_hits": s.dedup_hits,
+        })
+    return out
+
+
+def start_serving(metrics_port: int = 0, health_port: int = 0,
+                  draining: Optional[threading.Event] = None):
+    """Health/readiness + /metrics + /debug/sessions for the sidecar
+    process: readyz flips 503 the moment a drain begins (a load balancer
+    stops routing new solves there) while healthz stays 200 as long as the
+    process lives — in-flight solves are still finishing and killing the
+    pod early would waste them. Returns the started ServingGroup."""
+    from ..operator.server import ServingGroup
+    return ServingGroup(
+        metrics_port, health_port,
+        healthy=lambda: True,
+        ready=lambda: draining is None or not draining.is_set(),
+        sessions=sessions_snapshot).start()
+
+
 def serve(port: int = 0, max_workers: int = 4,
           max_concurrent: Optional[int] = None,
           max_queued: Optional[int] = None):
     """Start the sidecar; returns (server, bound_port). `max_concurrent` /
     `max_queued` reconfigure the process-wide admission queue (the device
-    is shared, so the queue is too)."""
+    is shared, so the queue is too). The returned server additionally
+    carries `server.drain(grace)` — graceful drain: stop accepting
+    (UNAVAILABLE NACKs), NACK the queued waiters with the same retryable
+    code, wait up to `grace` seconds for in-flight solves — and
+    `server.draining` (the event start_serving's readiness probe reads)."""
     import gc
     if max_concurrent is not None:
         ADMISSION.max_concurrent = max(1, int(max_concurrent))
@@ -696,19 +930,41 @@ def serve(port: int = 0, max_workers: int = 4,
     t = threading.Thread(target=_idle_gc_loop, args=(stop,), daemon=True,
                          name="sidecar-idle-gc")
     t.start()
+    draining = threading.Event()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
-    server.add_generic_rpc_handlers((SolverServicer(),))
+    server.add_generic_rpc_handlers((SolverServicer(draining),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     _orig_stop = server.stop
+
+    def drain(grace: float = 10.0) -> int:
+        """Graceful drain; returns how many queued waiters were NACKed.
+        The admission queue is process-wide (it guards the device), so
+        the drain of its waiters is too."""
+        from ..metrics.registry import SIDECAR_DRAINING
+        draining.set()
+        SIDECAR_DRAINING.set(1.0)
+        shed = ADMISSION.shed_all("draining")
+        deadline = time.monotonic() + max(0.0, grace)
+        while time.monotonic() < deadline:
+            with _request_lock:
+                if _active_requests == 0:
+                    break
+            time.sleep(0.01)
+        return shed
 
     def stop_server(grace):
         stop.set()
         import gc
         gc.enable()
+        from ..metrics.registry import SIDECAR_DRAINING
+        if draining.is_set():
+            SIDECAR_DRAINING.set(0.0)  # this server is gone, not draining
         return _orig_stop(grace)
 
+    server.drain = drain
+    server.draining = draining
     server.stop = stop_server
     return server, bound
 
@@ -720,10 +976,35 @@ def main(argv=None) -> int:
     parser.add_argument("--max-queued", type=int, default=None,
                         help="admission queue bound (default: "
                              "$KARPENTER_SIDECAR_MAX_QUEUED or 64)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve /metrics + /debug/sessions on this "
+                             "port (0 = ephemeral; omit to disable)")
+    parser.add_argument("--health-port", type=int, default=None,
+                        help="serve /healthz + /readyz on this port "
+                             "(readyz flips 503 during drain; omit to "
+                             "disable)")
+    parser.add_argument("--drain-grace", type=float, default=10.0,
+                        help="seconds to wait for in-flight solves on "
+                             "SIGINT before stopping")
     args = parser.parse_args(argv)
     server, bound = serve(args.port, max_queued=args.max_queued)
+    serving = None
+    if args.metrics_port is not None or args.health_port is not None:
+        serving = start_serving(args.metrics_port or 0, args.health_port or 0,
+                                draining=server.draining)
+        print(f"sidecar metrics on :{serving.metrics_port}, health probes "
+              f"on :{serving.health_port}", flush=True)
     print(f"solver sidecar listening on 127.0.0.1:{bound}", flush=True)
-    server.wait_for_termination()
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        print("draining: NACKing queued solves, finishing in-flight",
+              flush=True)
+        server.drain(args.drain_grace)
+        server.stop(0)
+    finally:
+        if serving is not None:
+            serving.stop()
     return 0
 
 

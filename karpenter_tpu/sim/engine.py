@@ -179,8 +179,39 @@ class FleetSimulator:
         opts.batch_idle_duration = scenario.batch_idle
         opts.batch_max_duration = scenario.batch_max
         opts.kwok_ready_delay = scenario.ready_delay
+        # solver_backend=sidecar (ROADMAP item 5): boot a REAL in-process
+        # gRPC sidecar and point the operator's provisioning at it — the
+        # whole session wire + admission stack runs under the accelerated
+        # clock, and wire_chaos events can fault the wire itself
+        self.sidecar_server = None
+        self._sidecar_port = None
+        self.wire_injector = None
+        self.solver_session = None
+        self._wire_windows: List[dict] = []
+        if scenario.backend == "sidecar":
+            from ..sidecar import server as sidecar_server
+            self.sidecar_server, self._sidecar_port = \
+                sidecar_server.serve(port=0)
+            opts.solver_backend = "sidecar"
+            opts.solver_address = f"127.0.0.1:{self._sidecar_port}"
         self.op = Operator(options=opts, cloud_provider=self.chaos,
                            clock=self.clock)
+        if scenario.backend == "sidecar":
+            from ..sidecar.client import RetryPolicy
+            from ..sidecar.wire_chaos import ChaosChannel
+            from ..utils.chaos import WireFaultInjector
+            self.wire_injector = WireFaultInjector(seed=scenario.seed)
+            sess = self.op.solver_session
+            sess._channel = ChaosChannel(sess._channel, self.wire_injector)
+            # wire retries sleep WALL seconds while the FakeClock stands
+            # still: a tight backoff keeps fault recovery from costing
+            # the compression headline, and a deep retry budget reflects
+            # that the sim's whole point is surviving the fault windows
+            sess.retry = RetryPolicy(deadline=15.0, max_attempts=6,
+                                     backoff_base=0.01, backoff_cap=0.25,
+                                     retry_budget=64.0, refund=1.0)
+            sess._retry_tokens = sess.retry.retry_budget
+            self.solver_session = sess
         self.kwok.store = self.op.store
         # pre-install the drought schedule CLOCK so duration'd windows
         # (zonal outages) expire at their simulated instant
@@ -532,6 +563,61 @@ class FleetSimulator:
 
         self._after(duration, calm)
 
+    def _ev_wire_chaos(self, ev, t: float) -> None:
+        """Wire-fault window on the solver gRPC channel (scenario
+        validation guarantees backend=sidecar). The same window-stack
+        shape as `flaky`/`slo`: an earlier window's close restores the
+        most recently opened still-active window's rates."""
+        inj = self.wire_injector
+        p = ev.params
+        if p["kill_server"]:
+            self._restart_sidecar()
+        window = {k: p[k] for k in ("drop", "delay", "duplicate",
+                                    "disconnect", "delay_seconds")}
+        self._wire_windows.append(window)
+        inj.set_rates(**window)
+        self.ledger.append(t, "event", event="wire_chaos", drop=p["drop"],
+                           delay=p["delay"], duplicate=p["duplicate"],
+                           disconnect=p["disconnect"],
+                           kill_server=p["kill_server"],
+                           duration=p["duration"])
+
+        def calm():
+            self._wire_windows.remove(window)
+            live = (self._wire_windows[-1] if self._wire_windows else
+                    {"drop": 0.0, "delay": 0.0, "duplicate": 0.0,
+                     "disconnect": 0.0,
+                     "delay_seconds": inj.delay_seconds})
+            inj.set_rates(**live)
+            self.ledger.append(self._rel(), "wire_chaos_end")
+
+        self._after(p["duration"], calm)
+
+    def _restart_sidecar(self) -> None:
+        """Server-kill fault: the listener dies and every session dies
+        with it (the session table is process state), then a fresh server
+        binds the same port. Clients must recover transparently —
+        UNAVAILABLE retries while the listener is down, then NOT_FOUND ->
+        session recreate + full resync against the replacement."""
+        from ..sidecar import server as sidecar_server
+        done = self.sidecar_server.stop(0)
+        if done is not None:
+            done.wait(5.0)
+        with sidecar_server._SESSIONS_LOCK:
+            sidecar_server._SESSIONS.clear()
+        port = self._sidecar_port
+        self.sidecar_server, self._sidecar_port = sidecar_server.serve(
+            port=port)
+        if self._sidecar_port != port:
+            # the client still dials the old address; a silent rebind
+            # failure (add_insecure_port returns 0) would surface as an
+            # unrelated retry-exhaustion RpcError minutes later
+            raise RuntimeError(
+                f"sidecar restart could not rebind 127.0.0.1:{port} "
+                f"(got port {self._sidecar_port}): the kill_server "
+                "window cannot be simulated")
+        self.ledger.append(self._rel(), "sidecar_restart")
+
     def _ev_slo(self, ev, t: float) -> None:
         watcher = self.op.slo
         budgets = dict(ev.params["budgets"])
@@ -563,6 +649,23 @@ class FleetSimulator:
                     weight=pool.weight)))
 
     def run(self) -> dict:
+        try:
+            return self._run()
+        finally:
+            if self.sidecar_server is not None:
+                if self.solver_session is not None:
+                    self.solver_session.close()
+                self.sidecar_server.stop(0)
+                self.sidecar_server = None
+                # the session table is process-global and this server's
+                # idle-GC reaper died with it: drop the run's sessions
+                # (each holds a fleet-sized ProblemState) instead of
+                # leaking them for the life of the process
+                from ..sidecar import server as sidecar_server
+                with sidecar_server._SESSIONS_LOCK:
+                    sidecar_server._SESSIONS.clear()
+
+    def _run(self) -> dict:
         wall0 = time.perf_counter()
         self._boot()
         self._running = True

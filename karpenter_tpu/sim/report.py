@@ -74,9 +74,25 @@ def build_report(sim) -> dict:
     c = sim.counts
     solver = sim.solver_stats
     solved_pods = solver["tensor_pods"] + solver["host_pods"]
+    service = None
+    if getattr(sim, "solver_session", None) is not None:
+        # backend=sidecar: how the service path survived the run (wire
+        # retries, transparent resyncs, injected faults) — measurement
+        # context like wall_seconds, not digested truth
+        sess = sim.solver_session
+        service = {
+            "backend": "sidecar",
+            "deadline_s": sess.retry.deadline,
+            "retries": sess.retries,
+            "resyncs": sess.resyncs,
+            "hedges": sess.hedges,
+            "wire_faults": dict(sim.wire_injector.counts),
+        }
     return {
         "scenario": sim.scenario.name,
         "seed": sim.scenario.seed,
+        "backend": sim.scenario.backend,
+        "service": service,
         "sim_seconds": round(sim_seconds, 3),
         # wall/compression are measurement context, not digested truth
         "wall_seconds": round(wall, 3),
@@ -147,6 +163,14 @@ def render_report(report: dict) -> str:
     out.append(f"solver      {solver['passes']} passes, "
                f"fallback fraction {solver['fallback_fraction']:.2%}, "
                f"{solver['pod_errors']} pod errors")
+    svc = report.get("service")
+    if svc:
+        faults = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(svc["wire_faults"].items())) or "none"
+        out.append(f"service     backend={svc['backend']} "
+                   f"deadline={svc['deadline_s']:g}s "
+                   f"retries={svc['retries']} resyncs={svc['resyncs']} "
+                   f"wire faults: {faults}")
     if report["breaches"]:
         out.append(f"breaches    {len(report['breaches'])}:")
         for b in report["breaches"]:

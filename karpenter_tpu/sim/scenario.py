@@ -218,9 +218,33 @@ EVENT_KINDS: Dict[str, Dict[str, tuple]] = {
         "budgets": (_budgets, True, None),
         "duration": (_pos, False, None),
     },
+    # wire-fault window (requires `backend: sidecar`): the seeded
+    # WireFaultInjector fires on the solver gRPC wire at these rates for
+    # `duration` simulated seconds — drop (request lost), delay (added
+    # latency), duplicate (retransmit racing its original), disconnect
+    # (response lost after the server applied). `kill_server` restarts
+    # the sidecar at `at` (all sessions lost; clients must resync
+    # transparently). At least one fault is required.
+    "wire_chaos": {
+        "drop": (_fraction, False, 0.0),
+        "delay": (_fraction, False, 0.0),
+        "duplicate": (_fraction, False, 0.0),
+        "disconnect": (_fraction, False, 0.0),
+        "delay_seconds": (_pos, False, 0.02),
+        "duration": (_pos, True, None),
+        "kill_server": (_bool, False, False),
+    },
 }
 
 _EVENT_COMMON = {"at", "kind"}
+
+
+def _backend(v):
+    v = _str(v)
+    if v not in ("tensor", "sidecar"):
+        raise TypeError('"tensor" or "sidecar"')
+    return v
+
 
 def _weight(v):
     v = _int(v)
@@ -255,6 +279,11 @@ _TOP_FIELDS: Dict[str, tuple] = {
     "slo_budgets": (lambda v: v if isinstance(v, str)
                     else (_ for _ in ()).throw(TypeError("a string")),
                     False, ""),
+    # solver backend: "tensor" = in-process (the default), "sidecar" =
+    # the engine boots a real in-process gRPC sidecar and the operator's
+    # provisioning runs through the session wire — `wire_chaos` events
+    # can then target the wire itself
+    "backend": (_backend, False, "tensor"),
 }
 
 
@@ -291,6 +320,7 @@ class Scenario:
     batch_idle: float = 1.0
     batch_max: float = 10.0
     slo_budgets: str = ""
+    backend: str = "tensor"
     nodepools: List[NodePoolSpec] = field(default_factory=list)
     events: List[SimEvent] = field(default_factory=list)
     source: str = "<dict>"
@@ -414,6 +444,12 @@ def _validate_event(raw, index: int, ctx: _Ctx) -> SimEvent:
         if params.get("fraction") is None and params.get("count") is None:
             ctx.fail(f"{what} needs at least one of 'fraction' / 'count'",
                      line)
+    if kind == "wire_chaos":
+        if not any((params["drop"], params["delay"], params["duplicate"],
+                    params["disconnect"], params["kill_server"])):
+            ctx.fail(f"{what} needs at least one fault: a non-zero "
+                     "'drop' / 'delay' / 'duplicate' / 'disconnect' rate "
+                     "or 'kill_server: true'", line)
     return SimEvent(at=at, kind=kind, params=params, line=line)
 
 
@@ -480,6 +516,15 @@ def parse_scenario(data, source: str = "<dict>") -> Scenario:
         except ValueError as exc:
             ctx.fail(f"bad 'slo_budgets': {exc}",
                      key_lines.get("slo_budgets", line))
+    if top["backend"] != "sidecar":
+        # wire chaos targets the gRPC wire; without the sidecar backend
+        # there is no wire, and a window that silently does nothing is
+        # the typo'd-experiment failure mode validation exists to stop
+        for ev in events:
+            if ev.kind == "wire_chaos":
+                ctx.fail(f"wire_chaos event at t={ev.at:g}s requires "
+                         "'backend: sidecar' (the tensor backend has no "
+                         "wire to fault)", ev.line)
     return Scenario(nodepools=pools, events=events, source=source, **top)
 
 

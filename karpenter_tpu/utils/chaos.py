@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import random
-from collections import Counter
+from collections import Counter, deque
 from typing import Optional
 
 from .backoff import TerminalError
@@ -139,6 +139,113 @@ class CapacityDrought:
         if hit is not None:
             self.hits["/".join(hit)] += 1
         return hit
+
+
+class WireFaultInjector:
+    """Seeded fault schedule for the gRPC wire (the service-path chaos
+    substrate ISSUE 11 adds below the process boundary the FaultInjector
+    stops at). One injector drives a chaos-wrapped channel
+    (sidecar/wire_chaos.ChaosChannel); per RPC *attempt* it draws one
+    verdict from the seeded RNG:
+
+    - ``drop``: the request never reaches the server (connection reset /
+      blackholed packet) — the client sees UNAVAILABLE, the server sees
+      nothing.
+    - ``disconnect``: the request IS delivered and applied, the response
+      is lost mid-stream — the client sees UNAVAILABLE while the server
+      state advanced (the desync case the request-digest dedupe cache
+      must make retry-safe).
+    - ``duplicate``: the request is delivered twice back to back (a
+      retransmit racing its original) — the second delivery must be
+      served from the dedupe cache, not re-applied.
+    - ``delay``: ``delay_seconds`` of added latency before delivery (a
+      congested wire; with a short client deadline this manufactures
+      DEADLINE_EXCEEDED).
+
+    Draw order is fixed (delay, then drop, then duplicate, then
+    disconnect — at most one delivery-altering fault per attempt) so the
+    same seed yields the same fault schedule for the same RPC sequence;
+    ``counts`` records fired faults per kind for "faults actually fired"
+    assertions. ``enabled=False`` short-circuits to zero overhead — the
+    chaos-off bench line wraps the channel and asserts the wrapper costs
+    nothing."""
+
+    KINDS = ("drop", "delay", "duplicate", "disconnect")
+
+    def __init__(self, seed: int = 0, drop: float = 0.0, delay: float = 0.0,
+                 duplicate: float = 0.0, disconnect: float = 0.0,
+                 delay_seconds: float = 0.02):
+        self.rng = random.Random(seed)
+        self.drop = drop
+        self.delay = delay
+        self.duplicate = duplicate
+        self.disconnect = disconnect
+        self.delay_seconds = delay_seconds
+        self.enabled = True
+        self.counts: Counter = Counter()
+        # one-shot forced faults consumed before any random draw: the
+        # deterministic "this exact fault WILL happen on the next attempt"
+        # primitive harnesses use to pin each recovery path regardless of
+        # what the background rates roll
+        self._forced: deque = deque()
+
+    def inject_next(self, *kinds: str) -> None:
+        """Queue a forced fault verdict for the next attempt (e.g.
+        inject_next("drop"), inject_next("delay", "disconnect"))."""
+        for k in kinds:
+            if k not in self.KINDS:
+                raise ValueError(f"unknown wire fault kind {k!r} "
+                                 f"(known: {', '.join(self.KINDS)})")
+        self._forced.append(list(kinds))
+
+    def set_rates(self, drop: float = 0.0, delay: float = 0.0,
+                  duplicate: float = 0.0, disconnect: float = 0.0,
+                  delay_seconds: Optional[float] = None) -> None:
+        self.drop = drop
+        self.delay = delay
+        self.duplicate = duplicate
+        self.disconnect = disconnect
+        if delay_seconds is not None:
+            self.delay_seconds = delay_seconds
+
+    def rates(self) -> dict:
+        return {"drop": self.drop, "delay": self.delay,
+                "duplicate": self.duplicate, "disconnect": self.disconnect,
+                "delay_seconds": self.delay_seconds}
+
+    def draw(self) -> list:
+        """Fault verdict for one RPC attempt: a (possibly empty) list of
+        kind names, ``delay`` optionally preceding ONE delivery-altering
+        fault. Always consumes the same number of RNG draws per call so
+        the schedule depends only on the attempt sequence, not on which
+        faults happen to fire."""
+        if not self.enabled:
+            return []
+        # the draws are burned even when a forced verdict overrides them:
+        # a run using inject_next() must see the SAME background schedule
+        # as a same-seed run without it, or forced-vs-baseline comparisons
+        # diverge from the forced attempt onward
+        draws = [self.rng.random() for _ in range(4)]
+        if self._forced:
+            out = self._forced.popleft()
+            for kind in out:
+                self.counts[kind] += 1
+            return out
+        out = []
+        if self.delay and draws[0] < self.delay:
+            out.append("delay")
+        if self.drop and draws[1] < self.drop:
+            out.append("drop")
+        elif self.duplicate and draws[2] < self.duplicate:
+            out.append("duplicate")
+        elif self.disconnect and draws[3] < self.disconnect:
+            out.append("disconnect")
+        for kind in out:
+            self.counts[kind] += 1
+        return out
+
+    def fired(self) -> int:
+        return sum(self.counts.values())
 
 
 @contextlib.contextmanager

@@ -560,6 +560,73 @@ class TestServiceBudget:
             "BENCH_MODE=service missing from the unknown-mode error list"
 
 
+class TestServiceFaultsBudget:
+    """ISSUE 11 guard: the BENCH_MODE=svc-faults line at test scale. The
+    headline run asserts in-bench: zero wedged sessions, zero resyncs
+    under a seeded 5% wire-fault window (with a forced drop/disconnect/
+    duplicate per tenant so each recovery path provably fires), p99 round
+    trip bounded, cold-parity byte-identical on the chaos-churned
+    sessions, and chaos-off overhead within budget. Here the same code
+    runs small (2k pods x the kwok 144-type catalog, 2 tenants) — the
+    overhead and p99 budgets are loosened because this 2-core driver box
+    cannot resolve a 5% delta on ~20ms windows (the memory-pinned
+    cross-process noise), while every correctness assert stays exact."""
+
+    BUDGET_SECONDS = 240.0
+    # wall-noise allowances for the clipped shape; the 5%/3s defaults
+    # remain asserted by the headline BENCH_MODE=svc-faults run
+    OVERHEAD_ALLOWANCE = 0.5
+    P99_ALLOWANCE_SECONDS = 20.0
+
+    def test_svc_faults_bench_shape_within_budget(self, capsys):
+        import json
+
+        saved = (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+                 bench.SVCFAULTS_TENANTS, bench.SVCFAULTS_WINDOWS,
+                 bench.SVCFAULTS_OVERHEAD, bench.SVCFAULTS_P99_BUDGET)
+        (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+         bench.SVCFAULTS_TENANTS, bench.SVCFAULTS_WINDOWS,
+         bench.SVCFAULTS_OVERHEAD, bench.SVCFAULTS_P99_BUDGET) = (
+            N_PODS, N_DEPLOYS, 144, 2, 3,
+            self.OVERHEAD_ALLOWANCE, self.P99_ALLOWANCE_SECONDS)
+        try:
+            t0 = time.perf_counter()
+            bench.bench_svc_faults()
+            elapsed = time.perf_counter() - t0
+        finally:
+            (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+             bench.SVCFAULTS_TENANTS, bench.SVCFAULTS_WINDOWS,
+             bench.SVCFAULTS_OVERHEAD, bench.SVCFAULTS_P99_BUDGET) = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"svc-faults bench took {elapsed:.1f}s at {N_PODS} pods — "
+            "fault recovery is likely resyncing instead of retrying")
+        line = json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert line["unit"] == "pods/sec"
+        assert "wire faults" in line["metric"]
+        # the in-bench asserts' reported evidence: every recovery path
+        # provably fired and healed without a single resync or wedge
+        assert line["zero_wedged"] is True
+        assert line["resyncs"] == 0
+        assert line["faults"]["drop"] >= 2        # one forced per tenant
+        assert line["faults"]["disconnect"] >= 2
+        assert line["faults"]["duplicate"] >= 2
+        assert line["retries"] >= 4               # drop+disconnect x tenants
+        assert line["dedup_hits"] >= 2            # disconnect recovery
+        assert line["parity_samples"] == 2
+        assert line["fault_p99_ms"] > 0
+        assert line["tenants"] == 2
+
+    def test_bench_mode_svc_faults_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "svc-faults" in m.group(0), \
+            "BENCH_MODE=svc-faults missing from the unknown-mode error list"
+
+
 class TestSimBudget:
     """ISSUE 9 guard: the BENCH_MODE=sim line at test scale. The full 24h
     mixed-day acceptance (two same-seed runs, byte-identical digests,

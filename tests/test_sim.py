@@ -130,6 +130,53 @@ class TestScenarioValidation:
         with pytest.raises(ScenarioError, match="bad 'slo_budgets'"):
             parse_scenario(_doc(slo_budgets="pass=-1"))
 
+    # -- ISSUE 11: backend + wire-chaos schema rejects -----------------------
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ScenarioError,
+                           match=r"'backend'.*\"tensor\" or \"sidecar\""):
+            parse_scenario(_doc(backend="grpc"))
+
+    def test_wire_chaos_without_sidecar_backend_rejected(self):
+        doc = _doc()
+        doc["events"].append({"at": 10, "kind": "wire_chaos", "drop": 0.1,
+                              "duration": 60})
+        with pytest.raises(ScenarioError,
+                           match=r"requires 'backend: sidecar'"):
+            parse_scenario(doc)
+
+    def test_wire_chaos_without_any_fault_rejected(self):
+        doc = _doc(backend="sidecar")
+        doc["events"].append({"at": 10, "kind": "wire_chaos",
+                              "duration": 60})
+        with pytest.raises(ScenarioError,
+                           match=r"needs at least one fault"):
+            parse_scenario(doc)
+
+    def test_wire_chaos_bad_rate_names_field(self):
+        doc = _doc(backend="sidecar")
+        doc["events"].append({"at": 10, "kind": "wire_chaos", "drop": 1.7,
+                              "duration": 60})
+        with pytest.raises(ScenarioError,
+                           match=r"field 'drop' in wire_chaos event #2 "
+                                 r"must be a number in \[0, 1\]"):
+            parse_scenario(doc)
+
+    def test_wire_chaos_unknown_field_rejected(self):
+        doc = _doc(backend="sidecar")
+        doc["events"].append({"at": 10, "kind": "wire_chaos", "dorp": 0.1,
+                              "duration": 60})
+        with pytest.raises(ScenarioError, match=r"unknown key 'dorp'"):
+            parse_scenario(doc)
+
+    def test_wire_chaos_sidecar_backend_accepted(self):
+        doc = _doc(backend="sidecar")
+        doc["events"].append({"at": 10, "kind": "wire_chaos",
+                              "kill_server": True, "duration": 60})
+        sc = parse_scenario(doc)
+        assert sc.backend == "sidecar"
+        assert sc.events[-1].params["kill_server"] is True
+
     def test_yaml_reject_names_file_and_line(self, tmp_path):
         p = tmp_path / "bad.yaml"
         p.write_text("name: x\n"
@@ -533,6 +580,66 @@ class TestEngine:
 
 # -- CLI ---------------------------------------------------------------------
 
+class TestServiceBackend:
+    """ISSUE 11: solver_backend=sidecar — the engine boots a real
+    in-process gRPC sidecar, runs the whole session wire under the
+    accelerated clock, survives wire-chaos windows and a server kill, and
+    keeps the ledger digest byte-identical for the same seed."""
+
+    DOC = {
+        "name": "svc", "seed": 5, "duration": 900.0, "tick": 20,
+        "backend": "sidecar",
+        "events": [
+            {"at": 5, "kind": "deploy", "name": "web", "replicas": 4,
+             "cpu": "500m", "memory": "256Mi"},
+            {"at": 120, "kind": "wire_chaos", "drop": 0.1,
+             "disconnect": 0.1, "duration": 300},
+            {"at": 300, "kind": "scale", "name": "web", "replicas": 8},
+            {"at": 500, "kind": "wire_chaos", "kill_server": True,
+             "duration": 60},
+            {"at": 700, "kind": "scale", "name": "web", "replicas": 6},
+        ],
+    }
+
+    def test_sidecar_backend_with_faults_completes_and_heals(self):
+        import copy
+        sim, report = _run(copy.deepcopy(self.DOC))
+        assert report["backend"] == "sidecar"
+        assert report["final"]["pods_pending"] == 0
+        assert report["final"]["pods_bound"] == 6
+        tts = report["time_to_schedule"]
+        assert tts["samples"] > 0 and tts["p99_s"] > 0
+        # the server kill forced exactly the transparent recovery path:
+        # NOT_FOUND -> session recreate -> full resync
+        svc = report["service"]
+        assert svc["backend"] == "sidecar" and svc["deadline_s"] > 0
+        assert svc["resyncs"] >= 1
+        kinds = [e["kind"] for e in sim.ledger.entries]
+        assert "sidecar_restart" in kinds and "wire_chaos_end" in kinds
+        assert any(e.get("event") == "wire_chaos"
+                   for e in sim.ledger.entries)
+        # the sidecar server was torn down with the run
+        assert sim.sidecar_server is None
+
+    def test_sidecar_backend_same_seed_byte_identical_digest(self):
+        import copy
+        _, r1 = _run(copy.deepcopy(self.DOC))
+        _, r2 = _run(copy.deepcopy(self.DOC))
+        assert r1["ledger_digest"] == r2["ledger_digest"]
+
+    def test_tensor_backend_reports_no_service_section(self):
+        _, report = _run(_doc())
+        assert report["backend"] == "tensor"
+        assert report["service"] is None
+
+    def test_service_faults_library_scenario_validates(self):
+        sc = load_scenario(os.path.join(SCENARIOS_DIR,
+                                        "service-faults.yaml"))
+        assert sc.backend == "sidecar"
+        assert any(e.kind == "wire_chaos" and e.params["kill_server"]
+                   for e in sc.events)
+
+
 class TestCli:
     def test_validate_accepts_library_scenario(self, capsys):
         from karpenter_tpu.sim.__main__ import main
@@ -584,7 +691,8 @@ class TestScenarioSoaks:
     @pytest.mark.parametrize("name", ["rolling-deploy.yaml",
                                       "spot-reclaim-wave.yaml",
                                       "zonal-drought.yaml",
-                                      "pdb-drain.yaml"])
+                                      "pdb-drain.yaml",
+                                      "service-faults.yaml"])
     def test_library_scenario_replays_clean(self, name):
         sc = load_scenario(os.path.join(SCENARIOS_DIR, name))
         sim = FleetSimulator(sc)
