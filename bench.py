@@ -417,6 +417,230 @@ def bench_trace():
         "phases": phase_millis(trace),
     }), flush=True)
 
+    # -- sidecar-path variant (ISSUE 12): the warm-delta round trip with
+    # tracing on vs off (<=5% budget), plus the cross-process causal join:
+    # ONE trace_id must name the operator-side sidecar.rpc span, the
+    # server-side session/queue/solve tree, and the device spans inside it.
+    from karpenter_tpu.sidecar.client import RemoteScheduler, SolverSession
+    from karpenter_tpu.sidecar.server import serve
+    server, port = serve()
+    try:
+        nodepool = NodePool(
+            metadata=ObjectMeta(name="default"),
+            spec=NodePoolSpec(template=NodeClaimTemplate(
+                spec=NodeClaimTemplateSpec())))
+        catalog = _catalog(n_its)
+        session = SolverSession(f"127.0.0.1:{port}", tenant="trace-bench")
+        rs = RemoteScheduler(f"127.0.0.1:{port}", [nodepool],
+                             {"default": catalog}, session=session)
+        rs.solve(pods)  # bootstrap: full-state upload + cold server solve
+
+        def warm_best():
+            best, last = float("inf"), None
+            for _ in range(max(REPEATS, 4)):
+                t0 = time.perf_counter()
+                r = rs.solve(pods)
+                elapsed = time.perf_counter() - t0
+                assert r.encode_kind == "delta", r.encode_kind
+                if elapsed < best:
+                    best, last = elapsed, r
+            return best, last
+
+        try:
+            TRACER.enabled = False
+            svc_off, _ = warm_best()
+            TRACER.enabled = True
+            svc_on, last = warm_best()
+        finally:
+            TRACER.enabled = saved_enabled
+        assert svc_on <= svc_off * 1.05 + 0.010, (
+            f"tracing-on warm delta {svc_on:.3f}s exceeds 5% over "
+            f"tracing-off {svc_off:.3f}s")
+        tid = last.trace_id
+        assert tid, "server returned no trace_id on the v2 wire"
+        joined = [t for t in TRACER.traces() if t.trace_id == tid]
+        names = {s.name for t in joined for s in t.spans}
+        for expect in ("sidecar.rpc",                      # operator side
+                       "sidecar.solve", "sidecar.queue",   # server side
+                       "solve", "device.dispatch",
+                       "device.execute"):                  # device truth
+            assert expect in names, (
+                f"trace {tid} does not join {expect}: {sorted(names)}")
+        session.close()
+    finally:
+        server.stop(0)
+    print(json.dumps({
+        "metric": (f"sidecar warm-delta round trip with pass tracing "
+                   f"enabled, {len(pods)} pods x {n_its} instance types "
+                   "(client+server+device spans joined under one "
+                   "trace_id)"),
+        "value": round(len(pods) / svc_on, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(len(pods) / svc_on / 100.0, 2),
+        "seconds": round(svc_on, 3),
+        "tracing_off_seconds": round(svc_off, 3),
+        "overhead_pct": round((svc_on / svc_off - 1) * 100, 2),
+        "joined_trace_id": tid,
+        "joined_spans": sorted(names),
+    }), flush=True)
+
+
+def _fallback_mix(pct: float = 2.0):
+    """The ROADMAP item-1 worst-case mixed batch: the headline tensor mix
+    plus ``pct``% of pods per partition-inexpressible shape class — host
+    ports under hostname pod-affinity (ports), shared PVCs (volumes), an
+    unsupported topology key (topo), and cross-group selector coupling
+    (multi_group). Returns (pods, expected {class: pods})."""
+    from karpenter_tpu.api.objects import HostPort, PVCRef
+    pods = _pods()
+    n = max(2, int(len(pods) * pct / 100.0))
+    req = res.parse_list({"cpu": "100m", "memory": "128Mi"})
+
+    def stamp(name, labels, spec):
+        return Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                       labels=dict(labels)),
+                   spec=spec, container_requests=[req])
+
+    out = []
+    # ports: a CONFLICTING host port (same port across the group) plus
+    # self-selecting hostname pod-affinity — the per-pod host tracking combo
+    labels = {"app": "fb-ports"}
+    sel = LabelSelector(match_labels=dict(labels))
+    aff = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
+                        label_selector=sel)]))
+    for i in range(n):
+        out.append(stamp(f"fb-ports-{i}", labels,
+                         PodSpec(host_ports=[HostPort(port=12345)],
+                                 affinity=aff)))
+    # volumes: a shared (non-ephemeral) PVC needs host-side set-dedup
+    for i in range(n):
+        out.append(stamp(f"fb-vol-{i}", {"app": "fb-vol"},
+                         PodSpec(volumes=[PVCRef(claim_name="shared-data",
+                                                 ephemeral=False)])))
+    # topo: a topology key the kernel has no layout for
+    rack = [TopologySpreadConstraint(topology_key="example.com/rack",
+                                     max_skew=1,
+                                     label_selector=LabelSelector(
+                                         match_labels={"app": "fb-topo"}))]
+    for i in range(n):
+        out.append(stamp(f"fb-topo-{i}", {"app": "fb-topo"},
+                         PodSpec(topology_spread_constraints=list(rack))))
+    # multi_group: deployment A's zone-spread selector counts deployment
+    # B's pods — shared domain counts demote both (B rides along as topo)
+    n_mg = max(2, n // 2)
+    sel_b = LabelSelector(match_labels={"app": "fb-mg-b"})
+    mg = [TopologySpreadConstraint(
+        topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+        label_selector=sel_b)]
+    for i in range(n_mg):
+        out.append(stamp(f"fb-mg-a-{i}", {"app": "fb-mg-a"},
+                         PodSpec(topology_spread_constraints=list(mg))))
+    for i in range(n_mg):
+        out.append(stamp(f"fb-mg-b-{i}", {"app": "fb-mg-b"}, PodSpec()))
+    expected = {"ports": n, "volumes": n, "topo": n + n_mg,
+                "multi_group": n_mg}
+    return pods + out, expected
+
+
+def bench_fallbacks():
+    """ISSUE 12 acceptance line (BENCH_MODE=fallbacks): the fallback cost
+    ledger on the ROADMAP item-1 worst-case mixed batch. Solves the
+    headline mix plus ~2% of pods per inexpressible shape class, asserts
+    the ledger attributes EVERY host escape to its expected class with
+    exact pod counts, and reports per-shape-class fallback fraction plus
+    the measured host-vs-tensor cost split — the numbers that decide which
+    shape to tensorize next. A second line pins the circuit-open class:
+    an open breaker degrades the whole batch and the ledger says so."""
+    from karpenter_tpu.obs.fallbacks import LEDGER
+
+    n_its = N_ITS or 2000
+    pods, expected = _fallback_mix()
+    _scheduler(n_its).solve(pods)  # warm the jit cache at the timed shapes
+
+    LEDGER.reset()
+    best, best_attr = float("inf"), None
+    for _ in range(max(REPEATS, 3)):
+        ts = _scheduler(n_its)
+        t0 = time.perf_counter()
+        r = ts.solve(pods)
+        elapsed = time.perf_counter() - t0
+        assert ts.fallback_reason == "", ts.fallback_reason
+        assert ts.partition[1] == sum(expected.values()), (
+            ts.partition, expected)
+        if elapsed < best:
+            best, best_attr = elapsed, ts.fallback_attribution
+    # the ledger's class attribution is exact, not approximate
+    assert best_attr["classes"] == expected, (best_attr["classes"], expected)
+    assert best_attr["host_seconds"] > 0 and best_attr["tensor_seconds"] > 0
+    snap = LEDGER.snapshot()
+    for shape, count in expected.items():
+        row = snap["classes"][f"provisioning/{shape}"]
+        assert row["pods"] == count * snap["solves"], (shape, row)
+    host_pods = sum(expected.values())
+    total = len(pods)
+    # host-vs-tensor cost on the same solve: seconds per pod on each path
+    host_s, tensor_s = best_attr["host_seconds"], best_attr["tensor_seconds"]
+    host_rate = host_pods / host_s if host_s else 0.0
+    tensor_rate = (total - host_pods) / tensor_s if tensor_s else 0.0
+    print(json.dumps({
+        "metric": (f"fallback cost ledger: worst-case mixed batch, {total} "
+                   f"pods x {n_its} instance types with "
+                   f"{host_pods} pods across 4 inexpressible shape classes "
+                   "(per-class attribution exact, host-vs-tensor split "
+                   "measured in-solve)"),
+        "value": round(total / best, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(total / best / 100.0, 2),
+        "seconds": round(best, 3),
+        "fallback_fraction": round(host_pods / total, 4),
+        "classes": {k: v for k, v in sorted(expected.items())},
+        "class_fraction": {k: round(v / total, 4)
+                           for k, v in sorted(expected.items())},
+        "host_seconds": round(host_s, 3),
+        "tensor_seconds": round(tensor_s, 3),
+        "host_pods_per_sec": round(host_rate, 1),
+        "tensor_pods_per_sec": round(tensor_rate, 1),
+        "host_vs_tensor_slowdown": round(tensor_rate / host_rate, 1)
+        if host_rate else 0.0,
+    }), flush=True)
+
+    # circuit_open: the breaker forcing the host oracle is a ledger class
+    # too — the whole batch charges to it
+    class _OpenCircuit:
+        def allow(self):
+            return False
+
+        def record_failure(self):
+            pass
+
+        def record_success(self):
+            pass
+
+    small = _pods()[:2000]
+    nodepool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplate(
+            spec=NodeClaimTemplateSpec())))
+    ts = TensorScheduler([nodepool], {"default": _catalog(144)},
+                         circuit=_OpenCircuit())
+    t0 = time.perf_counter()
+    ts.solve(small)
+    elapsed = time.perf_counter() - t0
+    assert ts.fallback_reason == "circuit_open"
+    assert ts.fallback_attribution["classes"] == {"circuit_open": len(small)}
+    assert ts.fallback_attribution["host_pods"] == len(small)
+    print(json.dumps({
+        "metric": (f"fallback cost ledger: circuit-open degradation, "
+                   f"{len(small)} pods x 144 instance types, whole batch "
+                   "charged to the circuit_open class"),
+        "value": round(len(small) / elapsed, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(len(small) / elapsed / 100.0, 2),
+        "seconds": round(elapsed, 3),
+        "classes": dict(ts.fallback_attribution["classes"]),
+    }), flush=True)
+
 
 def bench_drought():
     """ISSUE 5 acceptance line (BENCH_MODE=drought): the headline 50k x 2k
@@ -666,6 +890,7 @@ def bench_churn():
     assert provisioner.last_scheduler.fallback_reason == ""
 
     times = []
+    cold_times = []  # same-process cold reference (the parity solves)
     churned_total = 0
     n_arrivals_total = 0
     for w in range(1, CHURN_WINDOWS + 1):
@@ -701,7 +926,9 @@ def bench_churn():
             # restore its packed prefix from the previous pass's seed
             assert ps.last["warm_restored"] > 0, ps.last
         if w % 5 == 0:
+            tc0 = time.perf_counter()
             r_cold = solve(batch, cold=True)
+            cold_times.append(time.perf_counter() - tc0)
             assert digest(r) == digest(r_cold), \
                 f"window {w}: delta solve diverged from cold solve"
 
@@ -726,6 +953,10 @@ def bench_churn():
         "seconds": round(total, 3),
         "p50_ms": round(p50 * 1000, 1),
         "p99_ms": round(p99 * 1000, 1),
+        # same-process cold reference (the timed parity solves): wall-clock
+        # guards downstream compare p99 against THIS, not an absolute
+        # constant that flakes on a slower box
+        "cold_ms": round(min(cold_times) * 1000, 1) if cold_times else 0.0,
         "windows": CHURN_WINDOWS,
         "arrivals_per_window": CHURN_ARRIVALS,
         "nodes_churned": churned_total,
@@ -1472,8 +1703,17 @@ def drive(name, pods, out):
     r = rs.solve(pods)
     session.parity_every = 0
     parity = session.last_parity
+    # causal join (ISSUE 12): the server's trace_id rider must equal the
+    # trace id of OUR OWN sidecar.rpc span for that solve — the client
+    # half of the cross-process join (the parent bench process holds the
+    # server ring and asserts the other half)
+    from karpenter_tpu.obs.tracer import TRACER
+    client_trace = TRACER.find(r.trace_id) if r.trace_id else None
     out[name] = {"full": t_full, "times": times, "kinds": kinds,
-                 "parity": parity, "resyncs": session.resyncs}
+                 "parity": parity, "resyncs": session.resyncs,
+                 "trace_id": r.trace_id,
+                 "trace_joined_client": client_trace is not None and any(
+                     s.name == "sidecar.rpc" for s in client_trace.spans)}
     return session, rs, pods
 
 
@@ -1601,6 +1841,27 @@ def bench_service():
     for name in stats["phase_b"]:
         assert SIDECAR_QUEUE_WAIT.count({"tenant": name}) > 0, (
             f"no admission-queue samples for tenant {name}")
+    # causal join (ISSUE 12 acceptance): ONE trace_id names the client's
+    # sidecar.rpc span (asserted client-side, separate process), the
+    # server's session/queue/solve tree, and the device spans inside it.
+    # Every tenant's last warm solve must have joined client-side; at
+    # least one must still be resident in this process's bounded trace
+    # ring with the full server span tree.
+    from karpenter_tpu.obs.tracer import TRACER
+    for name, b in {**{"svc-0": a}, **stats["phase_b"]}.items():
+        assert b.get("trace_id"), f"{name}: no trace_id rider on the wire"
+        assert b.get("trace_joined_client"), (
+            f"{name}: client-side trace {b.get('trace_id')} did not join")
+    joined_full = 0
+    for name, b in stats["phase_b"].items():
+        t = TRACER.find(b["trace_id"])
+        if t is None:
+            continue  # bounded ring: later tenants may have evicted it
+        names = {s.name for s in t.spans}
+        assert {"sidecar.solve", "sidecar.queue", "solve",
+                "device.dispatch", "device.execute"} <= names, (name, names)
+        joined_full += 1
+    assert joined_full > 0, "no tenant's joined trace survived in the ring"
     print(json.dumps({
         "metric": (f"sidecar service: warm DELTA solve round trip, "
                    f"{stats['n_pods']} pods x {stats['n_its']} instance "
@@ -1624,6 +1885,8 @@ def bench_service():
         "delta_solves": delta_solves,
         "parity_samples": parity_samples,
         "resyncs": 0,
+        "trace_joined_tenants": 1 + len(stats["phase_b"]),
+        "trace_joins_in_server_ring": joined_full,
     }), flush=True)
 
 
@@ -2098,7 +2361,7 @@ def bench_meshscale_local():
     problem, _, _ = s.build_problem(groups)
     sharded_peak = sharded_memory_analysis(problem, mesh)
     args, statics = binpack.device_args(problem)
-    single_exe, _ = binpack._get_executable(args, statics)
+    single_exe, _, _ = binpack._get_executable(args, statics)
     m = single_exe.memory_analysis()
     single_peak = int(m.temp_size_in_bytes + m.argument_size_in_bytes
                       + m.output_size_in_bytes)
@@ -2216,6 +2479,9 @@ def main():
     if MODE == "trace":
         bench_trace()
         return
+    if MODE == "fallbacks":
+        bench_fallbacks()
+        return
     if MODE == "sim":
         bench_sim()
         return
@@ -2224,7 +2490,7 @@ def main():
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
             "mesh-headroom|meshscale|sidecar|service|svc-faults|minvalues|"
-            "faults|replay|drought|churn|trace|sim")
+            "faults|replay|drought|churn|trace|fallbacks|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

@@ -82,6 +82,10 @@ class LeaveOneOutEngine:
         self.candidates = list(candidates)
         self.spot_to_spot_enabled = spot_to_spot_enabled
         self.stats = {"classified": 0, "needs_sim": 0, "probes": 0}
+        # shape-class attribution of the NEEDS_SIM rows (obs/fallbacks
+        # vocabulary): which inexpressible shapes force exact replay sims —
+        # the disruption half of the fallback cost ledger
+        self.sim_classes: Dict[str, int] = {}
         self._worst_memo: Dict[tuple, np.ndarray] = {}
         self._reqs_memo: Dict[tuple, object] = {}
         from ..obs.tracer import TRACER
@@ -91,6 +95,8 @@ class LeaveOneOutEngine:
             1 for v in self._verdicts if v.kind != NEEDS_SIM)
         self.stats["needs_sim"] = sum(
             1 for v in self._verdicts if v.kind == NEEDS_SIM)
+        from ..obs.fallbacks import LEDGER
+        LEDGER.record_disruption(self.sim_classes)
 
     # -- public -------------------------------------------------------------
 
@@ -104,6 +110,9 @@ class LeaveOneOutEngine:
 
     # -- classification ------------------------------------------------------
 
+    def _count_sim(self, shape: str, n: int = 1) -> None:
+        self.sim_classes[shape] = self.sim_classes.get(shape, 0) + n
+
     def _classify(self) -> List[LooVerdict]:
         enc = self.enc
         snap = self.snapshot
@@ -112,15 +121,19 @@ class LeaveOneOutEngine:
         # global gates: shapes whose leave-one-out packs interact in ways
         # the closed-form math doesn't model go through the replay
         if snap.base_pods:
+            self._count_sim("base_pods", n)
             return sim  # every row re-packs the shared pending set
         if enc.problem.min_its is not None:
+            self._count_sim("minvalues", n)
             return sim  # minValues floors change fills and claim counts
         if any(np_.spec.limits for np_ in snap.ts.nodepools):
+            self._count_sim("limits", n)
             return sim  # subtractMax pessimism is order-dependent
         t = enc.tensors
         state_nodes = snap.ts.state_nodes
         N = len(state_nodes)
         if N == 0:
+            self._count_sim("other", n)
             return sim
         simple = [not g.topo and not g.host_ports
                   and not (g.pods and g.pods[0].spec.volumes)
@@ -144,10 +157,16 @@ class LeaveOneOutEngine:
                 counts[gi] = counts.get(gi, 0) + 1
             n_idx = enc.node_index.get(c.state_node.name())
             if unknown or n_idx is None or len(counts) != 1:
+                self._count_sim("multi_group" if not unknown
+                                and n_idx is not None else "other")
                 out.append(LooVerdict(NEEDS_SIM))
                 continue
             (g, k), = counts.items()
             if not simple[g]:
+                grp = enc.groups[g]
+                self._count_sim(
+                    "topo" if grp.topo else
+                    "ports" if grp.host_ports else "volumes")
                 out.append(LooVerdict(NEEDS_SIM))
                 continue
             view = views.get(g)

@@ -142,6 +142,10 @@ class DisruptionSnapshot:
             # encode too: consolidation must never plan a replacement onto
             # an offering a launch failure just proved dry
             unavailable=getattr(provisioner, "unavailable", None))
+        # candidate-build traffic: its fallback-ledger records must not
+        # move the headline provisioning totals (explicit flag — the
+        # tracing-based backstop is off when --trace-ring is 0)
+        self.ts.ledger_subsystem = "disruption"
         self._encodings: Dict[tuple, object] = {}
 
     # -- per-candidate-set encode (memoized) --------------------------------

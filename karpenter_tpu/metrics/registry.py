@@ -321,6 +321,19 @@ FLIGHTREC_DROPPED = REGISTRY.counter(
     "Decision records dropped (ring eviction or capture failure)",
     ("reason",))
 
+def phase_seconds_by_name() -> Dict[str, float]:
+    """Total observed seconds per phase (span name) across every label
+    combination of karpenter_solver_phase_duration_seconds — the sim
+    report's per-subsystem attribution source (snapshot at run start,
+    delta at the end)."""
+    out: Dict[str, float] = {}
+    # list() snapshot: solver threads may observe new series mid-iteration
+    for k, s in list(SOLVER_PHASE_DURATION._sums.items()):
+        phase = dict(k).get("phase", "")
+        out[phase] = out.get(phase, 0.0) + s
+    return out
+
+
 # -- bounded tenant label ---------------------------------------------------
 # The sidecar serves many tenant clusters from one process; tenant-labeled
 # series (queue depth/wait, phase histograms) must stay bounded no matter
@@ -435,6 +448,63 @@ SIDECAR_CLIENT_HEDGES = REGISTRY.counter(
     "(safe: solves are pure functions of session state and the server "
     "dedupes by request digest)",
     ("outcome",), max_series=8)
+
+# -- whole-fleet causal observability (ISSUE 12) ---------------------------
+# Fallback cost ledger: every host-oracle escape classified by the shape
+# class that forced it (obs/fallbacks.py), so ROADMAP item 1 gets its
+# priority ordering from measurements instead of guesses. Device truth:
+# per-executable dispatch-vs-device time split and XLA memory watermarks
+# (obs/device.py). Profile lifecycle: obs/profile.py.
+
+FALLBACK_PODS = REGISTRY.counter(
+    "karpenter_fallback_pods_total",
+    "Pods solved on the host-oracle path instead of the tensor kernel "
+    "(subsystem=provisioning) or LOO consolidation candidate rows punted "
+    "to exact replay sims (subsystem=disruption), by the shape class that "
+    "forced the escape (volumes, topo, ports, minvalues, multi_group, "
+    "limits, base_pods, circuit_open, ...)",
+    ("shape", "subsystem"), max_series=64)
+FALLBACK_SOLVES = REGISTRY.counter(
+    "karpenter_fallback_solves_total",
+    "Solves (or disruption passes) in which at least one pod/candidate "
+    "escaped the batched math, by shape class (a mixed solve increments "
+    "every class it contains)",
+    ("shape", "subsystem"), max_series=64)
+FALLBACK_HOST_SECONDS = REGISTRY.counter(
+    "karpenter_fallback_host_seconds_total",
+    "Wall seconds spent in the host-oracle path (full fallbacks and "
+    "remainder passes), attributed pro-rata by pod count across the "
+    "solve's escape shape classes",
+    ("shape", "subsystem"), max_series=64)
+FALLBACK_TENSOR_SECONDS = REGISTRY.counter(
+    "karpenter_fallback_tensor_seconds_total",
+    "Wall seconds spent in the tensor path across all solves — the "
+    "denominator for host-vs-tensor cost comparisons on mixed batches")
+DEVICE_DISPATCHES = REGISTRY.counter(
+    "karpenter_device_dispatches_total",
+    "Dispatches of a cached compiled executable, per executable label "
+    "(the binpack padded-shape-bucket cache key's digest)",
+    ("executable",), max_series=64)
+DEVICE_DISPATCH_SECONDS = REGISTRY.counter(
+    "karpenter_device_dispatch_seconds_total",
+    "Host-side dispatch overhead (exe(*args) enqueue time) per executable "
+    "— the host half of the device-time attribution split",
+    ("executable",), max_series=64)
+DEVICE_EXECUTE_SECONDS = REGISTRY.counter(
+    "karpenter_device_execute_seconds_total",
+    "Measured device completion time (block_until_ready delta after "
+    "dispatch) per executable — the accelerator half of the split; only "
+    "collected while tracing is enabled",
+    ("executable",), max_series=64)
+DEVICE_MEMORY_PEAK = REGISTRY.gauge(
+    "karpenter_device_memory_peak_bytes",
+    "Per-device XLA memory watermark: the max memory_analysis() peak "
+    "(args + temps + output) across every executable compiled so far",
+    ("device",), max_series=64)
+PROFILE_ACTIVE = REGISTRY.gauge(
+    "karpenter_profile_active",
+    "1 while a jax.profiler device-trace session is running "
+    "(/debug/profile?device=start or python -m karpenter_tpu.obs profile)")
 
 # -- trace-driven fleet simulator (sim/) -----------------------------------
 # The simulator's own aggregate truth lives in its report/ledger (those are

@@ -1,17 +1,31 @@
 """Observability substrate: pass-level span tracing + end-to-end SLOs.
 
 - ``tracer``: the clock-injectable span tracer, its bounded ring of
-  completed pass traces, and the Chrome trace-event export (Perfetto /
+  completed pass traces, cross-process trace-context adoption (the
+  sidecar wire's trace_ctx), and the Chrome trace-event export (Perfetto /
   chrome://tracing compatible). Instrumentation sites use the process-wide
   ``TRACER``.
 - ``slo``: the SLOWatcher enforcing per-span wall-clock budgets over
   completed traces (breach metric + warning event + flight-recorder dump).
-- ``python -m karpenter_tpu.obs dump|show``: trace-dump workflow.
+- ``fallbacks``: the fallback cost ledger — every host-oracle escape
+  classified by shape class with pod counts and host-vs-tensor wall cost
+  (process-wide ``LEDGER``, served on ``/debug/fallbacks``).
+- ``device``: per-executable device-time attribution (dispatch vs
+  block_until_ready split) and XLA memory watermarks (``DEVICE_TIME``).
+- ``profile``: the jax.profiler session facility (``PROFILER``,
+  ``/debug/profile?device=start|stop``).
+- ``python -m karpenter_tpu.obs dump|show|profile``: the CLI workflows.
 """
 
+from .device import DEVICE_TIME, DeviceTimeTracker
+from .fallbacks import LEDGER, FallbackLedger, classify_reason
+from .profile import PROFILER, ProfileError, Profiler
 from .slo import SLOWatcher, parse_budgets
 from .tracer import (TRACER, PassTrace, Span, Tracer, chrome_trace,
                      dumps_chrome, phase_millis)
 
 __all__ = ["TRACER", "Tracer", "Span", "PassTrace", "chrome_trace",
-           "dumps_chrome", "phase_millis", "SLOWatcher", "parse_budgets"]
+           "dumps_chrome", "phase_millis", "SLOWatcher", "parse_budgets",
+           "LEDGER", "FallbackLedger", "classify_reason",
+           "DEVICE_TIME", "DeviceTimeTracker",
+           "PROFILER", "Profiler", "ProfileError"]

@@ -49,27 +49,63 @@ def _exclusive_micros(evs: list) -> dict:
     """EXCLUSIVE µs per span name (child time subtracted from parents),
     reconstructed from ts/dur containment per thread — the same breakdown
     tracer.phase_millis computes from live spans, so `obs show` and the
-    bench's `phases:` line agree on identical data."""
+    bench's `phases:` line agree on identical data.
+
+    Spans that do NOT nest cleanly (a mid-span exception recovery can
+    close out of order, leaving a span that starts inside one parent and
+    ends after it) get a deterministic rendering: a child only discounts
+    the part of its duration that lies INSIDE the enclosing span's
+    interval, so an overlapping child can never drive a parent's exclusive
+    time negative (or silently inflate a sibling by over-discounting), and
+    the same dump always renders the same table."""
     child: dict = {}
     by_tid: dict = {}
     for e in evs:
         by_tid.setdefault(e.get("tid"), []).append(e)
     for tid_evs in by_tid.values():
         tid_evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
-        stack: list = []
+        stack: list = []   # (event id, start, end) of open enclosing spans
         for e in tid_evs:
-            end = e["ts"] + e.get("dur", 0)
-            while stack and end > stack[-1][1] + 1e-6:
-                stack.pop()
+            start = e["ts"]
+            end = start + e.get("dur", 0)
+            while stack and start > stack[-1][2] - 1e-6:
+                stack.pop()   # fully past: not enclosing anymore
             if stack:
-                pid = stack[-1][0]
-                child[pid] = child.get(pid, 0.0) + e.get("dur", 0)
-            stack.append((id(e), end))
+                pid, pstart, pend = stack[-1]
+                child[pid] = child.get(pid, 0.0) + max(
+                    0.0, min(end, pend) - max(start, pstart))
+            stack.append((id(e), start, end))
     totals: dict = {}
     for e in evs:
         excl = max(0.0, e.get("dur", 0) - child.get(id(e), 0.0))
         totals[e["name"]] = totals.get(e["name"], 0.0) + excl
     return totals
+
+
+def _cmd_profile(url: str, seconds: float) -> int:
+    """Drive a device-profile session on a live operator: start the
+    jax.profiler trace via /debug/profile?device=start, wait, stop it.
+    The trace lands in the operator's $KARPENTER_PROFILE_DIR (the server
+    picks the directory — a debug port is not a write-anywhere primitive);
+    open it with TensorBoard's profile plugin or Perfetto."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    def hit(action: str) -> str:
+        req = f"{url.rstrip('/')}/debug/profile?device={action}"
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read().decode().strip()
+        except urllib.error.HTTPError as e:
+            raise SystemExit(
+                f"profile {action} rejected: {e.read().decode().strip()}")
+    print(hit("start"))
+    try:
+        time.sleep(max(0.0, seconds))
+    finally:
+        print(hit("stop"))
+    return 0
 
 
 def _cmd_show(path: str) -> int:
@@ -106,9 +142,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="last N traces only")
     p_show = sub.add_parser("show", help="per-phase breakdown of a dump")
     p_show.add_argument("trace")
+    p_prof = sub.add_parser(
+        "profile", help="device-profile a live operator (jax.profiler "
+                        "start/wait/stop via /debug/profile?device=)")
+    p_prof.add_argument("--url", required=True,
+                        help="live operator metrics base URL "
+                             "(http://host:port; needs --enable-profiling "
+                             "and $KARPENTER_PROFILE_DIR server-side)")
+    p_prof.add_argument("--seconds", type=float, default=5.0,
+                        help="capture window (default 5)")
     args = parser.parse_args(argv)
     if args.cmd == "dump":
         return _cmd_dump(args.url, args.out, args.n)
+    if args.cmd == "profile":
+        return _cmd_profile(args.url, args.seconds)
     return _cmd_show(args.trace)
 
 

@@ -1,0 +1,177 @@
+"""Device and memory truth: per-executable attribution of accelerator time.
+
+Every span the PR-7 tracer records measures HOST wall clock; JAX dispatch
+is asynchronous, so "device.execute" historically timed the *enqueue* and
+the real device time hid inside whatever span happened to block first
+(usually the result fetch). This module splits the two:
+
+- **dispatch overhead** — host time for ``exe(*args)`` to return (argument
+  donation, tokenization, enqueue), and
+- **device time** — the measured ``block_until_ready`` delta after
+  dispatch, which is the accelerator's own completion truth,
+
+attributed PER COMPILED EXECUTABLE (the binpack executable cache's padded
+shape buckets), alongside what XLA itself says about the program:
+``cost_analysis()`` flops and ``memory_analysis()`` per-device peak bytes.
+The peak bytes feed a continuous watermark gauge per device
+(``karpenter_device_memory_peak_bytes{device}``) — the number PR 10
+computed once for a bench line now tracks every executable the process
+ever runs.
+
+The measured split only happens while the tracer is enabled (the same
+switch that gates every other span): with tracing off, dispatch stays
+fully asynchronous and the hot path is byte-identical to the pre-ISSUE-12
+behavior. Blocking inside the dispatch site is free in practice because
+every caller fetches the results immediately after — the wait moves, it
+isn't added; BENCH_MODE=trace pins the <=5% envelope either way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional
+
+
+class ExecStats:
+    """Aggregate truth for one compiled executable (one cache key)."""
+
+    __slots__ = ("label", "kind", "shapes", "devices", "flops",
+                 "bytes_accessed", "peak_bytes", "dispatches",
+                 "dispatch_seconds", "device_seconds")
+
+    def __init__(self, label: str, kind: str, shapes: str,
+                 devices: List[str]):
+        self.label = label
+        self.kind = kind              # "single" | "mesh"
+        self.shapes = shapes          # human-readable arg-shape summary
+        self.devices = devices
+        self.flops = 0.0              # XLA cost_analysis estimate
+        self.bytes_accessed = 0.0
+        self.peak_bytes = 0           # XLA memory_analysis per-device peak
+        self.dispatches = 0
+        self.dispatch_seconds = 0.0   # host enqueue overhead
+        self.device_seconds = 0.0     # block_until_ready deltas
+
+    def snapshot(self) -> dict:
+        return {
+            "executable": self.label,
+            "kind": self.kind,
+            "shapes": self.shapes,
+            "devices": list(self.devices),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "peak_bytes": self.peak_bytes,
+            "dispatches": self.dispatches,
+            "dispatch_seconds": round(self.dispatch_seconds, 6),
+            "device_seconds": round(self.device_seconds, 6),
+        }
+
+
+class DeviceTimeTracker:
+    """Process-wide per-executable device-time + memory registry (the
+    executable cache is process-wide, so its attribution is too)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: "Dict[tuple, ExecStats]" = {}
+        self._watermarks: Dict[str, int] = {}
+
+    # -- registration (compile/first-use time, once per executable) ---------
+
+    def get(self, key: tuple) -> Optional[ExecStats]:
+        """Fast path for the dispatch site: an already-registered key skips
+        the arg-tree walks that feed register()'s shapes/devices."""
+        with self._lock:
+            return self._stats.get(key)
+
+    def register(self, key: tuple, exe, kind: str, shapes: str = "",
+                 devices: Optional[List[str]] = None) -> ExecStats:
+        """Idempotent: the first call for a cache key runs XLA's cost and
+        memory analyses (cheap — already-compiled program metadata) and
+        opens the stats entry; later calls return it. ``devices`` are the
+        caller's placement labels (the dispatch site knows them — single
+        default device vs the mesh grid); omitted = the default device."""
+        with self._lock:
+            st = self._stats.get(key)
+        if st is not None:
+            return st
+        label = "x" + hashlib.sha1(repr(key).encode()).hexdigest()[:10]
+        st = ExecStats(label, kind, shapes, devices or _default_device())
+        try:
+            cost = exe.cost_analysis()
+            if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+                cost = cost[0] if cost else {}
+            st.flops = float(cost.get("flops", 0.0))
+            st.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+        except Exception:  # noqa: BLE001 — analysis is advisory, never fatal
+            pass
+        try:
+            m = exe.memory_analysis()
+            st.peak_bytes = int(m.temp_size_in_bytes
+                                + m.argument_size_in_bytes
+                                + m.output_size_in_bytes)
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            # first registration wins on a race; both computed identically
+            st = self._stats.setdefault(key, st)
+        if st.peak_bytes:
+            self._update_watermarks(st)
+        return st
+
+    def _update_watermarks(self, st: ExecStats) -> None:
+        """Continuous per-device memory watermark: the max per-device peak
+        across every executable registered so far (memory_analysis is the
+        PER-DEVICE program under GSPMD, so the sharded number is already
+        the right per-device truth)."""
+        from ..metrics.registry import DEVICE_MEMORY_PEAK
+        with self._lock:
+            for dev in st.devices:
+                if st.peak_bytes > self._watermarks.get(dev, 0):
+                    self._watermarks[dev] = st.peak_bytes
+                    DEVICE_MEMORY_PEAK.set(float(st.peak_bytes),
+                                           {"device": dev})
+
+    # -- per-dispatch recording ---------------------------------------------
+
+    def record(self, st: ExecStats, dispatch_s: float,
+               device_s: float) -> None:
+        from ..metrics.registry import (DEVICE_DISPATCH_SECONDS,
+                                        DEVICE_EXECUTE_SECONDS,
+                                        DEVICE_DISPATCHES)
+        with self._lock:
+            st.dispatches += 1
+            st.dispatch_seconds += dispatch_s
+            st.device_seconds += device_s
+        labels = {"executable": st.label}
+        DEVICE_DISPATCHES.inc(labels)
+        DEVICE_DISPATCH_SECONDS.inc(labels, dispatch_s)
+        DEVICE_EXECUTE_SECONDS.inc(labels, device_s)
+
+    # -- read side -----------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            stats = list(self._stats.values())
+        return [st.snapshot() for st in stats]
+
+    def watermarks(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._watermarks)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._watermarks.clear()
+
+
+def _default_device() -> List[str]:
+    try:
+        import jax
+        return [str(jax.devices()[0].id)]
+    except Exception:  # noqa: BLE001
+        return ["0"]
+
+
+DEVICE_TIME = DeviceTimeTracker()

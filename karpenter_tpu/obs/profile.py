@@ -1,0 +1,120 @@
+"""Device profiling facility: the jax.profiler lifecycle as an obs surface.
+
+PR 7 left device profiling as an ad-hoc hook — the provisioner wrapped its
+schedule() call in ``jax.profiler.trace(profile_dir)`` when
+``--enable-profiling`` was set, and nothing else could start, stop, or even
+discover a device profile. This module owns the ONE process-wide profiler
+session (jax.profiler is process-global state, so the facility must be
+too) and exposes it three ways:
+
+- ``PROFILER.start(dir)/stop()`` — programmatic start/stop;
+- ``GET /debug/profile?device=start|stop`` on the metrics port (gated
+  behind ``--enable-profiling`` like the sampling profiler that shares the
+  route);
+- ``python -m karpenter_tpu.obs profile --url ...`` — start, wait, stop,
+  from the terminal.
+
+Env-gated: a profile lands ONLY in an operator-sanctioned directory —
+``$KARPENTER_PROFILE_DIR`` or an explicit ``start(dir)`` — never a
+caller-chosen path (the /debug/flightrecorder dir-confinement rule: a
+debug port must not be a write-anywhere primitive; the HTTP surface can't
+pass a dir at all).
+
+The provisioner's per-pass hook is kept (``profile_dir`` still works) but
+now routes through :meth:`Profiler.pass_scope`, which NESTS SAFELY: while
+an endpoint-started session is active the per-pass hook is a no-op instead
+of a crash inside jax.profiler's single-session assertion.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+PROFILE_DIR_ENV = "KARPENTER_PROFILE_DIR"
+
+
+class ProfileError(RuntimeError):
+    """Misuse of the single profiler session (double start, stop without
+    start, no sanctioned output directory)."""
+
+
+class Profiler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dir: Optional[str] = None
+
+    @property
+    def active(self) -> bool:
+        return self._dir is not None
+
+    @property
+    def out_dir(self) -> Optional[str]:
+        return self._dir
+
+    def start(self, out_dir: Optional[str] = None) -> str:
+        """Begin a device profile into `out_dir` (or $KARPENTER_PROFILE_DIR).
+        Returns the directory; raises ProfileError when a session is
+        already running or no sanctioned directory exists."""
+        out_dir = out_dir or os.environ.get(PROFILE_DIR_ENV)
+        if not out_dir:
+            raise ProfileError(
+                "no profile directory: pass one or set "
+                f"${PROFILE_DIR_ENV} (profiles only land in an "
+                "operator-sanctioned directory)")
+        with self._lock:
+            if self._dir is not None:
+                raise ProfileError(
+                    f"a device profile is already running into {self._dir}; "
+                    "stop it first (jax.profiler is single-session)")
+            import jax
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            self._dir = out_dir
+            from ..metrics.registry import PROFILE_ACTIVE
+            PROFILE_ACTIVE.set(1.0)
+            return out_dir
+
+    def stop(self) -> str:
+        """End the running profile; returns the directory it wrote to."""
+        with self._lock:
+            if self._dir is None:
+                raise ProfileError("no device profile is running")
+            import jax
+            jax.profiler.stop_trace()
+            out_dir, self._dir = self._dir, None
+            from ..metrics.registry import PROFILE_ACTIVE
+            PROFILE_ACTIVE.set(0.0)
+            return out_dir
+
+    @contextmanager
+    def pass_scope(self, out_dir: str):
+        """The provisioner's per-pass hook (--enable-profiling): profile
+        exactly this scope — unless a session is already active, in which
+        case the pass is already being captured and the scope is a no-op
+        (jax.profiler refuses nested sessions). Registers through
+        start()/stop() so the session is VISIBLE: PROFILE_ACTIVE reads 1,
+        and a concurrent /debug/profile?device=start gets the clean
+        already-running ProfileError instead of jax's raw assertion."""
+        try:
+            self.start(out_dir)
+        except ProfileError:
+            # an endpoint-started (or racing per-pass) session is already
+            # capturing this pass — nothing to do
+            yield
+            return
+        except Exception:  # noqa: BLE001 — profiling must never cost a pass
+            yield
+            return
+        try:
+            yield
+        finally:
+            try:
+                self.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+PROFILER = Profiler()

@@ -189,14 +189,27 @@ class Tracer:
             # (stack of open span indices, span list, trace_id, wall epoch)
             st = self._local.state = {"stack": [], "spans": [],
                                       "trace_id": "", "at": 0.0,
-                                      "drop": False}
+                                      "drop": False, "adopt": None}
         return st
 
     def _begin(self, name: str, attrs: dict) -> Span:
         st = self._state()
         if not st["stack"]:
             st["spans"] = []
-            st["trace_id"] = f"t{next(self._seq):06d}"
+            adopt = st.get("adopt")
+            if adopt is not None:
+                # cross-process join: this root continues the REMOTE trace
+                # (the sidecar wire's trace_ctx) instead of minting a local
+                # id — one trace_id then names the operator-side pass, the
+                # server-side session/queue/solve tree, and the flightrec
+                # records on both sides
+                st["trace_id"] = adopt[0]
+                if adopt[1]:
+                    attrs = dict(attrs)
+                    attrs.setdefault("remote_parent", adopt[1])
+                st["adopt"] = None
+            else:
+                st["trace_id"] = f"t{next(self._seq):06d}"
             st["at"] = time.time()
             st["drop"] = False
         parent = st["stack"][-1] if st["stack"] else -1
@@ -268,6 +281,48 @@ class Tracer:
             return ""
         st = getattr(self._local, "state", None)
         return st["trace_id"] if st is not None and st["stack"] else ""
+
+    def current_root_name(self) -> str:
+        """Name of the active trace's ROOT span ('' when none) — cheap
+        subsystem attribution (a solve under a disruption.pass root is a
+        disruption probe, not provisioning traffic)."""
+        if not self.enabled:
+            return ""
+        st = getattr(self._local, "state", None)
+        if st is not None and st["stack"]:
+            return st["spans"][0].name
+        return ""
+
+    def current_ctx(self) -> Optional[dict]:
+        """Wire-portable context of the ACTIVE span on this thread — the
+        ``trace_ctx`` the sidecar client threads through the delta wire so
+        the server can adopt() the same trace. None when tracing is off or
+        no trace is active (legacy wire shape: the field is simply absent)."""
+        if not self.enabled:
+            return None
+        st = getattr(self._local, "state", None)
+        if st is None or not st["stack"]:
+            return None
+        return {"id": st["trace_id"],
+                "span": f"{st['spans'][st['stack'][-1]].name}"
+                        f"#{st['stack'][-1]}"}
+
+    def adopt(self, trace_id: str, parent: str = "") -> None:
+        """Arrange for the NEXT root span on this thread to JOIN the given
+        remote trace (same trace_id, ``remote_parent`` attr naming the
+        caller's span) instead of minting a local id. A no-op while a trace
+        is already active; adopt("") clears a pending adoption. Retries /
+        hedges / duplicate deliveries never reach this point twice — the
+        server's idempotency-nonce dedupe answers them from the response
+        cache before any span opens, so one logical request yields exactly
+        one server span tree."""
+        if not self.enabled:
+            return  # span() returns the no-op ctx: a stored adoption would
+            #         leak onto whatever trace roots after a re-enable
+        st = self._state()
+        if st["stack"]:
+            return
+        st["adopt"] = (trace_id, parent) if trace_id else None
 
     def drop_current(self) -> None:
         """Discard the current trace at completion (no ring, no derived
@@ -341,11 +396,20 @@ def dumps_chrome(traces: List[PassTrace]) -> str:
 def phase_millis(trace: PassTrace) -> Dict[str, float]:
     """EXCLUSIVE wall milliseconds per span name (root excluded, child time
     subtracted from parents) — the bench's ``phases`` breakdown: the values
-    sum to ~the root duration instead of double-counting nested stages."""
+    sum to ~the root duration instead of double-counting nested stages.
+
+    Mispaired spans (a mid-span exception recovery can close out of order,
+    leaving a child OVERLAPPING its recorded parent instead of nesting
+    inside it) are rendered deterministically: a child only discounts the
+    part of its duration that actually lies INSIDE the parent's interval,
+    so no parent's exclusive time can go negative and the same trace always
+    renders the same table."""
     child_time = [0.0] * len(trace.spans)
     for sp in trace.spans:
         if sp.parent >= 0:
-            child_time[sp.parent] += sp.duration
+            par = trace.spans[sp.parent]
+            child_time[sp.parent] += max(
+                0.0, min(sp.end, par.end) - max(sp.start, par.start))
     out: Dict[str, float] = {}
     for sp in trace.spans[1:]:
         self_ms = max(0.0, sp.duration - child_time[sp.index]) * 1e3

@@ -89,10 +89,32 @@ def _debug_profile(query: dict):
     sys._current_frames at ~100 Hz for ?seconds=N (default 5, cap 60) and
     renders folded stacks ("thread;fn (file:line);... count"), the format
     flamegraph.pl / speedscope consume directly. Cheap enough to run
-    against a live operator; cProfile would only see the handler thread."""
+    against a live operator; cProfile would only see the handler thread.
+
+    ``?device=start`` / ``?device=stop`` instead drive the DEVICE profiler
+    (obs/profile.py): a jax.profiler trace session into the env-sanctioned
+    $KARPENTER_PROFILE_DIR, the promoted form of the provisioner's old
+    ad-hoc per-pass hook. `python -m karpenter_tpu.obs profile` wraps this
+    pair from the terminal."""
     import sys
     import time as _time
     from collections import Counter
+    device = query.get("device", [""])[0]
+    if device:
+        from ..obs.profile import PROFILER, ProfileError
+        try:
+            if device == "start":
+                out_dir = PROFILER.start()
+                return (200, "text/plain",
+                        f"device profile started into {out_dir}\n")
+            if device == "stop":
+                out_dir = PROFILER.stop()
+                return (200, "text/plain",
+                        f"device profile stopped; trace in {out_dir}\n")
+            return (400, "text/plain",
+                    "device must be 'start' or 'stop'")
+        except ProfileError as e:
+            return 409, "text/plain", f"{e}\n"
     try:
         seconds = float(query.get("seconds", ["5"])[0])
     except (TypeError, ValueError):
@@ -260,6 +282,22 @@ def _debug_slo_factory(slo):
     return fn
 
 
+def _debug_fallbacks(query: dict):
+    """The fallback cost ledger's operator surface (process-global like
+    /metrics): per-shape-class host-oracle escape counts, pod volumes and
+    host-vs-tensor wall cost, plus the recent per-solve attribution
+    records — the first stop when karpenter_fallback_pods_total moves, and
+    ROADMAP item 1's priority ordering. ?n= bounds the recent list."""
+    import json
+    from ..obs.fallbacks import LEDGER
+    try:
+        n = max(0, int(query.get("n", ["20"])[0]))
+    except (TypeError, ValueError):
+        return 400, "text/plain", "n must be an integer"
+    return (200, "application/json",
+            json.dumps(LEDGER.snapshot(recent=n), indent=1) + "\n")
+
+
 def _debug_sessions_factory(sessions):
     """The sidecar's session-table surface (ISSUE 11 satellite, the
     /debug/offerings snapshot pattern): per-tenant session digest, queue
@@ -326,6 +364,9 @@ class ServingGroup:
         metrics_routes = {
             "/metrics": lambda: (200, "text/plain; version=0.0.4",
                                  registry.expose()),
+            # the fallback cost ledger is process-global (obs/fallbacks),
+            # so its surface serves wherever /metrics does
+            "/debug/fallbacks": _debug_fallbacks,
         }
         if manager is not None:
             metrics_routes["/debug/deadletter"] = \

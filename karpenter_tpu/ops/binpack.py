@@ -25,6 +25,7 @@ oracle scheduler in tests/test_binpack_parity.py.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -430,14 +431,15 @@ def _exec_cache_key(args, statics) -> tuple:
 
 
 def _get_executable(args, statics, shard=None):
-    """(compiled executable, cache_hit) for the precompute program, through
-    the ONE persistent executable cache. ``shard=None`` compiles the
-    single-device packed-output kernel; a sharded dispatch (parallel/mesh)
-    passes ``shard=(key_prefix, in_shardings, out_shardings)`` and gets the
-    raw 6-output kernel compiled under GSPMD — same kernel, same cache; the
-    key_prefix carries the device identity + mesh grid + gather mode, NOT
-    the Mesh object, so a recreated mesh over the same devices reuses the
-    executable."""
+    """(compiled executable, cache_hit, cache_key) for the precompute
+    program, through the ONE persistent executable cache. ``shard=None``
+    compiles the single-device packed-output kernel; a sharded dispatch
+    (parallel/mesh) passes ``shard=(key_prefix, in_shardings,
+    out_shardings)`` and gets the raw 6-output kernel compiled under GSPMD
+    — same kernel, same cache; the key_prefix carries the device identity +
+    mesh grid + gather mode, NOT the Mesh object, so a recreated mesh over
+    the same devices reuses the executable. The returned key is the
+    device-time attribution identity (obs/device.py)."""
     from ..obs.tracer import TRACER
     key = _exec_cache_key(args, statics)
     if shard is not None:
@@ -447,7 +449,7 @@ def _get_executable(args, statics, shard=None):
         if exe is not None:
             _EXEC_CACHE.move_to_end(key)
     if exe is not None:
-        return exe, True
+        return exe, True, key
     with TRACER.span("compile"):
         if shard is None:
             exe = _precompute_packed.lower(*args, **statics).compile()
@@ -462,21 +464,69 @@ def _get_executable(args, statics, shard=None):
             _EXEC_CACHE.popitem(last=False)
         _EXEC_CACHE[key] = exe
         _EXEC_CACHE.move_to_end(key)
-    return exe, False
+    return exe, False, key
+
+
+def _arg_devices(args):
+    """Placement labels off the committed arg arrays (sharded uploads carry
+    their NamedSharding device set); None when the args are host buffers
+    the executable will auto-place."""
+    for leaf in jax.tree_util.tree_leaves(args):
+        devs = getattr(leaf, "devices", None)
+        if callable(devs):
+            try:
+                ds = devs()
+            except Exception:  # noqa: BLE001
+                continue
+            if ds:
+                return sorted((str(d.id) for d in ds), key=_dev_sort)
+    return None
+
+
+def _dev_sort(label: str):
+    return (0, int(label)) if label.isdigit() else (1, label)
+
+
+def _shape_summary(args) -> str:
+    leaves = jax.tree_util.tree_leaves(args)
+    big = sorted(leaves, key=lambda a: -int(np.prod(a.shape) or 0))[:3]
+    return ",".join("x".join(map(str, leaf.shape)) for leaf in big)
 
 
 def _run_precompute(args, statics, shard=None):
     from ..metrics.registry import (SOLVER_COMPILE_CACHE_HITS,
                                     SOLVER_COMPILE_CACHE_MISSES)
     from ..obs.tracer import TRACER
-    exe, hit = _get_executable(args, statics, shard)
+    exe, hit, key = _get_executable(args, statics, shard)
     if hit:
         SOLVER_COMPILE_CACHE_HITS.inc()
     else:
         SOLVER_COMPILE_CACHE_MISSES.inc()
-    with TRACER.span("device.execute",
-                     compile_cache="hit" if hit else "miss"):
+    if not TRACER.enabled:
+        # tracing off: fully asynchronous dispatch, byte-identical to the
+        # pre-attribution hot path (the fetch site absorbs device time)
         return exe(*args)
+    # device-time attribution (ISSUE 12): split host dispatch overhead
+    # from the accelerator's own completion truth. Blocking here is free —
+    # every caller fetches the results immediately after this returns, so
+    # the wait MOVES into the device.execute span rather than being added.
+    from ..obs.device import DEVICE_TIME
+    st = DEVICE_TIME.get(key)
+    if st is None:
+        # first dispatch of this executable: the arg-tree walks feeding
+        # shapes/devices run ONCE here, never on the steady-state path
+        st = DEVICE_TIME.register(key, exe, "mesh" if shard else "single",
+                                  shapes=_shape_summary(args),
+                                  devices=_arg_devices(args))
+    t0 = time.perf_counter()
+    with TRACER.span("device.dispatch", executable=st.label,
+                     compile_cache="hit" if hit else "miss"):
+        out = exe(*args)
+    t1 = time.perf_counter()
+    with TRACER.span("device.execute", executable=st.label):
+        jax.block_until_ready(out)
+    DEVICE_TIME.record(st, t1 - t0, time.perf_counter() - t1)
+    return out
 
 
 def precompute(p: PackProblem) -> PackTensors:

@@ -349,10 +349,17 @@ def sharded_memory_analysis(p: binpack.PackProblem, mesh: Mesh) -> int:
     the executable if this problem shape hasn't run yet."""
     args, statics, shard, _, _, _ = _sharded_dispatch(
         p, mesh, replicate_out=False)
-    exe, _ = binpack._get_executable(args, statics, shard=shard)
+    exe, _, key = binpack._get_executable(args, statics, shard=shard)
     m = exe.memory_analysis()
-    return int(m.temp_size_in_bytes + m.argument_size_in_bytes
+    peak = int(m.temp_size_in_bytes + m.argument_size_in_bytes
                + m.output_size_in_bytes)
+    # feed the continuous per-device watermark gauges too: the one-shot
+    # bench probe and the live dispatch path share the same truth
+    from ..obs.device import DEVICE_TIME
+    DEVICE_TIME.register(key, exe, "mesh",
+                         shapes=binpack._shape_summary(args),
+                         devices=[str(d.id) for d in mesh.devices.flat])
+    return peak
 
 
 def _unpad_tensors(raw, padded: binpack.PackProblem, G: int, T: int

@@ -302,7 +302,7 @@ def _batch_conflicted_port_keys(pods: List[Pod]) -> set:
 
 
 def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None,
-                   port_occupied=None):
+                   port_occupied=None, breakdown: Optional[list] = None):
     """Returns (groups, leftover_pods, reason): every pod lands on exactly
     one side. `groups` are tensor-eligible equivalence classes; `leftover`
     pods carry constraint shapes only the host oracle understands (host
@@ -310,6 +310,11 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
     topology counts couple to a leftover pod or another group (shared
     selector domains must be counted by one solver). `reason` describes the
     first leftover cause (empty when leftover is empty).
+
+    `breakdown`, when given, receives one ``(reason, pod_count)`` tuple per
+    host-side bucket — the fallback cost ledger's raw attribution (the
+    classification into shape classes happens in obs/fallbacks.py, so this
+    module stays free of observability vocabulary).
 
     Two-phase: a cheap structural signature buckets the pods; the expensive
     classification (Requirements construction, topology-shape analysis) runs
@@ -417,7 +422,7 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
                 groups[sig] = g
                 order.append(g)
             g.pods.extend(bucket)
-        return _finish_partition(order, reasons)
+        return _finish_partition(order, reasons, breakdown)
 
     for pod in pods:
         spec = pod.spec
@@ -469,10 +474,11 @@ def partition_pods(pods: List[Pod], prebuckets: Optional[List[List[Pod]]] = None
             order.append(g)
         g.pods.append(pod)
 
-    return _finish_partition(order, reasons)
+    return _finish_partition(order, reasons, breakdown)
 
 
-def _finish_partition(order: List[PodGroup], reasons: Dict[int, str]):
+def _finish_partition(order: List[PodGroup], reasons: Dict[int, str],
+                      breakdown: Optional[list] = None):
     # cross-group selector coupling: a topology selector matching another
     # bucket's labels means shared domain counts — both sides must be solved
     # by ONE solver. Any bucket coupled (transitively) to a host-path bucket
@@ -529,4 +535,7 @@ def _finish_partition(order: List[PodGroup], reasons: Dict[int, str]):
 
     leftover = [p for g in order if id(g) in reasons for p in g.pods]
     reason = next((reasons[id(g)] for g in order if id(g) in reasons), "")
+    if breakdown is not None:
+        breakdown.extend((reasons[id(g)], len(g.pods))
+                         for g in order if id(g) in reasons)
     return [g for g in order if id(g) not in reasons], leftover, reason

@@ -297,8 +297,12 @@ class Provisioner(SingletonController):
             done = metrics.REGISTRY.measure(metrics.SCHEDULING_DURATION.name)
             started = self.clock.now()
             if self.profile_dir:
-                import jax
-                with jax.profiler.trace(self.profile_dir):
+                # per-pass device profile through the ONE process-wide
+                # profiler facility: a /debug/profile?device=start session
+                # already capturing makes this a no-op instead of a crash
+                # inside jax.profiler's single-session assertion
+                from ..obs.profile import PROFILER
+                with PROFILER.pass_scope(self.profile_dir):
                     results = self.schedule(pods + deleting_pods)
             else:
                 results = self.schedule(pods + deleting_pods)
@@ -517,6 +521,12 @@ class Provisioner(SingletonController):
             # gRPC RemoteScheduler has no recorder hook — its solves record
             # on the sidecar server's side
             ts.flight_recorder = self.flight_recorder
+        if not record and hasattr(ts, "ledger_subsystem"):
+            # simulation probes are disruption candidate-build traffic:
+            # flag them for the fallback ledger so the headline
+            # provisioning totals describe LIVE solves only (explicit —
+            # works with tracing disabled, unlike the root-span backstop)
+            ts.ledger_subsystem = "disruption"
         self.last_scheduler = ts
         return ts.solve(pods)
 

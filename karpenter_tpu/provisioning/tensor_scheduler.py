@@ -371,6 +371,21 @@ class TensorScheduler:
         self.encode_kind = "cold"
         # (pods solved on the tensor path, pods handed to the host pass)
         self.partition = (0, 0)
+        # per-solve fallback cost attribution (obs/fallbacks.py): shape-
+        # class pod counts + the host-vs-tensor wall split of the LAST
+        # solve — the fleet simulator and /debug/fallbacks read this
+        self.fallback_attribution: dict = {}
+        # which subsystem's traffic this scheduler's solves represent in
+        # the fallback ledger: the provisioner's simulation entry point
+        # (schedule_with(record=False)) and the DisruptionSnapshot flip
+        # this to "disruption" EXPLICITLY, so candidate-build probes never
+        # pollute the headline provisioning totals even with tracing off
+        # (the root-span heuristic in _record_fallbacks is a backstop,
+        # not the source of truth)
+        self.ledger_subsystem = "provisioning"
+        self._breakdown: list = []     # partition_pods (reason, count) rows
+        self._tensor_seconds = 0.0
+        self._host_seconds = 0.0
         # per-instance state-node encoding memo keyed by vocab identity:
         # the disruption snapshot builds several problems against the SAME
         # frozen node set + catalog vocab per pass, and re-encoding 5k node
@@ -396,6 +411,7 @@ class TensorScheduler:
             # the pass trace_id joins this solve's trace, its flight-recorder
             # record, and the provisioner's log line
             self.last_trace_id = TRACER.current_trace_id()
+            self._record_fallbacks(len(pods))
             if rec is not None:
                 rec.capture_provisioning(self, pods, results,
                                          time.perf_counter() - started)
@@ -405,6 +421,9 @@ class TensorScheduler:
         # fresh registry snapshot per solve (see drought_patterns)
         self._drought_pinned = False
         self.encode_kind = "cold"
+        self._breakdown = []
+        self._tensor_seconds = 0.0
+        self._host_seconds = 0.0
         if self.problem_state is not None:
             self.problem_state.begin_solve()
         # port eligibility needs existing-node usage: a port occupied on a
@@ -418,7 +437,8 @@ class TensorScheduler:
         else:
             port_occupied = lambda triples: False  # noqa: E731
         groups, leftover, reason = partition_pods(
-            pods, prebuckets=prebuckets, port_occupied=port_occupied)
+            pods, prebuckets=prebuckets, port_occupied=port_occupied,
+            breakdown=self._breakdown)
         self.partition = (sum(g.count for g in groups), len(leftover))
         if not groups:
             return self._host_solve(pods, reason)
@@ -428,8 +448,12 @@ class TensorScheduler:
             # cooldown's half-open probe
             return self._host_solve(pods, "circuit_open")
         eligible = [p for g in groups for p in g.pods]
+        t0 = time.perf_counter()
         try:
-            results = self._tensor_solve(groups, eligible)
+            try:
+                results = self._tensor_solve(groups, eligible)
+            finally:
+                self._tensor_seconds += time.perf_counter() - t0
         except _FallbackError as e:
             # expected expressibility fallback: the kernel worked as
             # designed, so the breaker doesn't count it either way
@@ -521,7 +545,50 @@ class TensorScheduler:
     def _host_solve(self, pods: List[Pod], reason: str) -> Results:
         self.fallback_reason = reason
         with TRACER.span("host.solve", pods=len(pods), reason=reason):
-            return self._make_host(pods).solve(pods)
+            t0 = time.perf_counter()
+            try:
+                return self._make_host(pods).solve(pods)
+            finally:
+                self._host_seconds += time.perf_counter() - t0
+
+    def _record_fallbacks(self, n_pods: int) -> None:
+        """Assemble this solve's fallback cost attribution and feed the
+        process-wide ledger. Per-class pod counts come from the
+        partitioner's breakdown; a whole-batch fallback (circuit open,
+        device error, an expressibility _FallbackError, limit-pressure or
+        relaxable-preference re-solves) additionally charges the
+        tensor-eligible pods to the fallback's own class, since they ran
+        host too. A solve under a disruption.pass root is a candidate-build
+        probe, not provisioning traffic — attributed to the disruption
+        subsystem so ROADMAP item-1 priorities read clean."""
+        from ..obs.fallbacks import (LEDGER, classify_breakdown,
+                                     classify_reason)
+        classes = classify_breakdown(self._breakdown)
+        tensor_pods, host_pods = self.partition
+        if self.fallback_reason:
+            if tensor_pods:
+                c = classify_reason(self.fallback_reason)
+                classes[c] = classes.get(c, 0) + tensor_pods
+            tensor_pods, host_pods = 0, n_pods
+        self.fallback_attribution = {
+            "classes": classes,
+            "tensor_pods": tensor_pods,
+            "host_pods": host_pods,
+            "tensor_seconds": self._tensor_seconds,
+            "host_seconds": self._host_seconds,
+        }
+        subsystem = self.ledger_subsystem
+        if subsystem == "provisioning" \
+                and TRACER.current_root_name().startswith("disruption"):
+            # backstop for unflagged schedulers running under a disruption
+            # pass (the explicit flag is the source of truth — it also
+            # works with tracing disabled)
+            subsystem = "disruption"
+        LEDGER.record_solve(
+            classes, tensor_pods, host_pods,
+            self._tensor_seconds, self._host_seconds,
+            trace_id=self.last_trace_id, encode_kind=self.encode_kind,
+            subsystem=subsystem)
 
     def _make_host(self, pods: List[Pod]) -> Scheduler:
         from .domains import build_topology_domains
@@ -559,7 +626,11 @@ class TensorScheduler:
         half. (Leftover pods can't couple by construction — partition_pods
         demotes any group whose selectors touch host-side pods.)"""
         with TRACER.span("host.remainder", pods=len(pods)):
-            return self._host_remainder(pods, tensor_results)
+            t0 = time.perf_counter()
+            try:
+                return self._host_remainder(pods, tensor_results)
+            finally:
+                self._host_seconds += time.perf_counter() - t0
 
     def _host_remainder(self, pods: List[Pod], tensor_results: Results
                         ) -> Results:

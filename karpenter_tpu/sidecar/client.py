@@ -196,6 +196,12 @@ class RemoteResults:
     deadline_s: float = 0.0      # per-RPC deadline this solve ran under
     retries: int = 0             # wire retries this solve needed
     hedged: bool = False         # a hedged request produced this answer
+    # causal-observability riders (ISSUE 12): the trace id the server's
+    # span tree ran under — equal to the client's own trace id when the
+    # wire carried trace_ctx (the cross-process join worked) — and the
+    # solve's fallback cost attribution (obs/fallbacks shape)
+    trace_id: str = ""
+    fallback_attribution: dict = field(default_factory=dict)
 
     def all_pods_scheduled(self) -> bool:
         return not self.pod_errors
@@ -630,8 +636,29 @@ class SolverSession(_RetryBudgetMixin):
     # -- solve ----------------------------------------------------------------
 
     def solve(self, nodepools, instance_types, pods: List[Pod],
-              state_nodes=(), daemonset_pods=(), cluster=None):
+              state_nodes=(), daemonset_pods=(), cluster=None,
+              subsystem: str = "provisioning"):
+        from ..obs.tracer import TRACER
+        # operator-side view of the remote solve: one span covering request
+        # assembly + the wire round trip(s). Roots a client PassTrace when
+        # nothing is active (bench, tests); nests under the provisioner
+        # pass otherwise — and its trace ctx rides the wire so the SERVER's
+        # session/queue/solve span tree joins the same trace_id.
+        with TRACER.span("sidecar.rpc", pods=len(pods),
+                         tenant=self.tenant or "default") as rpc_span:
+            results = self._solve_traced(nodepools, instance_types, pods,
+                                         state_nodes, daemonset_pods,
+                                         cluster, subsystem)
+            rpc_span.set(encode_kind=results.encode_kind,
+                         retries=results.retries,
+                         hedged=results.hedged)
+        return results
+
+    def _solve_traced(self, nodepools, instance_types, pods: List[Pod],
+                      state_nodes=(), daemonset_pods=(), cluster=None,
+                      subsystem: str = "provisioning"):
         from . import wire
+        from ..obs.tracer import TRACER
         store = getattr(cluster, "store", None)
         self._ensure_session(nodepools, instance_types)
         self._solve_seq += 1
@@ -654,6 +681,21 @@ class SolverSession(_RetryBudgetMixin):
             # same state bytes (a resync rebuilding the exact bootstrap
             # snapshot) can never collide into a stale cached response
             header["req"] = f"q{next(self._req_seq)}"
+            # trace propagation (wire v2): the active operator-side trace
+            # rides the request so the server's span tree adopts the same
+            # trace_id. Wire retries and hedges resend these identical
+            # bytes and are answered from the server's nonce-keyed dedupe
+            # cache BEFORE any span opens — one logical request can never
+            # mint two server span trees.
+            ctx = TRACER.current_ctx()
+            if ctx is not None:
+                header["trace_ctx"] = ctx
+            # fallback-ledger subsystem rider: a disruption candidate
+            # probe served over the wire must not pollute the SERVER
+            # process's headline provisioning totals (the in-process
+            # ledger_subsystem flag, carried across the boundary)
+            if subsystem != "provisioning":
+                header["subsystem"] = subsystem
             # reset HERE, not before the loop: a hedged CreateSession
             # inside a NOT_FOUND recovery also sets the flag, and the
             # rider must report whether THIS solve's answer came from a
@@ -755,6 +797,8 @@ def decode_results_rows(data: bytes, pods: List[Pod], catalog: list
     results.warm = header.get("warm", "")
     results.degraded = header.get("degraded", "")
     results.partition = tuple(header.get("partition", (0, 0)))
+    results.trace_id = header.get("trace_id", "")
+    results.fallback_attribution = header.get("fallback_attribution", {})
     shape_protos = []
     shape_reqs = []
     shape_its = []
@@ -805,6 +849,11 @@ class RemoteScheduler(_RetryBudgetMixin):
         # same way an in-process solve would (topology.go:268-321)
         self.cluster = cluster
         self.fallback_reason = ""
+        # mirrors TensorScheduler.ledger_subsystem so the provisioner's
+        # simulation entry point can flag disruption probes on THIS
+        # scheduler too; rides the wire so the server-side ledger
+        # attributes them correctly
+        self.ledger_subsystem = "provisioning"
         self.session = session
         self._last: Optional[RemoteResults] = None
         if session is not None:
@@ -832,6 +881,11 @@ class RemoteScheduler(_RetryBudgetMixin):
         return self._last.encode_kind if self._last is not None else ""
 
     @property
+    def fallback_attribution(self) -> dict:
+        return (self._last.fallback_attribution
+                if self._last is not None else {})
+
+    @property
     def partition(self) -> tuple:
         if self._last is not None and any(self._last.partition):
             return tuple(self._last.partition)
@@ -842,7 +896,8 @@ class RemoteScheduler(_RetryBudgetMixin):
             results = self.session.solve(
                 self.nodepools, self.instance_types, pods,
                 state_nodes=self.state_nodes,
-                daemonset_pods=self.daemonset_pods, cluster=self.cluster)
+                daemonset_pods=self.daemonset_pods, cluster=self.cluster,
+                subsystem=self.ledger_subsystem)
             self.fallback_reason = results.fallback_reason
             self._last = results
             return results

@@ -447,7 +447,22 @@ def encode_pod_rows(pods):
 # decision digests (flightrec.decision_digest) — the DEVIATIONS-19 audit
 # shape applied to the wire.
 
-DELTA_SCHEMA_VERSION = 1
+# v2 adds the OPTIONAL `trace_ctx` / `subsystem` header fields: the
+# operator-side pass trace rides the wire so the server's session/queue/
+# solve span tree (and its flightrec records) joins the SAME trace_id, and
+# disruption candidate probes flag themselves for the server's fallback
+# ledger. v1 requests (no new fields) are still served — the fields are
+# additive, so the server speaks both; unknown FUTURE versions still fail
+# loudly.
+#
+# SKEW CONTRACT (deliberately one-directional, the kube convention):
+# servers upgrade BEFORE clients. A v2 client against a v1-only server is
+# rejected INVALID_ARGUMENT on every solve — the version gate exists so a
+# server never half-parses fields it doesn't know, and the price of that
+# loud failure is paid at rollout time, not at 3am as a silently-wrong
+# solve. Roll the sidecar first.
+DELTA_SCHEMA_VERSION = 2
+DELTA_SCHEMA_ACCEPTED = (1, 2)
 
 
 class DeltaVersionError(ValueError):
@@ -463,10 +478,12 @@ class DigestMismatchError(ValueError):
 
 def check_delta_version(header: dict) -> None:
     v = header.get("v")
-    if v != DELTA_SCHEMA_VERSION:
+    if v not in DELTA_SCHEMA_ACCEPTED:
         raise DeltaVersionError(
             f"unknown delta session schema version {v!r} (this end speaks "
-            f"v{DELTA_SCHEMA_VERSION}); refusing to guess at the fields")
+            f"v{DELTA_SCHEMA_VERSION}, accepts "
+            f"{list(DELTA_SCHEMA_ACCEPTED)}); refusing to guess at the "
+            "fields")
 
 
 def template_content_key(d: dict) -> str:

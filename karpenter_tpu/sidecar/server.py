@@ -410,28 +410,62 @@ def _solve_session(request: bytes, context=None) -> bytes:
             except codec.DeltaVersionError as e:
                 _bad_request(context, str(e))
 
-        def admitted(run):
-            # session.lock is taken BEFORE the admission slot: a request
-            # serialized behind a same-session sibling must not occupy a
-            # device slot while it waits (with max_concurrent > 1 that
-            # would idle a device another tenant is queued for)
-            try:
-                wait = ADMISSION.acquire(session.tenant)
-            except QueueFullError as e:
-                if context is not None:
-                    context.abort(_shed_status(e), str(e))
-                raise
-            try:
-                if context is not None and not context.is_active():
-                    # the client gave up (deadline/cancel) while we were
-                    # queued: don't burn the device on a response nobody
-                    # will receive — hand the slot to a live request
-                    context.abort(grpc.StatusCode.CANCELLED,
-                                  "client cancelled while queued for the "
-                                  "device")
-                return run(wait)
-            finally:
-                ADMISSION.release()
+        def admitted(run, traced=False):
+            # ONE copy of the admission semantics for both wire paths
+            # (shed abort, client-cancel check, acquire/release pairing —
+            # the _demotion_reason single-copy rule). session.lock is
+            # taken BEFORE the admission slot: a request serialized behind
+            # a same-session sibling must not occupy a device slot while
+            # it waits (with max_concurrent > 1 that would idle a device
+            # another tenant is queued for).
+            #
+            # `traced` (the delta path): adopt the client's trace ctx
+            # (wire v2) so ONE trace_id names both sides, and root the
+            # sidecar.solve span BEFORE the admission queue so queue-wait
+            # is a real span inside the trace, not just a metric. Sheds
+            # and client-cancels drop the trace (drop_current): the client
+            # retries the identical bytes and the completed retry — served
+            # past the nonce dedupe — is the one real span tree.
+            from contextlib import nullcontext
+
+            from ..obs.tracer import TRACER
+            if traced:
+                from ..metrics.registry import tenant_label
+                tctx = header.get("trace_ctx") or {}
+                if tctx.get("id"):
+                    TRACER.adopt(str(tctx["id"]), str(tctx.get("span", "")))
+                root = TRACER.span("sidecar.solve",
+                                   tenant=tenant_label(session.tenant),
+                                   session=session.id)
+            else:
+                root = nullcontext()
+            with root:
+                try:
+                    with (TRACER.span("sidecar.queue") if traced
+                          else nullcontext()) as qsp:
+                        wait = ADMISSION.acquire(session.tenant)
+                        if qsp is not None:
+                            qsp.set(wait_ms=round(wait * 1e3, 3))
+                except QueueFullError as e:
+                    if traced:
+                        TRACER.drop_current()
+                    if context is not None:
+                        context.abort(_shed_status(e), str(e))
+                    raise
+                try:
+                    if context is not None and not context.is_active():
+                        # the client gave up (deadline/cancel) while we
+                        # were queued: don't burn the device on a response
+                        # nobody will receive — hand the slot to a live
+                        # request
+                        if traced:
+                            TRACER.drop_current()
+                        context.abort(grpc.StatusCode.CANCELLED,
+                                      "client cancelled while queued for "
+                                      "the device")
+                    return run(wait)
+                finally:
+                    ADMISSION.release()
 
         if legacy:
             return admitted(lambda wait: _solve_session_legacy(
@@ -479,7 +513,7 @@ def _solve_session(request: bytes, context=None) -> bytes:
                             "loser of a superseded solve")
                     raise ValueError("stale request nonce")
             response = admitted(lambda wait: _solve_session_delta(
-                session, header, blobs, context, wait))
+                session, header, blobs, context, wait), traced=True)
             if req_digest is not None:
                 session.response_cache[req_digest] = response
                 session.last_req_seq = max(session.last_req_seq, req_seq)
@@ -664,56 +698,70 @@ def _parity_probe(session: _Session, results, ts_sched, pods) -> str:
 
 def _solve_session_delta(session: _Session, header: dict, blobs,
                          context, queue_wait: float) -> bytes:
-    from ..metrics.registry import tenant_label
     from ..obs.tracer import TRACER
-    with TRACER.span("sidecar.solve", tenant=tenant_label(session.tenant),
-                     session=session.id,
-                     queue_wait_ms=round(queue_wait * 1e3, 3)):
-        with TRACER.span("sidecar.apply"):
-            digest = _apply_session_delta(session, header, blobs, context)
-        # another tenant's catalog traffic may have LRU-evicted our
-        # encoding; reinstating the PINNED object keeps vocab identity
-        # (and with it every ProblemState row cache and the warm-pack
-        # token) valid
-        restore_catalog_encoding(session.catalog_token, session._ce_pin)
-        with TRACER.span("sidecar.batch", pods=len(session.rows)):
-            pods, buckets = _build_session_batch(session, use_cache=True)
-        state_nodes = list(session.state_nodes.values())
-        daemonset_pods = list(session.daemonset_pods)
-        ts_sched = _session_scheduler(session, state_nodes, daemonset_pods,
-                                      session.problem_state)
-        results = ts_sched.solve(pods, prebuckets=buckets)
-        if ts_sched.fallback_reason or ts_sched.partition[1]:
-            # the host path ran: its relaxation ladder may have mutated
-            # pod specs in place — the cached batch is no longer a
-            # faithful rebuild, so the next solve reconstructs it
-            session.wire_pods = None
-        session._ce_pin = catalog_encoding_pin(session.catalog_token) \
-            or session._ce_pin
-        extra = {
-            "encode_kind": ts_sched.encode_kind,
-            "digest": digest,
-            "queue_wait_ms": round(queue_wait * 1e3, 3),
-            "warm": session.problem_state.last.get("warm", ""),
-            "partition": list(ts_sched.partition),
-        }
-        if ts_sched.fallback_reason == "circuit_open":
-            # the PR-2 circuit breaker forced the host oracle: say so on
-            # the wire — a client must see `degraded=host_oracle`, not a
-            # silently slower answer (the breaker state is server-process
-            # truth the client has no other window into)
-            extra["degraded"] = "host_oracle"
-        if header.get("parity_check"):
-            extra["parity"] = _parity_probe(session, results, ts_sched,
-                                            pods)
-        session.solves += 1
-        session.last_digest = digest
-        session.last_solve_at = time.monotonic()
-        with TRACER.span("sidecar.encode"):
-            return codec.encode_solve_response_rows(
-                results, ts_sched.fallback_reason,
-                session.it_idx_by_id, session.it_idx_by_name,
-                extra_header=extra)
+    # runs INSIDE the sidecar.solve root span traced_admitted opened (the
+    # queue wait is already a sibling span); annotate the root so the SLO
+    # watcher and phase histograms see how the pass was produced
+    TRACER.annotate(queue_wait_ms=round(queue_wait * 1e3, 3))
+    with TRACER.span("sidecar.apply"):
+        digest = _apply_session_delta(session, header, blobs, context)
+    # another tenant's catalog traffic may have LRU-evicted our
+    # encoding; reinstating the PINNED object keeps vocab identity
+    # (and with it every ProblemState row cache and the warm-pack
+    # token) valid
+    restore_catalog_encoding(session.catalog_token, session._ce_pin)
+    with TRACER.span("sidecar.batch", pods=len(session.rows)):
+        pods, buckets = _build_session_batch(session, use_cache=True)
+    state_nodes = list(session.state_nodes.values())
+    daemonset_pods = list(session.daemonset_pods)
+    ts_sched = _session_scheduler(session, state_nodes, daemonset_pods,
+                                  session.problem_state)
+    if header.get("subsystem") == "disruption":
+        # fallback-ledger rider: a remote disruption candidate probe must
+        # not move THIS process's headline provisioning totals (whitelist
+        # — an unknown value stays provisioning)
+        ts_sched.ledger_subsystem = "disruption"
+    results = ts_sched.solve(pods, prebuckets=buckets)
+    if ts_sched.fallback_reason or ts_sched.partition[1]:
+        # the host path ran: its relaxation ladder may have mutated
+        # pod specs in place — the cached batch is no longer a
+        # faithful rebuild, so the next solve reconstructs it
+        session.wire_pods = None
+    session._ce_pin = catalog_encoding_pin(session.catalog_token) \
+        or session._ce_pin
+    extra = {
+        "encode_kind": ts_sched.encode_kind,
+        "digest": digest,
+        "queue_wait_ms": round(queue_wait * 1e3, 3),
+        "warm": session.problem_state.last.get("warm", ""),
+        "partition": list(ts_sched.partition),
+        # the trace id this solve's server span tree ran under — equal to
+        # the client's own id when the request carried trace_ctx, so the
+        # client can assert the cross-process join end to end
+        "trace_id": TRACER.current_trace_id(),
+        # the fallback cost attribution rider: shape-class pod counts +
+        # host/tensor wall split (obs/fallbacks), so a remote caller (the
+        # fleet simulator's sidecar backend) reads the same per-solve
+        # attribution an in-process scheduler exposes
+        "fallback_attribution": ts_sched.fallback_attribution,
+    }
+    if ts_sched.fallback_reason == "circuit_open":
+        # the PR-2 circuit breaker forced the host oracle: say so on
+        # the wire — a client must see `degraded=host_oracle`, not a
+        # silently slower answer (the breaker state is server-process
+        # truth the client has no other window into)
+        extra["degraded"] = "host_oracle"
+    if header.get("parity_check"):
+        extra["parity"] = _parity_probe(session, results, ts_sched,
+                                        pods)
+    session.solves += 1
+    session.last_digest = digest
+    session.last_solve_at = time.monotonic()
+    with TRACER.span("sidecar.encode"):
+        return codec.encode_solve_response_rows(
+            results, ts_sched.fallback_reason,
+            session.it_idx_by_id, session.it_idx_by_name,
+            extra_header=extra)
 
 
 def _solve_session_legacy(session: _Session, header: dict, blobs) -> bytes:

@@ -264,6 +264,13 @@ class FleetSimulator:
                                pods_evicted=0, pods_replaced=0)
         self.solver_stats = _Counter(passes=0, tensor_pods=0, host_pods=0,
                                      pod_errors=0)
+        # fallback cost ledger (ISSUE 12): per-shape-class host-oracle pod
+        # counts (deterministic — digested in the ledger entries) and the
+        # host/tensor wall split (measurement context, report-only)
+        self.fallback_classes: Dict[str, int] = {}
+        self.fallback_host_seconds = 0.0
+        self.fallback_tensor_seconds = 0.0
+        self.phase_attribution: Dict[str, float] = {}
         self.events_applied: "_Counter[str]" = _Counter()
         self.breaches: list = []
         self.workloads: Dict[str, _Workload] = {}
@@ -361,8 +368,7 @@ class FleetSimulator:
         self.solver_stats["tensor_pods"] += part[0]
         self.solver_stats["host_pods"] += part[1]
         self.solver_stats["pod_errors"] += len(results.pod_errors)
-        self.ledger.append(
-            self._rel(), "solve",
+        entry = dict(
             pods=part[0] + part[1],
             claims=len(results.new_nodeclaims),
             existing=sum(1 for en in results.existing_nodes if en.pods),
@@ -370,6 +376,20 @@ class FleetSimulator:
             encode_kind=getattr(ts, "encode_kind", "cold"),
             fallback=getattr(ts, "fallback_reason", ""),
             trace_id=getattr(ts, "last_trace_id", ""))
+        # the solve's fallback cost attribution: shape-class pod counts
+        # are deterministic (digested — same seed, same escapes); the wall
+        # split is measurement context and stays out of the ledger
+        attr = getattr(ts, "fallback_attribution", None)
+        if attr:
+            classes = attr.get("classes") or {}
+            for shape, pods in classes.items():
+                self.fallback_classes[shape] = \
+                    self.fallback_classes.get(shape, 0) + pods
+            self.fallback_host_seconds += attr.get("host_seconds", 0.0)
+            self.fallback_tensor_seconds += attr.get("tensor_seconds", 0.0)
+            if classes:
+                entry["fallbacks"] = dict(sorted(classes.items()))
+        self.ledger.append(self._rel(), "solve", **entry)
 
     def _collect_breaches(self) -> None:
         # drain IN PLACE: the watcher's on_breach hook holds a reference
@@ -667,6 +687,9 @@ class FleetSimulator:
 
     def _run(self) -> dict:
         wall0 = time.perf_counter()
+        # per-subsystem attribution baseline: the phase histogram is
+        # process-global, so the run's share is the delta from here
+        phase_base = metrics.phase_seconds_by_name()
         self._boot()
         self._running = True
         sc = self.scenario
@@ -708,6 +731,11 @@ class FleetSimulator:
         self._running = False
         self.sim_seconds = self.clock.now() - self.t0
         self.wall_seconds = time.perf_counter() - wall0
+        phase_now = metrics.phase_seconds_by_name()
+        self.phase_attribution = {
+            k: round(max(0.0, phase_now.get(k, 0.0) - phase_base.get(k, 0.0)),
+                     6)
+            for k in phase_now}
         store = self.op.store
         self.final_state = {
             "nodes": len(store.list(Node)),

@@ -63,6 +63,29 @@ class Ledger:
         return len(lines)
 
 
+# span-name -> subsystem mapping for the per-subsystem attribution view.
+# provisioning/disruption are pass-level (inclusive) families; device and
+# wire are the disjoint leaf stages nested inside them, so the view answers
+# "of the pass time, how much was accelerator / how much was wire".
+SUBSYSTEM_SPANS = {
+    "provisioning": ("provisioner.pass",),
+    "disruption": ("disruption.pass",),
+    "disruption_candidate_build": ("disruption.snapshot",
+                                   "disruption.encode", "disruption.loo"),
+    "device": ("device.upload", "device.dispatch", "device.execute",
+               "device.fetch", "compile"),
+    "wire": ("sidecar.rpc", "sidecar.queue"),
+}
+
+
+def subsystem_attribution(phase_seconds: Dict[str, float]) -> Dict[str, float]:
+    """Fold per-phase seconds (metrics.phase_seconds_by_name delta) into
+    the per-subsystem attribution the SLO report carries."""
+    return {
+        sub: round(sum(phase_seconds.get(p, 0.0) for p in spans), 3)
+        for sub, spans in SUBSYSTEM_SPANS.items()}
+
+
 def build_report(sim) -> dict:
     """Aggregate a finished FleetSimulator into the SLO report dict."""
     tts = sim.tts_samples
@@ -127,6 +150,27 @@ def build_report(sim) -> dict:
                 solver["host_pods"] / solved_pods, 4) if solved_pods else 0.0,
             "pod_errors": solver["pod_errors"],
         },
+        # fallback cost ledger (ISSUE 12): which shape classes forced the
+        # host-oracle escapes, and what the slow path cost vs the tensor
+        # path. Class pod counts are deterministic (they also ride the
+        # digested solve ledger entries); the wall seconds are measurement
+        # context like wall_seconds.
+        "fallbacks": {
+            "classes": dict(sorted(sim.fallback_classes.items())),
+            "host_seconds": round(sim.fallback_host_seconds, 3),
+            "tensor_seconds": round(sim.fallback_tensor_seconds, 3),
+            "host_cost_ratio": round(
+                sim.fallback_host_seconds
+                / (sim.fallback_tensor_seconds
+                   + sim.fallback_host_seconds), 4)
+            if (sim.fallback_tensor_seconds
+                + sim.fallback_host_seconds) else 0.0,
+        },
+        # per-subsystem wall attribution from the span-derived phase
+        # histograms (run delta): provisioning/disruption are INCLUSIVE
+        # pass times, device/wire the leaf-stage costs nested inside them
+        # (disruption_candidate_build = snapshot + encode + LOO classify)
+        "attribution": subsystem_attribution(sim.phase_attribution),
         "breaches": [
             {"slo": b.slo, "trace_id": b.trace_id,
              "budget": b.budget, "dump": b.dump_path}
@@ -163,6 +207,19 @@ def render_report(report: dict) -> str:
     out.append(f"solver      {solver['passes']} passes, "
                f"fallback fraction {solver['fallback_fraction']:.2%}, "
                f"{solver['pod_errors']} pod errors")
+    fb = report.get("fallbacks")
+    if fb and fb["classes"]:
+        shapes = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(fb["classes"].items()))
+        out.append(f"fallbacks   {shapes}; host {fb['host_seconds']:.2f}s "
+                   f"vs tensor {fb['tensor_seconds']:.2f}s "
+                   f"({fb['host_cost_ratio']:.0%} of solver wall on the "
+                   "host path)")
+    attr = report.get("attribution")
+    if attr and any(attr.values()):
+        parts = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(attr.items())
+                          if v)
+        out.append(f"subsystems  {parts}")
     svc = report.get("service")
     if svc:
         faults = ", ".join(f"{k}x{v}" for k, v in

@@ -156,17 +156,26 @@ class TestFlightRecorderBudget:
     milliseconds, and the deferred materialization must still replay to a
     byte-identical decision."""
 
-    CAPTURE_BUDGET_SECONDS = 0.020
+    # same-process ratio (ISSUE 12 satellite): the capture rides inside
+    # the solve, so its cost is bounded as a fraction of THIS process's
+    # own measured solve — the old 20ms absolute budget flaked whenever
+    # the 2-core box stalled the timer (an eager encode costs >100ms at
+    # this scale, far past 5% of any plausible solve time + grace)
+    CAPTURE_SOLVE_FRACTION = 0.05
+    CAPTURE_GRACE_SECONDS = 0.010
 
     def test_capture_is_deferred_and_cheap(self, solved):
         from karpenter_tpu.flightrec import FlightRecorder
-        pods, ts, results, _ = solved
+        pods, ts, results, solve_elapsed = solved
         rec = FlightRecorder(capacity=4)
         t0 = time.perf_counter()
         rec.capture_provisioning(ts, pods, results, 0.0)
         elapsed = time.perf_counter() - t0
-        assert elapsed < self.CAPTURE_BUDGET_SECONDS, (
-            f"hot-path capture took {elapsed * 1000:.1f}ms at "
+        budget = (solve_elapsed * self.CAPTURE_SOLVE_FRACTION
+                  + self.CAPTURE_GRACE_SECONDS)
+        assert elapsed < budget, (
+            f"hot-path capture took {elapsed * 1000:.1f}ms vs the "
+            f"same-process solve's {solve_elapsed * 1000:.0f}ms at "
             f"{len(pods)} pods — the deferred encode likely went eager")
         r = rec.records()[-1]
         assert r._refs is not None and r._digest_refs is not None, \
@@ -450,11 +459,16 @@ class TestChurnBudget:
     regression would trip: the internal delta-residency asserts
     (encode_kind == delta every window, dirty-row counts on node-churn
     windows, warm prefix restores on steady ones), the sampled
-    delta-vs-cold bit-identity, and a p99 time-to-decision budget a
-    return of cold encodes would blow."""
+    delta-vs-cold bit-identity, and a p99 time-to-decision bound a
+    return of cold encodes would blow — expressed as a SAME-PROCESS RATIO
+    against the bench's own timed cold parity solve (the TestMeshBudget
+    pattern, ISSUE 12 satellite: the old 1500ms absolute budget flaked on
+    slow boxes and couldn't flag a cold regression on a fast one; on-box
+    the delta p99 is ~22ms vs ~294ms cold, so 0.5x cold catches a return
+    to cold encodes with >2x margin on both sides)."""
 
     N_NODES = 300
-    P99_BUDGET_MS = 1500.0
+    P99_COLD_RATIO = 0.5
     RATE_FLOOR = 200.0
 
     def test_churn_bench_shape_within_budget(self, capsys):
@@ -476,9 +490,11 @@ class TestChurnBudget:
              if l.startswith("{")][-1])
         assert line["unit"] == "pods/sec"
         assert "steady-state churn" in line["metric"]
-        assert line["p99_ms"] < self.P99_BUDGET_MS, (
-            f"churn p99 {line['p99_ms']}ms at {self.N_NODES} nodes — the "
-            "delta path likely fell back to cold encodes")
+        assert line["cold_ms"] > 0, "bench reported no cold reference"
+        assert line["p99_ms"] < line["cold_ms"] * self.P99_COLD_RATIO, (
+            f"churn p99 {line['p99_ms']}ms vs same-process cold "
+            f"{line['cold_ms']}ms at {self.N_NODES} nodes — the delta "
+            "path likely fell back to cold encodes")
         assert line["value"] >= self.RATE_FLOOR
         assert line["delta_encodes"] == 8  # every timed window rode deltas
         assert line["warm_restored_groups"] > 0
@@ -508,11 +524,21 @@ class TestServiceBudget:
     would trip: every timed window DELTA-resident server-side with zero
     resyncs (asserted in-bench from the response headers), the sampled
     byte-identical cold-parity probes, per-tenant admission metrics, and a
-    wall-clock budget a return of full-batch re-encodes (or a resync loop)
-    would blow."""
+    wall-clock bound a return of full-batch re-encodes (or a resync loop)
+    would blow — expressed as a SAME-PROCESS RATIO: the warm delta round
+    trip vs the SAME run's full-session bootstrap, both measured in the
+    same client process (ISSUE 12 satellite — the old 20s absolute warm
+    budget was a recurring flake on this 2-core box, where cross-process
+    captures run 30-50% slower than the r05 numbers; the in-bench
+    SERVICE_WARM_BUDGET stays as a generous hang guard only)."""
 
     BUDGET_SECONDS = 240.0
-    WARM_BUDGET_SECONDS = 20.0
+    WARM_BUDGET_SECONDS = 60.0     # hang guard passed into the bench
+    # the warm delta must BEAT the bootstrap by a margin for the ratio to
+    # bind (1.0 would hold even when deltas regress to full re-encodes):
+    # headline measures 0.46s vs 2.2s (0.21x); test scale ~0.1x
+    WARM_VS_FULL_RATIO = 0.5
+    RATIO_GRACE_SECONDS = 0.1
 
     def test_service_bench_shape_within_budget(self, capsys):
         import json
@@ -547,9 +573,20 @@ class TestServiceBudget:
         assert line["delta_solves"] == 3 + 2 * 3  # phase A + B windows
         assert line["parity_samples"] == 3        # 1 + one per tenant
         assert line["tenants"] == 2
-        assert line["seconds"] < self.WARM_BUDGET_SECONDS
+        # same-process ratio: the p50 warm delta round trip must beat the
+        # full-session bootstrap measured by the same client process in
+        # the same run (a return of full-batch re-encodes makes them equal)
         assert line["full_session_seconds"] > 0
+        assert line["seconds"] <= (line["full_session_seconds"]
+                                   * self.WARM_VS_FULL_RATIO
+                                   + self.RATIO_GRACE_SECONDS), (
+            f"warm delta p50 {line['seconds']}s vs full bootstrap "
+            f"{line['full_session_seconds']}s same-process — the delta "
+            "wire likely fell back to full-batch re-encodes")
         assert line["resync_seconds"] > 0
+        # the causal join evidence (ISSUE 12): every tenant's warm solve
+        # joined client-side and at least one full server tree survived
+        assert line["trace_joins_in_server_ring"] >= 1
 
     def test_bench_mode_service_is_a_known_mode(self):
         import re
@@ -695,3 +732,54 @@ def test_node_count_parity_vs_host_oracle_per_kind(kind):
          f"{kind}: tensor={len(r.new_nodeclaims)} "
          f"oracle={len(rh.new_nodeclaims)}")
     assert set(r.pod_errors) == set(rh.pod_errors)
+
+
+class TestFallbacksBudget:
+    """ISSUE 12 guard: the BENCH_MODE=fallbacks line at test scale. The
+    bench itself asserts the hard contracts (per-class pod counts EXACT on
+    the solve's attribution, the process ledger's aggregation consistent,
+    circuit_open charging the whole batch); this guard runs the same code
+    small and pins the reported evidence plus a generous hang-guard
+    wall clock (the real cost signal is the in-line host-vs-tensor ratio,
+    which is same-process by construction — no absolute capture
+    constants)."""
+
+    BUDGET_SECONDS = 120.0
+
+    def test_fallbacks_bench_shape_within_budget(self, capsys):
+        import json
+
+        saved = (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS, bench.REPEATS)
+        (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS, bench.REPEATS) = \
+            (N_PODS, N_DEPLOYS, 144, 1)
+        try:
+            t0 = time.perf_counter()
+            bench.bench_fallbacks()
+            elapsed = time.perf_counter() - t0
+        finally:
+            (bench.N_PODS, bench.N_DEPLOYS, bench.N_ITS,
+             bench.REPEATS) = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"fallbacks bench took {elapsed:.1f}s at {N_PODS} pods — the "
+            "host path or the ledger likely regressed")
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        mixed, circ = lines[-2], lines[-1]
+        assert set(mixed["classes"]) == {"ports", "volumes", "topo",
+                                         "multi_group"}
+        assert mixed["fallback_fraction"] > 0
+        assert set(mixed["class_fraction"]) == set(mixed["classes"])
+        assert mixed["host_seconds"] > 0 and mixed["tensor_seconds"] > 0
+        # the degradation envelope is real: the host path is measurably
+        # slower per pod than the tensor path on the same solve
+        assert mixed["host_vs_tensor_slowdown"] > 1.0
+        assert "circuit_open" in circ["metric"]
+        assert list(circ["classes"]) == ["circuit_open"]
+
+    def test_bench_mode_fallbacks_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "fallbacks" in m.group(0), \
+            "BENCH_MODE=fallbacks missing from the unknown-mode error list"

@@ -1,0 +1,309 @@
+"""Fallback cost ledger (ISSUE 12 tentpole c): every host-oracle escape
+classified by shape class, with pod counts and host-vs-tensor wall cost —
+directed vectors per shape class, the process-wide LEDGER aggregation,
+the karpenter_fallback_* metric families, and /debug/fallbacks."""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (Affinity, HostPort, LabelSelector,
+                                       ObjectMeta, Pod, PodAffinity,
+                                       PodAffinityTerm, PodSpec, PVCRef,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.obs import fallbacks as fb
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.utils import resources as res
+
+from factories import make_nodepool, make_pods
+
+REQ = res.parse_list({"cpu": "100m", "memory": "128Mi"})
+
+
+def _pod(name, labels=None, **spec_kw):
+    return Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                   labels=dict(labels or {})),
+               spec=PodSpec(**spec_kw), container_requests=[REQ])
+
+
+def _scheduler(**kw):
+    return TensorScheduler([make_nodepool(name="default")],
+                           {"default": construct_instance_types()[:12]},
+                           **kw)
+
+
+class TestClassifyReason:
+    """One directed vector per shape class, over the EXACT reason strings
+    the partitioner / scheduler / LOO engine emit today — a reworded
+    reason that falls out of its class lands in 'other', which this test
+    catches."""
+
+    CASES = {
+        # grouping._demotion_reason
+        "host ports require per-pod conflict tracking": "ports",
+        "persistent volume claims shared across pods require host-side "
+        "limit tracking": "volumes",                      # NOT limits
+        "unsupported topology constraint shape": "topo",  # NOT ports
+        "host ports with hostname pod-affinity need per-pod host "
+        "tracking": "ports",                              # NOT topo
+        "node-affinity preferences with zonal topology need the host "
+        "relaxation ladder": "topo",
+        # grouping._finish_partition coupling
+        "topology selector couples to host-path pods": "topo",
+        "topology selector couples multiple pod groups": "multi_group",
+        # tensor_scheduler fallbacks
+        "daemonset host ports need per-pod conflict tracking": "ports",
+        "minValues on example.com/foo needs host-side enforcement":
+            "minvalues",
+        "pack errors under nodepool limit pressure": "limits",
+        "unscheduled pods with relaxable preferences": "topo",
+        "circuit_open": "circuit_open",
+        "tensor solve failed: RuntimeError('device gone')": "device_error",
+        # a device OOM's exception text mentions 'limit' — still a device
+        # error, never the nodepool-limits shape class
+        "tensor solve failed: XlaRuntimeError('RESOURCE_EXHAUSTED: "
+        "memory limit exceeded')": "device_error",
+        # disruption LOO globals
+        "base pods re-pack the shared pending set": "base_pods",
+        # unknown strings stay visible, not silently dropped
+        "some future reason": "other",
+        "": "other",
+    }
+
+    def test_every_reason_classifies(self):
+        for reason, want in self.CASES.items():
+            assert fb.classify_reason(reason) == want, reason
+
+    def test_breakdown_folds_counts(self):
+        classes = fb.classify_breakdown([
+            ("host ports require per-pod conflict tracking", 3),
+            ("unsupported topology constraint shape", 2),
+            ("topology selector couples to host-path pods", 4),
+        ])
+        assert classes == {"ports": 3, "topo": 6}
+
+
+class TestSolveAttribution:
+    """Directed integration vectors: a mixed batch's per-class pod counts
+    are EXACT on TensorScheduler.fallback_attribution."""
+
+    def _mixed(self):
+        pods = make_pods(6, cpu="100m")
+        # ports: conflicting host port + self-selecting hostname affinity
+        plab = {"app": "t-ports"}
+        sel = LabelSelector(match_labels=dict(plab))
+        aff = Affinity(pod_affinity=PodAffinity(required=[
+            PodAffinityTerm(topology_key=api_labels.LABEL_HOSTNAME,
+                            label_selector=sel)]))
+        pods += [_pod(f"t-ports-{i}", plab,
+                      host_ports=[HostPort(port=2222)], affinity=aff)
+                 for i in range(2)]
+        # volumes: shared non-ephemeral PVC
+        pods += [_pod(f"t-vol-{i}", {"app": "t-vol"},
+                      volumes=[PVCRef(claim_name="d", ephemeral=False)])
+                 for i in range(3)]
+        # topo: unsupported topology key
+        rack = [TopologySpreadConstraint(
+            topology_key="example.com/rack", max_skew=1,
+            label_selector=LabelSelector(match_labels={"app": "t-topo"}))]
+        pods += [_pod(f"t-topo-{i}", {"app": "t-topo"},
+                      topology_spread_constraints=list(rack))
+                 for i in range(4)]
+        # multi_group: A's selector counts B's pods; B rides along as topo
+        selb = LabelSelector(match_labels={"app": "t-mg-b"})
+        mg = [TopologySpreadConstraint(
+            topology_key=api_labels.LABEL_TOPOLOGY_ZONE, max_skew=1,
+            label_selector=selb)]
+        pods += [_pod(f"t-mg-a-{i}", {"app": "t-mg-a"},
+                      topology_spread_constraints=list(mg))
+                 for i in range(2)]
+        pods += [_pod(f"t-mg-b-{i}", {"app": "t-mg-b"}) for i in range(2)]
+        expected = {"ports": 2, "volumes": 3, "topo": 6, "multi_group": 2}
+        return pods, expected
+
+    def test_mixed_batch_classes_exact(self):
+        pods, expected = self._mixed()
+        ts = _scheduler()
+        ts.solve(pods)
+        attr = ts.fallback_attribution
+        assert attr["classes"] == expected
+        assert attr["host_pods"] == sum(expected.values())
+        assert attr["tensor_pods"] == len(pods) - sum(expected.values())
+        assert attr["host_seconds"] > 0.0
+        assert attr["tensor_seconds"] > 0.0
+
+    def test_clean_tensor_solve_has_no_classes(self):
+        ts = _scheduler()
+        ts.solve(make_pods(5, cpu="100m"))
+        attr = ts.fallback_attribution
+        assert attr["classes"] == {}
+        assert attr["host_pods"] == 0
+        assert attr["host_seconds"] == 0.0
+
+    def test_circuit_open_charges_whole_batch(self):
+        class _Open:
+            def allow(self):
+                return False
+
+            def record_failure(self):
+                pass
+
+            def record_success(self):
+                pass
+
+        ts = _scheduler(circuit=_Open())
+        pods = make_pods(7, cpu="100m")
+        ts.solve(pods)
+        assert ts.fallback_reason == "circuit_open"
+        attr = ts.fallback_attribution
+        assert attr["classes"] == {"circuit_open": 7}
+        assert attr["tensor_pods"] == 0 and attr["host_pods"] == 7
+
+    def test_minvalues_fallback_charges_batch(self):
+        class _MinValuesReq:
+            def __init__(self):
+                self.key = "example.com/custom"
+                self.operator = "Exists"
+                self.values = ()
+                self.min_values = 2
+
+        np_ = make_nodepool(name="default",
+                            requirements=[_MinValuesReq()])
+        ts = TensorScheduler([np_],
+                             {"default": construct_instance_types()[:12]})
+        pods = make_pods(4, cpu="100m")
+        ts.solve(pods)
+        assert "minValues" in ts.fallback_reason
+        assert ts.fallback_attribution["classes"] == {"minvalues": 4}
+
+
+class TestLedger:
+    def test_record_and_snapshot_shapes(self):
+        led = fb.FallbackLedger()
+        led.record_solve({"ports": 3, "topo": 1}, tensor_pods=96,
+                         host_pods=4, tensor_seconds=0.4, host_seconds=0.2,
+                         trace_id="t000042", encode_kind="delta")
+        led.record_solve({}, tensor_pods=100, host_pods=0,
+                         tensor_seconds=0.3, host_seconds=0.0)
+        snap = led.snapshot()
+        assert snap["solves"] == 2
+        assert snap["tensor_pods"] == 196 and snap["host_pods"] == 4
+        assert snap["fallback_fraction"] == round(4 / 200, 6)
+        ports = snap["classes"]["provisioning/ports"]
+        assert ports["pods"] == 3 and ports["solves"] == 1
+        # host seconds split pro-rata by pod count: 3/4 of 0.2s to ports
+        assert ports["host_seconds"] == pytest.approx(0.15)
+        assert snap["classes"]["provisioning/topo"]["host_seconds"] == \
+            pytest.approx(0.05)
+        assert snap["recent"][-1]["trace_id"] == "t000042"
+
+    def test_disruption_records_do_not_move_headline_totals(self):
+        led = fb.FallbackLedger()
+        led.record_disruption({"base_pods": 10, "volumes": 2})
+        led.record_solve({"topo": 1}, 9, 1, 0.1, 0.05,
+                         subsystem="disruption")
+        snap = led.snapshot()
+        assert snap["solves"] == 0 and snap["host_pods"] == 0
+        assert snap["classes"]["disruption/base_pods"]["pods"] == 10
+        assert snap["classes"]["disruption/topo"]["pods"] == 1
+        assert snap["recent"] == []
+
+    def test_process_ledger_aggregates_solves(self):
+        fb.LEDGER.reset()
+        ts = _scheduler()
+        pods = make_pods(3, cpu="100m") + [
+            _pod("lp-0", {"app": "lp"},
+                 volumes=[PVCRef(claim_name="x", ephemeral=False)])]
+        ts.solve(pods)
+        snap = fb.LEDGER.snapshot()
+        assert snap["solves"] == 1
+        assert snap["classes"]["provisioning/volumes"]["pods"] == 1
+        assert snap["recent"][0]["classes"] == {"volumes": 1}
+
+    def test_metrics_families_move(self):
+        from karpenter_tpu.metrics.registry import (FALLBACK_HOST_SECONDS,
+                                                    FALLBACK_PODS,
+                                                    FALLBACK_SOLVES)
+        labels = {"shape": "volumes", "subsystem": "provisioning"}
+        before = FALLBACK_PODS.value(labels)
+        ts = _scheduler()
+        ts.solve([_pod("mp-0", {"app": "mp"},
+                       volumes=[PVCRef(claim_name="y", ephemeral=False)])])
+        assert FALLBACK_PODS.value(labels) == before + 1
+        assert FALLBACK_SOLVES.value(labels) >= 1
+        assert FALLBACK_HOST_SECONDS.value(labels) > 0
+
+
+class TestDebugEndpoint:
+    def test_debug_fallbacks_serves_ledger(self):
+        from karpenter_tpu.operator.server import ServingGroup
+        fb.LEDGER.reset()
+        ts = _scheduler()
+        ts.solve([_pod("ep-0", {"app": "ep"},
+                       volumes=[PVCRef(claim_name="z", ephemeral=False)])])
+        group = ServingGroup(0, 0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{group.metrics_port}"
+                    "/debug/fallbacks?n=5", timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+        finally:
+            group.stop()
+        assert doc["solves"] >= 1
+        assert doc["classes"]["provisioning/volumes"]["pods"] >= 1
+        assert doc["fallback_fraction"] > 0
+        assert isinstance(doc["recent"], list) and doc["recent"]
+
+    def test_debug_fallbacks_rejects_bad_n(self):
+        from karpenter_tpu.operator.server import ServingGroup
+        group = ServingGroup(0, 0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{group.metrics_port}"
+                "/debug/fallbacks?n=bogus")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            group.stop()
+
+
+class TestSubsystemFlag:
+    def test_disruption_flag_honored_with_tracing_off(self):
+        """A candidate-build probe (ledger_subsystem='disruption', the
+        schedule_with(record=False)/DisruptionSnapshot flag) must not move
+        the headline provisioning totals even when --trace-ring 0 disabled
+        the root-span backstop."""
+        from karpenter_tpu.obs.tracer import TRACER
+        fb.LEDGER.reset()
+        saved = TRACER.enabled
+        try:
+            TRACER.enabled = False
+            ts = _scheduler()
+            ts.ledger_subsystem = "disruption"
+            ts.solve([_pod("sf-0", {"app": "sf"},
+                           volumes=[PVCRef(claim_name="q",
+                                           ephemeral=False)])])
+        finally:
+            TRACER.enabled = saved
+        snap = fb.LEDGER.snapshot()
+        assert snap["solves"] == 0 and snap["host_pods"] == 0
+        assert snap["classes"]["disruption/volumes"]["pods"] == 1
+
+    def test_simulation_probes_flagged_disruption(self):
+        """Provisioner.schedule_with(record=False) — the disruption sim
+        entry point — flags its scheduler; record=True (live) does not."""
+        import inspect
+
+        from karpenter_tpu.provisioning.provisioner import Provisioner
+        src = inspect.getsource(Provisioner.schedule_with)
+        assert 'ts.ledger_subsystem = "disruption"' in src
+
+    def test_snapshot_recent_zero_returns_none(self):
+        led = fb.FallbackLedger()
+        led.record_solve({"topo": 1}, 1, 1, 0.1, 0.1)
+        assert led.snapshot(recent=0)["recent"] == []
+        assert len(led.snapshot(recent=5)["recent"]) == 1

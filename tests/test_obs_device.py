@@ -1,0 +1,178 @@
+"""Device and memory truth (ISSUE 12 tentpole b): the dispatch/execute
+split with per-executable attribution, the XLA memory watermark gauges,
+and the promoted jax.profiler facility (obs/profile.py + /debug/profile +
+`python -m karpenter_tpu.obs profile`)."""
+
+import os
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.obs.device import DEVICE_TIME
+from karpenter_tpu.obs.profile import PROFILER, ProfileError, Profiler
+from karpenter_tpu.obs.tracer import TRACER
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+
+from factories import make_nodepool, make_pods
+
+
+def _solve(n=12):
+    ts = TensorScheduler([make_nodepool(name="default")],
+                         {"default": construct_instance_types()[:n]})
+    ts.solve(make_pods(8, cpu="250m"))
+    assert ts.fallback_reason == ""
+    return ts
+
+
+class TestDeviceTimeAttribution:
+    def test_solve_records_per_executable_stats(self):
+        DEVICE_TIME.clear()
+        _solve()
+        snap = DEVICE_TIME.snapshot()
+        assert snap, "no executable registered by the solve"
+        st = snap[0]
+        assert st["executable"].startswith("x")
+        assert st["kind"] == "single"
+        assert st["dispatches"] >= 1
+        assert st["dispatch_seconds"] >= 0.0
+        assert st["device_seconds"] >= 0.0
+        assert st["peak_bytes"] > 0, "memory_analysis produced no peak"
+        assert st["shapes"], "no arg-shape summary"
+
+    def test_spans_split_dispatch_from_execute(self):
+        _solve()
+        trace = TRACER.last()
+        names = [s.name for s in trace.spans]
+        assert "device.dispatch" in names
+        assert "device.execute" in names
+        dispatch = next(s for s in trace.spans
+                        if s.name == "device.dispatch")
+        execute = next(s for s in trace.spans if s.name == "device.execute")
+        # both carry the executable label and nest under precompute
+        assert dispatch.attrs["executable"] == execute.attrs["executable"]
+        assert dispatch.attrs["compile_cache"] in ("hit", "miss")
+
+    def test_memory_watermark_gauges_set(self):
+        from karpenter_tpu.metrics.registry import DEVICE_MEMORY_PEAK
+        DEVICE_TIME.clear()
+        _solve()
+        marks = DEVICE_TIME.watermarks()
+        assert marks, "no per-device watermark recorded"
+        for dev, peak in marks.items():
+            assert peak > 0
+            assert DEVICE_MEMORY_PEAK.value({"device": dev}) == float(peak)
+
+    def test_watermark_is_monotonic_max(self):
+        DEVICE_TIME.clear()
+        _solve(n=12)
+        first = dict(DEVICE_TIME.watermarks())
+        _solve(n=24)  # a bigger catalog compiles a bigger program
+        second = DEVICE_TIME.watermarks()
+        for dev in first:
+            assert second.get(dev, 0) >= first[dev]
+
+    def test_disabled_tracer_records_nothing_and_stays_async(self):
+        DEVICE_TIME.clear()
+        saved = TRACER.enabled
+        try:
+            TRACER.enabled = False
+            _solve()
+        finally:
+            TRACER.enabled = saved
+        assert DEVICE_TIME.snapshot() == []
+
+    def test_metrics_families_move(self):
+        from karpenter_tpu.metrics.registry import (DEVICE_DISPATCHES,
+                                                    DEVICE_EXECUTE_SECONDS)
+        DEVICE_TIME.clear()
+        _solve()
+        st = DEVICE_TIME.snapshot()[0]
+        labels = {"executable": st["executable"]}
+        assert DEVICE_DISPATCHES.value(labels) >= 1
+        assert DEVICE_EXECUTE_SECONDS.value(labels) >= 0.0
+
+
+class TestProfiler:
+    def test_start_without_sanctioned_dir_rejected(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_PROFILE_DIR", raising=False)
+        p = Profiler()
+        with pytest.raises(ProfileError, match="KARPENTER_PROFILE_DIR"):
+            p.start()
+
+    def test_start_stop_lifecycle(self, tmp_path):
+        from karpenter_tpu.metrics.registry import PROFILE_ACTIVE
+        p = Profiler()
+        out = p.start(str(tmp_path / "prof"))
+        try:
+            assert p.active and out == str(tmp_path / "prof")
+            assert PROFILE_ACTIVE.value() == 1.0
+            with pytest.raises(ProfileError, match="already running"):
+                p.start(str(tmp_path / "other"))
+        finally:
+            stopped = p.stop()
+        assert stopped == out and not p.active
+        assert PROFILE_ACTIVE.value() == 0.0
+        assert os.path.isdir(out)
+        with pytest.raises(ProfileError, match="no device profile"):
+            p.stop()
+
+    def test_env_dir_is_the_default(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PROFILE_DIR", str(tmp_path / "env"))
+        p = Profiler()
+        assert p.start() == str(tmp_path / "env")
+        p.stop()
+
+    def test_pass_scope_noop_while_session_active(self, tmp_path):
+        p = Profiler()
+        p.start(str(tmp_path / "ses"))
+        try:
+            # the provisioner's per-pass hook must not crash into
+            # jax.profiler's single-session assertion
+            with p.pass_scope(str(tmp_path / "pass")):
+                pass
+            assert not os.path.exists(str(tmp_path / "pass"))
+        finally:
+            p.stop()
+
+    def test_debug_profile_device_start_stop(self, tmp_path, monkeypatch):
+        from karpenter_tpu.operator.server import ServingGroup
+        monkeypatch.setenv("KARPENTER_PROFILE_DIR", str(tmp_path / "ep"))
+        group = ServingGroup(0, 0, profiling=True).start()
+        base = f"http://127.0.0.1:{group.metrics_port}/debug/profile"
+        try:
+            with urllib.request.urlopen(f"{base}?device=start",
+                                        timeout=10) as resp:
+                body = resp.read().decode()
+            assert "started" in body and str(tmp_path / "ep") in body
+            assert PROFILER.active
+            # double start: 409, not a crash
+            req = urllib.request.Request(f"{base}?device=start")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 409
+            with urllib.request.urlopen(f"{base}?device=stop",
+                                        timeout=10) as resp:
+                assert "stopped" in resp.read().decode()
+            assert not PROFILER.active
+        finally:
+            if PROFILER.active:
+                PROFILER.stop()
+            group.stop()
+
+    def test_obs_profile_cli(self, tmp_path, monkeypatch):
+        from karpenter_tpu.obs.__main__ import main as obs_main
+        from karpenter_tpu.operator.server import ServingGroup
+        monkeypatch.setenv("KARPENTER_PROFILE_DIR", str(tmp_path / "cli"))
+        group = ServingGroup(0, 0, profiling=True).start()
+        try:
+            rc = obs_main(["profile",
+                           "--url", f"http://127.0.0.1:{group.metrics_port}",
+                           "--seconds", "0.05"])
+            assert rc == 0
+            assert not PROFILER.active
+            assert os.path.isdir(str(tmp_path / "cli"))
+        finally:
+            if PROFILER.active:
+                PROFILER.stop()
+            group.stop()

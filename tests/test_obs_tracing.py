@@ -689,3 +689,70 @@ class TestDumpCLI:
         names = {e["name"] for e in doc["traceEvents"]}
         assert "provisioner.pass" in names
         assert "solve" in names
+
+
+class TestMispairedSpanRendering:
+    """ISSUE 12 satellite: spans that do NOT nest cleanly (possible after
+    a mid-span exception recovery closes out of order) must render
+    deterministically with no negative exclusive times — in both the live
+    phase_millis breakdown and `obs show`'s ts/dur reconstruction."""
+
+    def test_exclusive_micros_clips_overlap_to_parent_interval(self):
+        from karpenter_tpu.obs.__main__ import _exclusive_micros
+        # a=[0,10ms]; b=[5,15ms] OVERLAPS a (not nested); c=[12,14ms] in b
+        evs = [
+            {"name": "a", "ts": 0.0, "dur": 10_000.0, "tid": 1},
+            {"name": "b", "ts": 5_000.0, "dur": 10_000.0, "tid": 1},
+            {"name": "c", "ts": 12_000.0, "dur": 2_000.0, "tid": 1},
+        ]
+        totals = _exclusive_micros(evs)
+        assert all(v >= 0 for v in totals.values()), totals
+        # a is discounted ONLY b's overlap (5 ms), never b's full 10 ms
+        assert totals["a"] == pytest.approx(5_000.0)
+        assert totals["b"] == pytest.approx(8_000.0)  # minus c's 2 ms
+        assert totals["c"] == pytest.approx(2_000.0)
+        # deterministic: same input, same table, regardless of input order
+        assert _exclusive_micros(list(reversed(evs))) == totals
+
+    def test_exclusive_micros_child_outliving_parent(self):
+        from karpenter_tpu.obs.__main__ import _exclusive_micros
+        # child starts inside the parent and ends AFTER it, with a child
+        # duration LONGER than the parent's: the old full-duration
+        # discount drove the parent negative (silently clamped to 0)
+        evs = [
+            {"name": "p", "ts": 0.0, "dur": 9_000.0, "tid": 1},
+            {"name": "q", "ts": 8_000.0, "dur": 12_000.0, "tid": 1},
+        ]
+        totals = _exclusive_micros(evs)
+        assert totals["p"] == pytest.approx(8_000.0)  # 9 ms - 1 ms overlap
+        assert totals["q"] == pytest.approx(12_000.0)
+
+    def test_phase_millis_overlapping_child_never_negative(self):
+        from karpenter_tpu.obs.tracer import PassTrace, Span
+        root = Span("solve", 0.0, -1, 0, 1, {})
+        root.end = 0.020
+        x = Span("x", 0.001, 0, 1, 1, {})
+        x.end = 0.010
+        # y records x as its parent but OVERLAPS it (mispaired exit):
+        # y's duration (12 ms) exceeds x's (9 ms)
+        y = Span("y", 0.008, 1, 2, 1, {})
+        y.end = 0.020
+        trace = PassTrace("t1", 0.0, [root, x, y])
+        phases = phase_millis(trace)
+        assert phases["x"] == pytest.approx(7.0)   # 9 ms - 2 ms overlap
+        assert phases["y"] == pytest.approx(12.0)
+        assert all(v >= 0 for v in phases.values())
+        # rendering is deterministic
+        assert phase_millis(trace) == phases
+
+    def test_clean_nesting_unchanged(self):
+        from karpenter_tpu.obs.tracer import PassTrace, Span
+        root = Span("solve", 0.0, -1, 0, 1, {})
+        root.end = 0.010
+        a = Span("a", 0.001, 0, 1, 1, {})
+        a.end = 0.008
+        b = Span("b", 0.002, 1, 2, 1, {})
+        b.end = 0.004
+        phases = phase_millis(PassTrace("t2", 0.0, [root, a, b]))
+        assert phases["a"] == pytest.approx(5.0)
+        assert phases["b"] == pytest.approx(2.0)

@@ -1,0 +1,293 @@
+"""Cross-process trace propagation (ISSUE 12): the delta wire's trace_ctx
+joins the operator-side sidecar.rpc span, the server-side session/queue/
+solve tree, and the device spans under ONE trace_id — and retries, hedges
+and duplicate deliveries under wire chaos must never mint a second server
+span tree (the PR-11 idempotency nonce answers them from the dedupe cache
+before any span opens). Legacy wire shapes (v1 delta without trace_ctx,
+and the pre-delta no-`v` wire) are still served."""
+
+import grpc
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.obs.tracer import TRACER, Tracer
+from karpenter_tpu.sidecar import codec, wire
+from karpenter_tpu.sidecar import server as srv
+from karpenter_tpu.sidecar.client import (RemoteScheduler, RetryPolicy,
+                                          SolverSession)
+from karpenter_tpu.sidecar.wire_chaos import ChaosChannel
+from karpenter_tpu.utils.chaos import WireFaultInjector
+
+from factories import make_nodepool, make_pods
+
+pytestmark = pytest.mark.chaos
+
+
+def _fast_policy(**over):
+    kw = dict(deadline=10.0, max_attempts=5, backoff_base=0.002,
+              backoff_cap=0.01, retry_budget=32.0, refund=1.0,
+              sleep=lambda _s: None)
+    kw.update(over)
+    return RetryPolicy(**kw)
+
+
+def _pair(addr, its, pool, tenant="", injector=None, **kw):
+    channel = None
+    if injector is not None:
+        channel = ChaosChannel(
+            grpc.insecure_channel(addr, options=srv.GRPC_OPTIONS), injector)
+    kw.setdefault("retry", _fast_policy())
+    session = SolverSession(addr, channel=channel, tenant=tenant, **kw)
+    rs = RemoteScheduler(addr, [pool], {"default": its}, session=session)
+    return rs, session
+
+
+@pytest.fixture()
+def sidecar():
+    server, port = srv.serve(port=0)
+    TRACER.clear()
+    yield f"127.0.0.1:{port}", server
+    server.stop(grace=None)
+
+
+def _server_trees(trace_id):
+    """Server span trees in the (shared in-process) ring for a trace_id:
+    the traces rooted at sidecar.solve — the client's tree roots at
+    sidecar.rpc, so the two sides of one trace_id stay countable."""
+    return [t for t in TRACER.traces()
+            if t.trace_id == trace_id and t.root.name == "sidecar.solve"]
+
+
+def _client_trees(trace_id):
+    return [t for t in TRACER.traces()
+            if t.trace_id == trace_id and any(
+                s.name == "sidecar.rpc" for s in t.spans)]
+
+
+class TestTracerAdoption:
+    def test_adopted_root_joins_remote_trace(self):
+        tr = Tracer()
+        tr.adopt("t-remote-1", "sidecar.rpc#0")
+        with tr.span("sidecar.solve"):
+            assert tr.current_trace_id() == "t-remote-1"
+        t = tr.last()
+        assert t.trace_id == "t-remote-1"
+        assert t.root.attrs["remote_parent"] == "sidecar.rpc#0"
+        # adoption is one-shot: the next root minted locally again
+        with tr.span("solve"):
+            assert tr.current_trace_id().startswith("t0")
+
+    def test_adopt_is_noop_while_a_trace_is_active(self):
+        tr = Tracer()
+        with tr.span("solve"):
+            tr.adopt("t-remote-2")
+            with tr.span("inner"):
+                pass
+            assert tr.current_trace_id() != "t-remote-2"
+        # and the pending-adoption slot stayed clean
+        with tr.span("solve"):
+            assert tr.current_trace_id() != "t-remote-2"
+
+    def test_adopt_while_disabled_never_leaks(self):
+        tr = Tracer(enabled=False)
+        tr.adopt("t-remote-3")
+        tr.enabled = True
+        with tr.span("solve"):
+            assert tr.current_trace_id() != "t-remote-3"
+
+    def test_current_ctx_names_the_active_span(self):
+        tr = Tracer()
+        assert tr.current_ctx() is None
+        with tr.span("provisioner.pass"):
+            with tr.span("sidecar.rpc"):
+                ctx = tr.current_ctx()
+        assert ctx["id"].startswith("t")
+        assert ctx["span"] == "sidecar.rpc#1"
+        tr.enabled = False
+        assert tr.current_ctx() is None
+
+
+class TestCleanJoin:
+    def test_one_trace_id_joins_client_server_device(self, sidecar):
+        addr, _ = sidecar
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"))
+        r = rs.solve(make_pods(5, cpu="500m"))
+        tid = r.trace_id
+        assert tid, "no trace_id rider on the v2 wire"
+        assert len(_client_trees(tid)) == 1
+        trees = _server_trees(tid)
+        assert len(trees) == 1, [t.summary() for t in TRACER.traces()]
+        names = {s.name for s in trees[0].spans}
+        # queue-wait is a real span, the solve nests inside the session
+        # tree, and the device truth rides the same trace
+        assert {"sidecar.queue", "sidecar.apply", "solve",
+                "device.dispatch", "device.execute"} <= names, names
+        # the remote parent names the client's rpc span
+        assert trees[0].root.attrs["remote_parent"].startswith("sidecar.rpc")
+
+    def test_fresh_solves_get_fresh_trace_ids(self, sidecar):
+        addr, _ = sidecar
+        rs, _ = _pair(addr, construct_instance_types()[:12],
+                      make_nodepool(name="default"))
+        pods = make_pods(4, cpu="250m")
+        t1 = rs.solve(pods).trace_id
+        t2 = rs.solve(pods).trace_id
+        assert t1 and t2 and t1 != t2
+        assert len(_server_trees(t1)) == 1
+        assert len(_server_trees(t2)) == 1
+
+
+class TestChaosSingleServerTree:
+    def test_duplicate_delivery_yields_one_server_tree(self, sidecar):
+        addr, _ = sidecar
+        inj = WireFaultInjector(seed=5)
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"), injector=inj)
+        pods = make_pods(5, cpu="500m")
+        rs.solve(pods)  # bootstrap
+        inj.inject_next("duplicate")
+        r = rs.solve(pods)
+        assert r.trace_id
+        assert len(_server_trees(r.trace_id)) == 1
+        assert session.resyncs == 0
+
+    def test_retry_after_drop_yields_one_server_tree(self, sidecar):
+        # drop: the request never arrives; the retry (identical bytes,
+        # same nonce + trace_ctx) is the one real apply
+        addr, _ = sidecar
+        inj = WireFaultInjector(seed=6)
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"), injector=inj)
+        pods = make_pods(5, cpu="500m")
+        rs.solve(pods)
+        inj.inject_next("drop")
+        r = rs.solve(pods)
+        assert r.retries >= 1
+        assert r.trace_id and len(_server_trees(r.trace_id)) == 1
+
+    def test_retry_after_lost_response_yields_one_server_tree(self, sidecar):
+        # disconnect: applied but the response is lost — the retry is
+        # answered from the nonce dedupe cache BEFORE any span opens, so
+        # the first apply's tree stays the only one
+        addr, _ = sidecar
+        inj = WireFaultInjector(seed=7)
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"), injector=inj)
+        pods = make_pods(5, cpu="500m")
+        rs.solve(pods)
+        inj.inject_next("disconnect")
+        r = rs.solve(pods)
+        assert r.retries >= 1
+        assert r.trace_id and len(_server_trees(r.trace_id)) == 1
+
+    def test_hedge_race_yields_one_server_tree(self, sidecar):
+        # a delayed primary triggers the hedge; both deliveries reach the
+        # server, exactly one solves — the other is a dedupe hit
+        addr, _ = sidecar
+        inj = WireFaultInjector(seed=8, delay_seconds=0.2)
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"), injector=inj,
+                            retry=_fast_policy(hedge_delay=0.02))
+        pods = make_pods(5, cpu="500m")
+        rs.solve(pods)
+        inj.inject_next("delay")
+        r = rs.solve(pods)
+        assert r.trace_id
+        assert len(_server_trees(r.trace_id)) == 1
+
+    def test_seeded_chaos_soak_every_solve_single_tree(self, sidecar):
+        addr, _ = sidecar
+        inj = WireFaultInjector(seed=12, drop=0.15, duplicate=0.15,
+                                disconnect=0.15)
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"), injector=inj)
+        pods = make_pods(6, cpu="250m")
+        tids = []
+        for _ in range(8):
+            r = rs.solve(pods)
+            assert r.trace_id
+            tids.append(r.trace_id)
+        assert len(set(tids)) == len(tids)
+        for tid in tids:
+            assert len(_server_trees(tid)) == 1, tid
+
+
+class TestLegacyWire:
+    def test_v1_delta_without_trace_ctx_still_served(self, sidecar):
+        """An older client speaking schema v1 (no trace_ctx field): the
+        server serves it and roots its OWN local trace instead."""
+        addr, _ = sidecar
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"))
+        orig = session._call_resilient
+
+        def downgrade(method, payload):
+            if method == "SolveSession":
+                header, blobs = wire.unpack(payload)
+                header.pop("trace_ctx", None)
+                header["v"] = 1
+                payload = wire.pack(header,
+                                    {k: bytes(v) for k, v in blobs.items()})
+            return orig(method, payload)
+
+        session._call_resilient = downgrade
+        r = rs.solve(make_pods(4, cpu="250m"))
+        assert not r.pod_errors
+        tid = r.trace_id
+        assert tid, "server should still trace v1 solves (locally rooted)"
+        trees = _server_trees(tid)
+        assert len(trees) == 1
+        # locally rooted: no remote parent, and no client tree shares it
+        assert "remote_parent" not in trees[0].root.attrs
+        assert _client_trees(tid) == []
+
+    def test_unknown_future_version_still_rejected_loudly(self, sidecar):
+        addr, _ = sidecar
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"))
+        orig = session._call_resilient
+
+        def futurize(method, payload):
+            if method == "SolveSession":
+                header, blobs = wire.unpack(payload)
+                header["v"] = 99
+                payload = wire.pack(header,
+                                    {k: bytes(v) for k, v in blobs.items()})
+            return orig(method, payload)
+
+        session._call_resilient = futurize
+        with pytest.raises(grpc.RpcError) as ei:
+            rs.solve(make_pods(3, cpu="250m"))
+        assert ei.value.code() in (grpc.StatusCode.INVALID_ARGUMENT,
+                                   grpc.StatusCode.FAILED_PRECONDITION)
+
+    def test_accepted_versions(self):
+        codec.check_delta_version({"v": 1})
+        codec.check_delta_version({"v": 2})
+        with pytest.raises(codec.DeltaVersionError):
+            codec.check_delta_version({"v": 3})
+        with pytest.raises(codec.DeltaVersionError):
+            codec.check_delta_version({})
+
+
+class TestSubsystemRider:
+    """The fallback-ledger subsystem flag crosses the wire: a disruption
+    candidate probe served by the sidecar must not move the SERVER
+    process's headline provisioning totals (the in-process
+    ledger_subsystem contract, carried as a v2 header rider)."""
+
+    def test_disruption_probe_rides_the_wire(self, sidecar):
+        from karpenter_tpu.obs import fallbacks as fb
+        addr, _ = sidecar
+        rs, session = _pair(addr, construct_instance_types()[:12],
+                            make_nodepool(name="default"))
+        pods = make_pods(3, cpu="250m")
+        fb.LEDGER.reset()
+        rs.solve(pods)  # control: a live solve moves the headline totals
+        assert fb.LEDGER.snapshot()["solves"] == 1
+        rs.ledger_subsystem = "disruption"
+        rs.solve(pods)
+        snap = fb.LEDGER.snapshot()
+        assert snap["solves"] == 1, (
+            "a wire-flagged disruption probe moved the provisioning totals")

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Sim digest regression gate: byte-exact perf-behavior pinning for CI.
+
+Replays a clipped library scenario (mixed-day, first CLIP_SECONDS of
+simulated time) through the FULL operator loop and compares two things
+against a pinned golden (tests/goldens/sim-regression.json):
+
+- the deterministic event-ledger DIGEST — same seed + scenario + code
+  must produce a byte-identical ledger (the PR-9 determinism contract),
+  so ANY behavior change in the solver, the disruption engine, the wire,
+  or the chaos actuators flips this hash. This is the perf-behavior pin
+  the ROADMAP asked for where wall-clock asserts flake: a 2-core CI box
+  can't slow a digest down.
+- the SLO-report SHAPE — the dotted key paths and value types of the
+  report dict, so a section silently vanishing (or a type drifting from
+  number to string) fails loudly even though values are run-volatile.
+
+On mismatch the gate exits 1 and prints the one command that refreshes
+the pin — a deliberate behavior change regenerates, an accidental one
+gets reviewed:
+
+    python tools/sim_regression.py --update
+
+Run the gate itself with no arguments (exit 0 = green). Tier-1 wraps this
+module in tests/test_sim_regression.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from anywhere, venv or not
+    sys.path.insert(0, REPO)
+GOLDEN_PATH = os.path.join(REPO, "tests", "goldens", "sim-regression.json")
+SCENARIO = "mixed-day.yaml"
+CLIP_SECONDS = 7200.0
+
+# report sections whose KEYS are data (shape classes seen, event kinds
+# applied, ...): compared as opaque "dict" leaves, not recursed — their
+# contents are pinned by the ledger digest where deterministic
+_OPAQUE = {"events_applied", "fallbacks.classes", "attribution", "final"}
+
+
+def report_shape(obj, prefix: str = "") -> list:
+    """Sorted dotted key paths with value-type names — the report's
+    structural fingerprint, value-free."""
+    out = []
+    if isinstance(obj, dict):
+        if prefix.rstrip(".") in _OPAQUE:
+            return [f"{prefix.rstrip('.')}:dict"]
+        for k in sorted(obj):
+            out.extend(report_shape(obj[k], f"{prefix}{k}."))
+        return out
+    path = prefix.rstrip(".")
+    if isinstance(obj, list):
+        return [f"{path}:list"]
+    if isinstance(obj, bool):
+        return [f"{path}:bool"]
+    if isinstance(obj, (int, float)):
+        return [f"{path}:number"]
+    if obj is None:
+        return [f"{path}:null"]
+    return [f"{path}:str"]
+
+
+def run_clipped(clip_seconds: float = CLIP_SECONDS) -> dict:
+    """One clipped deterministic run of the library scenario; returns the
+    report dict (ledger digest included)."""
+    import karpenter_tpu.sim as sim_pkg
+    from karpenter_tpu.sim import FleetSimulator, load_scenario
+    sc = load_scenario(os.path.join(os.path.dirname(sim_pkg.__file__),
+                                    "scenarios", SCENARIO))
+    clip = min(clip_seconds, sc.duration)
+    sc.events = [e for e in sc.events if e.at <= clip]
+    sc.duration = clip
+    return FleetSimulator(sc).run()
+
+
+def current_pin(clip_seconds: float = CLIP_SECONDS) -> dict:
+    report = run_clipped(clip_seconds)
+    return {
+        "scenario": SCENARIO,
+        "clip_seconds": clip_seconds,
+        "ledger_digest": report["ledger_digest"],
+        "ledger_entries": report["ledger_entries"],
+        "report_shape": report_shape(report),
+    }
+
+
+def compare(pin: dict, golden: dict) -> list:
+    """Human-readable mismatch lines ([] = green)."""
+    problems = []
+    if pin["ledger_digest"] != golden["ledger_digest"]:
+        problems.append(
+            f"ledger digest changed:\n  pinned  {golden['ledger_digest']}"
+            f"\n  current {pin['ledger_digest']}\n  (entries: pinned "
+            f"{golden['ledger_entries']}, current {pin['ledger_entries']})")
+    missing = sorted(set(golden["report_shape"]) - set(pin["report_shape"]))
+    added = sorted(set(pin["report_shape"]) - set(golden["report_shape"]))
+    if missing:
+        problems.append("report keys GONE vs golden: " + ", ".join(missing))
+    if added:
+        problems.append("report keys NEW vs golden: " + ", ".join(added))
+    return problems
+
+
+def main(argv=None, pin: dict = None) -> int:
+    """CLI gate; `pin` injects a precomputed current_pin() (the tier-1
+    wrapper computes the ~2s clipped replay once and reuses it across its
+    tests instead of re-running per invocation)."""
+    parser = argparse.ArgumentParser(
+        prog="python tools/sim_regression.py",
+        description="sim ledger-digest + report-shape regression gate")
+    parser.add_argument("--update", action="store_true",
+                        help="regenerate the golden pin from this tree")
+    parser.add_argument("--golden", default=GOLDEN_PATH,
+                        help=f"golden file (default {GOLDEN_PATH})")
+    args = parser.parse_args(argv)
+    if pin is None:
+        pin = current_pin()
+    if args.update:
+        os.makedirs(os.path.dirname(args.golden), exist_ok=True)
+        with open(args.golden, "w") as f:
+            json.dump(pin, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"golden updated: {args.golden}\n"
+              f"  ledger_digest {pin['ledger_digest'][:16]}… "
+              f"({pin['ledger_entries']} entries, "
+              f"{len(pin['report_shape'])} report keys)")
+        return 0
+    if not os.path.exists(args.golden):
+        print(f"sim regression gate: no golden at {args.golden}\n"
+              "  generate one: python tools/sim_regression.py --update",
+              file=sys.stderr)
+        return 2
+    with open(args.golden) as f:
+        golden = json.load(f)
+    problems = compare(pin, golden)
+    if problems:
+        print("sim regression gate FAILED — the clipped "
+              f"{golden['scenario']} replay diverged from the pin:\n"
+              + "\n".join(f"- {p}" for p in problems)
+              + "\n\nIf this behavior change is intentional, refresh the "
+                "pin and commit it:\n    python tools/sim_regression.py "
+                "--update", file=sys.stderr)
+        return 1
+    print(f"sim regression gate green: digest "
+          f"{pin['ledger_digest'][:16]}… matches the pin")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
