@@ -93,6 +93,15 @@ MESHSCALE_PODS = int(os.environ.get("BENCH_MESHSCALE_PODS", "1000000"))
 MESHSCALE_DEPLOYS = int(os.environ.get("BENCH_MESHSCALE_DEPLOYS", "4000"))
 MESHSCALE_ITS = int(os.environ.get("BENCH_MESHSCALE_ITS", "4000"))
 MESHSCALE_SHARDS = int(os.environ.get("BENCH_MESHSCALE_SHARDS", "4"))
+# BENCH_MODE=disruption-scale knobs (ISSUE 14): fleet size for the
+# streaming disruption pass, pending-pod batch for the provisioning-pass
+# denominator, and the warm-pass/provisioning-pass ratio ceiling ("same
+# order as a provisioning pass"). Tier-1 clips via BENCH_DISRUPTION_NODES
+# (TestDisruptionScaleBudget).
+DISRUPTION_NODES = int(os.environ.get("BENCH_DISRUPTION_NODES", "50000"))
+DISRUPTION_PENDING = int(os.environ.get("BENCH_DISRUPTION_PENDING", "2000"))
+DISRUPTION_WARM_RATIO = float(os.environ.get("BENCH_DISRUPTION_WARM_RATIO",
+                                             "10"))
 # soft wall-clock budget for the default multi-line run: once exceeded,
 # remaining AUXILIARY benches are skipped so the headline line (emitted
 # last) always lands before any driver-side timeout
@@ -1390,6 +1399,210 @@ def bench_single_consolidation():
     }), flush=True)
 
 
+def bench_disruption_scale():
+    """ISSUE 14 acceptance line (BENCH_MODE=disruption-scale): a FULL
+    disruption pass (all four methods through DisruptionController) over a
+    DISRUPTION_NODES-node fleet in the reference's worst-case shape —
+    every candidate but the last provably unconsolidatable. The COLD pass
+    pays the snapshot build, 50k candidate rows, and the device encodes;
+    WARM passes are served from the StreamingDisruptionState (every layer
+    reused, zero rows rebuilt, encodings kept) and must land in the same
+    order as a provisioning pass over the same fleet (ratio asserted).
+    Decisions are asserted byte-identical to a cold rebuild: a FRESH
+    controller (fresh stream, cold snapshot) must produce the same
+    command."""
+    from karpenter_tpu.api import labels as api_labels
+    from karpenter_tpu.api.nodeclaim import (COND_CONSOLIDATABLE,
+                                             COND_INITIALIZED, COND_LAUNCHED,
+                                             COND_REGISTERED, NodeClaim,
+                                             NodeClaimSpec)
+    from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                           ObjectMeta, PodSpec)
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.cloudprovider.types import Offerings
+    from karpenter_tpu.disruption.controller import (DisruptionController,
+                                                     OrchestrationQueue)
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.provisioning.provisioner import Provisioner
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    N = DISRUPTION_NODES
+    # on-demand-only catalog: spot pricing would hand every stuck candidate
+    # a cheaper replacement and short-circuit the scan at candidate #1
+    catalog = _catalog()
+    for it in catalog:
+        it.offerings = Offerings(
+            [o for o in it.offerings
+             if o.capacity_type == api_labels.CAPACITY_TYPE_ON_DEMAND])
+
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    provider = KwokCloudProvider(instance_types=catalog, store=store)
+    provisioner = Provisioner(store, cluster, provider, clock)
+    store.create(NodePool(metadata=ObjectMeta(name="default"),
+                          spec=NodePoolSpec(template=NodeClaimTemplate(
+                              spec=NodeClaimTemplateSpec()))))
+
+    def od_price(it):
+        offs = [o.price for o in it.offerings if o.available]
+        return min(offs) if offs else float("inf")
+
+    ref = next(it for it in catalog
+               if it.capacity.get("cpu") == 4000 and "amd64-linux" in it.name)
+    stuck_req = ref.allocatable()["cpu"] - 300
+    fits = [it for it in catalog if it.allocatable().get("cpu", 0) >= stuck_req]
+    big = min(fits, key=od_price)
+    assert big.allocatable()["cpu"] - stuck_req < stuck_req
+    small = min((it for it in catalog if it.capacity.get("cpu") == 1000),
+                key=od_price)
+
+    def fab_node(i, it):
+        name = f"dscale-node-{i:06d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: it.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: "test-zone-a",
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"dscale-nc-{i:06d}",
+                                           namespace="", labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"dscale://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED,
+                     COND_CONSOLIDATABLE):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"dscale://{i}"),
+            status=NodeStatus(capacity=dict(it.capacity),
+                              allocatable=it.allocatable())))
+
+    def fab_pods(i, cpu_milli_pods):
+        for j, cpu in enumerate(cpu_milli_pods):
+            store.create(Pod(
+                metadata=ObjectMeta(name=f"dscale-pod-{i}-{j}",
+                                    namespace="default"),
+                spec=PodSpec(node_name=f"dscale-node-{i:06d}"),
+                container_requests=[{"cpu": cpu, "memory": 128 * 1000}]))
+
+    # ALL nodes first, THEN pods: node creation hydrates usage by scanning
+    # the pod store (cluster._populate_resource_requests), so interleaving
+    # them is O(N^2) pod scans at fleet scale — pods bound after their
+    # node exists take the O(1) informer binding path instead
+    for i in range(N - 1):
+        fab_node(i, big)
+    fab_node(N - 1, small)
+    for i in range(N - 1):
+        fab_pods(i, [stuck_req])
+    # the one winner: two small pods, last in the fair order
+    fab_pods(N - 1, [200, 200])
+
+    # -- provisioning-pass denominator: a cold solve of a pending batch
+    # against the SAME fleet (the "same order as a provisioning pass" bar)
+    pending = [Pod(metadata=ObjectMeta(name=f"dscale-pend-{i}",
+                                       namespace="default"),
+                   spec=PodSpec(),
+                   container_requests=[{"cpu": 100 + (i % 4) * 50,
+                                        "memory": 128 * 1000}])
+               for i in range(DISRUPTION_PENDING)]
+    state_nodes = [sn for sn in cluster.state_nodes(deep_copy=False)
+                   if not sn.deleting()]
+    t0 = time.perf_counter()
+    prov_results = provisioner.schedule_with(pending, state_nodes)
+    prov_s = time.perf_counter() - t0
+    assert not prov_results.pod_errors
+
+    queue = OrchestrationQueue(store, cluster, clock)
+    controller = DisruptionController(store, cluster, provisioner, queue,
+                                      clock)
+
+    def one_pass(ctrl):
+        ctrl.pending = None  # fresh decision per repeat (skip the TTL wait)
+        for m in ctrl.methods:
+            if hasattr(m, "_last_state"):
+                m._last_state = None
+        t0 = time.perf_counter()
+        ctrl.reconcile()
+        return time.perf_counter() - t0
+
+    def command_of(ctrl):
+        assert ctrl.pending is not None, "pass made no decision"
+        cmd = ctrl.pending[0]
+        return (cmd.decision, sorted(c.name for c in cmd.candidates),
+                [[it.name for it in r.instance_type_options]
+                 for r in cmd.replacements])
+
+    cold_s = one_pass(controller)
+    cold_build_s = controller.stream.last["seconds"]
+    decision = command_of(controller)
+    assert decision[0] == "delete" and \
+        decision[1] == [f"dscale-node-{N-1:06d}"], decision
+    single = controller.methods[-1]
+    stats = single.last_engine_stats
+    assert stats is not None and stats["needs_sim"] == 0, stats
+    assert stats["probes"] == 1, stats  # only the winner materializes
+    multi_stats = controller.methods[-2].last_multi_engine_stats
+    assert multi_stats is not None and multi_stats["probes_saved"] > 0, \
+        multi_stats  # the ranked subset search skipped rejected midpoints
+
+    warm_s = float("inf")
+    warm_build_s = float("inf")
+    for _ in range(max(1, REPEATS - 1)):
+        s = one_pass(controller)
+        # warm-vs-cold delta residency: every layer reused, zero rows
+        # rebuilt, encodings kept
+        last = controller.stream.last
+        assert last["layers"] == {
+            "pods": "reused", "context": "reused", "scheduler": "reused",
+            "encodings": "reused"}, last
+        assert last["rows_rebuilt"] == 0 and \
+            last["rows_reused"] == len(cluster.nodes), last
+        assert command_of(controller) == decision
+        warm_s = min(warm_s, s)
+        warm_build_s = min(warm_build_s, last["seconds"])
+
+    # byte-identity vs a COLD snapshot rebuild: a fresh controller (fresh
+    # stream, nothing cached) must produce the same command
+    fresh = DisruptionController(store, cluster, provisioner,
+                                 OrchestrationQueue(store, cluster, clock),
+                                 clock)
+    one_pass(fresh)
+    assert command_of(fresh) == decision, (command_of(fresh), decision)
+
+    ratio = warm_s / prov_s
+    assert ratio <= DISRUPTION_WARM_RATIO, (
+        f"warm disruption pass {warm_s:.3f}s is {ratio:.1f}x the "
+        f"provisioning pass {prov_s:.3f}s (budget {DISRUPTION_WARM_RATIO}x)")
+    print(json.dumps({
+        "metric": (f"streaming disruption pass, {N}-node fleet x "
+                   f"{len(catalog)} instance types (full 4-method pass, "
+                   "worst case: one win at the end of the fair order)"),
+        "value": round(warm_s, 3),
+        "unit": "seconds",
+        "nodes": N,
+        "cold_pass_s": round(cold_s, 3),
+        "warm_pass_s": round(warm_s, 3),
+        "cold_candidate_build_s": round(cold_build_s, 3),
+        "warm_candidate_build_s": round(warm_build_s, 3),
+        "provisioning_pass_s": round(prov_s, 3),
+        "warm_vs_provisioning": round(ratio, 2),
+        "warm_vs_cold": round(warm_s / cold_s, 3) if cold_s else None,
+        "loo_probes": stats["probes"],
+        "multi_probes_saved": multi_stats["probes_saved"],
+        "decision": decision[0],
+    }), flush=True)
+
+
 def bench_spot_repack():
     """BASELINE config #5: spot repack — catalog x 6 zones with a shifted
     price vector; the consolidation search must find the cost-optimal
@@ -2437,6 +2650,9 @@ def main():
     if MODE == "single":
         bench_single_consolidation()
         return
+    if MODE == "disruption-scale":
+        bench_disruption_scale()
+        return
     if MODE == "spot":
         bench_spot_repack()
         return
@@ -2488,9 +2704,10 @@ def main():
     if MODE not in ("all", "provisioning"):
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
-            "all|provisioning|consolidation|single|spot|mesh|mesh-local|"
-            "mesh-headroom|meshscale|sidecar|service|svc-faults|minvalues|"
-            "faults|replay|drought|churn|trace|fallbacks|sim")
+            "all|provisioning|consolidation|single|disruption-scale|spot|"
+            "mesh|mesh-local|mesh-headroom|meshscale|sidecar|service|"
+            "svc-faults|minvalues|faults|replay|drought|churn|trace|"
+            "fallbacks|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

@@ -274,3 +274,236 @@ class LeaveOneOutEngine:
         if not bool((it_set & (worst < price)).any()):
             return LooVerdict(REJECT, "Can't replace with a cheaper node")
         return LooVerdict(WIN)
+
+
+class MultiNodeLooEngine:
+    """Ranked multi-node subset search: closed-form verdicts for the
+    prefix subsets the multi-node binary search probes (ISSUE 14).
+
+    The reference's multi-node consolidation binary-searches the largest
+    cost-ordered candidate PREFIX replaceable by at most one cheaper node
+    (multinodeconsolidation.go:110-162), paying a full host replay per
+    midpoint. This engine scores every prefix length over the SAME shared
+    snapshot tensors the single-node LeaveOneOutEngine reads:
+
+    - prefixes whose pods all land in ONE simple group generalize the
+      single-node closed form exactly (multiple excluded exist columns,
+      summed demand, summed candidate price, the same uninitialized-node
+      threshold / claims-count / price-filter math);
+    - multi-group prefixes get SOUND rejection bounds only: a group whose
+      solo demand provably reaches an uninitialized managed node (any
+      contention only brings that node closer), and a resource-volume
+      lower bound proving >= 2 fresh claims (any node's usable capacity
+      is bounded by the catalog's per-resource max);
+    - everything else is NEEDS_SIM: the midpoint replays exactly as the
+      reference search would.
+
+    Exactness contract (the single-node contract, verbatim): a REJECT is
+    only ever returned when the replay's decide() would provably return an
+    empty command, so the binary search can skip that midpoint's replay
+    without changing ITS decision; a WIN is never trusted — the search
+    replays it to derive the actual command. The multi-node parity fuzzer
+    (tests/test_single_consolidation_fuzzer.py) pins decision equality
+    against the engine-off binary search seed by seed.
+    """
+
+    def __init__(self, snapshot: DisruptionSnapshot,
+                 candidates: Sequence[Candidate],
+                 spot_to_spot_enabled: bool = False):
+        self.snapshot = snapshot
+        self.enc = snapshot.encoding_for(candidates)  # may raise
+        self.candidates = list(candidates)
+        self.spot_to_spot_enabled = spot_to_spot_enabled
+        self.stats = {"classified": 0, "needs_sim": 0, "probes_saved": 0}
+        self._worst_memo: Dict[tuple, np.ndarray] = {}
+        self._reqs_memo: Dict[tuple, object] = {}
+        self._verdicts: Dict[int, LooVerdict] = {}
+        from ..obs.tracer import TRACER
+        with TRACER.span("disruption.mnloo", candidates=len(self.candidates)):
+            self._prepare()
+
+    # the single-node engine's replacement-pricing memos, shared verbatim
+    _combined_reqs = LeaveOneOutEngine._combined_reqs
+    _worst_prices = LeaveOneOutEngine._worst_prices
+
+    def _prepare(self) -> None:
+        enc = self.enc
+        snap = self.snapshot
+        self._global_sim = None
+        if snap.base_pods:
+            self._global_sim = "base_pods"
+        elif enc.problem.min_its is not None:
+            self._global_sim = "minvalues"
+        elif any(np_.spec.limits for np_ in snap.ts.nodepools):
+            self._global_sim = "limits"
+        state_nodes = snap.ts.state_nodes
+        N = len(state_nodes)
+        if N == 0:
+            self._global_sim = self._global_sim or "other"
+        if self._global_sim is not None:
+            return
+        self._order = np.array(exist_fill_order(state_nodes), dtype=np.int64)
+        pos_of = np.empty(N, dtype=np.int64)
+        pos_of[self._order] = np.arange(N)
+        self._pos_of = pos_of
+        self._err = np.array([sn.managed() and not sn.initialized()
+                              for sn in state_nodes], dtype=bool)
+        self._simple = [not g.topo and not g.host_ports
+                        and not (g.pods and g.pods[0].spec.volumes)
+                        for g in enc.groups]
+        self._views: Dict[int, _GroupView] = {}
+        # per-candidate (group->count, node index); the first candidate the
+        # tensors can't express makes every prefix containing it NEEDS_SIM
+        self._cand: List[Optional[tuple]] = []
+        for i, c in enumerate(self.candidates):
+            counts: Dict[int, int] = {}
+            bad = False
+            for uid in enc.pod_uids_by_candidate[i]:
+                gi = enc.uid_group.get(uid)
+                if gi is None:
+                    bad = True
+                    break
+                counts[gi] = counts.get(gi, 0) + 1
+            n_idx = enc.node_index.get(c.state_node.name())
+            if bad or n_idx is None or bool(self._err[n_idx]) \
+                    or any(not self._simple[g] for g in counts):
+                self._cand.append(None)
+            else:
+                self._cand.append((counts, n_idx))
+
+    def _view(self, g: int) -> _GroupView:
+        v = self._views.get(g)
+        if v is None:
+            v = _GroupView(self.enc, g, self._order, self._pos_of, self._err)
+            self._views[g] = v
+        return v
+
+    def verdict(self, n: int) -> LooVerdict:
+        """Closed-form verdict for the prefix candidates[:n]."""
+        v = self._verdicts.get(n)
+        if v is None:
+            v = self._verdict(n)
+            self._verdicts[n] = v
+            self.stats["classified" if v.kind != NEEDS_SIM
+                       else "needs_sim"] += 1
+            from ..metrics import registry as metrics
+            metrics.DISRUPTION_SUBSET_VERDICTS.inc({"kind": v.kind})
+            if v.kind == REJECT:
+                self.stats["probes_saved"] += 1
+        return v
+
+    def _verdict(self, n: int) -> LooVerdict:
+        if self._global_sim is not None:
+            return LooVerdict(NEEDS_SIM)
+        prefix = self._cand[:n]
+        if any(c is None for c in prefix):
+            return LooVerdict(NEEDS_SIM)
+        # per-group aggregates over the prefix: demand, removed capacity,
+        # capacity removed before each group's first uninitialized position
+        k: Dict[int, int] = {}
+        removed: Dict[int, int] = {}
+        removed_pre_err: Dict[int, int] = {}
+        groups = set()
+        for counts, _ in prefix:
+            groups.update(counts)
+        for g in groups:
+            view = self._view(g)
+            kg = rg = rpe = 0
+            e0 = int(view.err_pos[0]) if view.err_pos.size else -1
+            for counts, n_idx in prefix:
+                kg += counts.get(g, 0)
+                cap = int(view.cap[n_idx])
+                rg += cap
+                if e0 >= 0 and int(view.pos_of[n_idx]) < e0:
+                    rpe += cap
+            k[g], removed[g], removed_pre_err[g] = kg, rg, rpe
+
+        # sound uninit rejection per group: contention from other groups
+        # only brings the first error node closer (see class docstring)
+        for g in groups:
+            view = self._view(g)
+            if view.err_pos.size:
+                thr = float(view.cum[view.err_pos[0]]) - removed_pre_err[g]
+                if k[g] > thr:
+                    return LooVerdict(REJECT, (
+                        "not all pods would schedule, would schedule "
+                        "against an uninitialized node"))
+
+        overflow = {g: k[g] - (self._view(g).total - removed[g])
+                    for g in groups}
+        overflow = {g: r for g, r in overflow.items() if r > 0}
+        if not overflow:
+            if len(groups) == 1:
+                return LooVerdict(WIN)  # exact: delete, zero new nodes
+            # multi-group: solo totals are optimistic — contention could
+            # still overflow, so a delete is plausible but not proven
+            return LooVerdict(NEEDS_SIM)
+
+        if len(groups) > 1:
+            return self._multi_group_claims_bound(overflow)
+        (g,) = groups
+        return self._single_group_replacement(n, g, overflow[g])
+
+    def _multi_group_claims_bound(self, overflow: Dict[int, int]
+                                  ) -> LooVerdict:
+        """Resource-volume lower bound on fresh claims: every node's
+        usable capacity per resource is bounded by the catalog max, so
+        ceil(total overflow volume / max node) >= 2 proves the replay
+        would create >= 2 claims — decide() rejects those."""
+        t = self.enc.tensors
+        p = self.enc.problem
+        need = np.zeros(p.group_req.shape[1], dtype=np.float64)
+        for g, r in overflow.items():
+            need += r * p.group_req[g].astype(np.float64)
+        max_alloc = p.it_alloc.max(axis=0).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_res = np.where(max_alloc > 0, need / max_alloc,
+                               np.where(need > 0, np.inf, 0.0))
+        claims_lb = int(np.ceil(per_res.max())) if per_res.size else 0
+        if claims_lb >= 2:
+            return LooVerdict(REJECT, (
+                f"Can't remove without creating {claims_lb} candidates"))
+        return LooVerdict(NEEDS_SIM)
+
+    def _single_group_replacement(self, n: int, g: int, r: int) -> LooVerdict:
+        """The single-node replacement classification with summed demand
+        and summed candidate price (consolidation.go:176-302 closed form,
+        multi-candidate decide() semantics: no spot-to-spot >= 15 floor
+        for len(candidates) > 1)."""
+        t = self.enc.tensors
+        m0 = next((m for m in range(len(self.enc.templates))
+                   if t.it_ok[g, m].any()), None)
+        if m0 is None:
+            return LooVerdict(REJECT, (
+                "not all pods would schedule, no instance type satisfied "
+                "the pod"))
+        per = int(t.ppn[g, m0][t.it_ok[g, m0]].max())
+        claims = -(-r // per)
+        if claims != 1:
+            return LooVerdict(REJECT, (
+                f"Can't remove without creating {claims} candidates"))
+        prefix = self.candidates[:n]
+        price = 0.0
+        for c in prefix:
+            p_ = c.price()
+            if p_ is None:
+                return LooVerdict(REJECT)
+            price += p_
+        it_set = t.it_ok[g, m0] & (t.ppn[g, m0] >= r)
+        base_reqs = self._combined_reqs(g, m0, False)
+        ct_req = base_reqs.get(api_labels.CAPACITY_TYPE_LABEL_KEY)
+        all_spot = all(c.capacity_type == api_labels.CAPACITY_TYPE_SPOT
+                       for c in prefix)
+        if all_spot and ct_req.has(api_labels.CAPACITY_TYPE_SPOT):
+            if not self.spot_to_spot_enabled:
+                return LooVerdict(REJECT, (
+                    "SpotToSpotConsolidation is disabled, can't replace a "
+                    "spot node with a spot node"))
+            worst = self._worst_prices(g, m0, True)
+            if not bool((it_set & (worst < price)).any()):
+                return LooVerdict(REJECT, "Can't replace with a cheaper node")
+            return LooVerdict(WIN)  # len > 1: no MIN_SPOT_TO_SPOT floor
+        worst = self._worst_prices(g, m0, False)
+        if not bool((it_set & (worst < price)).any()):
+            return LooVerdict(REJECT, "Can't replace with a cheaper node")
+        return LooVerdict(WIN)

@@ -27,7 +27,6 @@ from ..scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from ..state.cluster import Cluster
 from ..utils.backoff import ItemBackoff
 from ..utils.clock import Clock
-from .helpers import build_disruption_budget_mapping, get_candidates
 from .methods import (Drift, Emptiness, Method, MultiNodeConsolidation,
                       SingleNodeConsolidation)
 from .types import Command
@@ -177,6 +176,10 @@ class DisruptionController(SingletonController):
         self.pending: Optional[tuple] = None
         # the per-pass shared DisruptionSnapshot (reconcile scope only)
         self._snapshot = None
+        # the cross-pass streaming state: delta-applied snapshot layers,
+        # cached candidate rows, columnar budget accounting (stream.py)
+        from .stream import StreamingDisruptionState
+        self.stream = StreamingDisruptionState()
 
     def reconcile(self) -> Optional[Result]:
         if not self.cluster.synced():
@@ -210,9 +213,10 @@ class DisruptionController(SingletonController):
 
     def _pass_snapshot(self):
         if self._snapshot is None:
-            from .prefix import DisruptionSnapshot
-            self._snapshot = DisruptionSnapshot(self.cluster,
-                                                self.provisioner)
+            # the stream keeps the snapshot object across passes and
+            # rebuilds only the layers whose invalidation tokens moved
+            self._snapshot = self.stream.refresh(self.cluster,
+                                                 self.provisioner)
         return self._snapshot
 
     def _cleanup_stale_taints(self) -> None:
@@ -262,11 +266,12 @@ class DisruptionController(SingletonController):
         snapshot = self._pass_snapshot()
         if hasattr(method, "attach_snapshot"):
             method.attach_snapshot(snapshot)
-        candidates = get_candidates(
-            self.cluster, self.provisioner, method.should_disrupt,
-            disrupting_provider_ids=disrupting,
+        # columnar candidate construction over the stream's cached rows
+        # (bit-identical to helpers.get_candidates against this snapshot)
+        candidates = self.stream.candidates_for(
+            method.should_disrupt, disrupting_provider_ids=disrupting,
             disruption_class=method.disruption_class,
-            recorder=self.recorder, context=snapshot)
+            recorder=self.recorder)
         metrics.DISRUPTION_ELIGIBLE_NODES.set(
             len(candidates), {"reason": method.reason})
         if not candidates:
@@ -275,8 +280,8 @@ class DisruptionController(SingletonController):
             TRACER.drop_current()
             return False
         sp.set(candidates=len(candidates))
-        budgets = build_disruption_budget_mapping(self.cluster, method.reason,
-                                                  recorder=self.recorder)
+        budgets = self.stream.budget_mapping(method.reason,
+                                             recorder=self.recorder)
         started = self.clock.now()
         cmd, results = method.compute_command(budgets, candidates)
         metrics.DISRUPTION_EVAL_DURATION.observe(

@@ -43,9 +43,38 @@ MULTI_NODE_CONSOLIDATION_CANDIDATES = 100   # multinodeconsolidation.go:35
 MIN_SPOT_TO_SPOT_INSTANCE_TYPES = 15        # consolidation.go:47
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0     # multinodeconsolidation.go:35
 SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0   # singlenodeconsolidation.go:30
+
+
+def _loo_min_candidates_from_env(default: int = 16) -> int:
+    """KARPENTER_LOO_MIN_CANDIDATES: the eligible-candidate floor below
+    which the batched leave-one-out engine's device encode costs more than
+    the handful of serial probes it replaces. Rejects loudly at import —
+    a typo'd knob must never silently fall back to the default."""
+    import os
+    raw = os.environ.get("KARPENTER_LOO_MIN_CANDIDATES")
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"invalid KARPENTER_LOO_MIN_CANDIDATES={raw!r}: must be a "
+            "non-negative integer")
+    if value < 0:
+        raise SystemExit(
+            f"invalid KARPENTER_LOO_MIN_CANDIDATES={raw!r}: must be a "
+            "non-negative integer")
+    return value
+
+
 # below this many eligible candidates the batched leave-one-out engine's
 # device encode costs more than the handful of serial probes it replaces
-SINGLE_NODE_BATCH_MIN_CANDIDATES = 16
+# (env-overridable: KARPENTER_LOO_MIN_CANDIDATES)
+SINGLE_NODE_BATCH_MIN_CANDIDATES = _loo_min_candidates_from_env()
+# the closed-form multi-node subset engine is near-free (no device work on
+# top of the prefix encode the search builds anyway); the floor exists for
+# the fuzzer's engine-off oracle runs
+MULTI_NODE_BATCH_MIN_CANDIDATES = 2
 
 
 class Method:
@@ -212,6 +241,8 @@ class consolidation(Method):
         # all methods of one pass share a single encode; None for standalone
         # callers (tests, direct use) — sims then build their own state
         self._pass_snapshot = None
+        # closed-form multi-node subset engine stats of the last search
+        self.last_multi_engine_stats = None
 
     def attach_snapshot(self, snapshot) -> None:
         self._pass_snapshot = snapshot
@@ -442,7 +473,12 @@ class MultiNodeConsolidation(consolidation):
 
     def _first_n_consolidation_option(self, candidates: List[Candidate]
                                       ) -> Tuple[Command, object]:
-        """multinodeconsolidation.go:110-162 with shared-precompute probes."""
+        """multinodeconsolidation.go:110-162 with shared-precompute probes
+        and closed-form midpoint verdicts: a prefix the ranked subset
+        engine PROVABLY rejects skips its replay entirely (the engine's
+        exactness contract guarantees the replay's decide() would return
+        an empty command), so the search replays only plausible prefixes
+        — in the common ranked case, only the winner."""
         from ..metrics import registry as metrics
         from .prefix import PrefixFallback, PrefixSimulator
 
@@ -451,6 +487,8 @@ class MultiNodeConsolidation(consolidation):
         if len(candidates) < 2:
             return Command(reason=self.reason), None
         sim = None
+        engine = None
+        self.last_multi_engine_stats = None
         try:
             sim = PrefixSimulator(self.cluster, self.provisioner, candidates,
                                   snapshot=self._pass_snapshot)
@@ -458,6 +496,15 @@ class MultiNodeConsolidation(consolidation):
             pass
         except CandidateError:
             return Command(reason=self.reason), None
+        if sim is not None and \
+                len(candidates) >= MULTI_NODE_BATCH_MIN_CANDIDATES:
+            from .batch import MultiNodeLooEngine
+            from .prefix import SnapshotFallback
+            try:
+                engine = MultiNodeLooEngine(sim.snapshot, candidates,
+                                            self.spot_to_spot_enabled)
+            except (SnapshotFallback, CandidateError):
+                engine = None
         deadline = self.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
         # binary search on prefix size (multinodeconsolidation.go:110-162);
         # floor of 2 per the >= 2 rule above
@@ -472,6 +519,11 @@ class MultiNodeConsolidation(consolidation):
                     {"consolidation_type": self.consolidation_type})
                 return best
             mid = (lo + hi) // 2
+            if engine is not None and engine.verdict(mid).kind == "reject":
+                # provably empty without a replay (exactness contract)
+                self.last_multi_engine_stats = dict(engine.stats)
+                hi = mid - 1
+                continue
             if sim is not None:
                 results, sim_errors = sim.simulate(mid)
                 cmd, results = self.decide(candidates[:mid], results,
@@ -491,6 +543,8 @@ class MultiNodeConsolidation(consolidation):
                 continue
             best = (cmd, results)
             lo = mid + 1
+        if engine is not None:
+            self.last_multi_engine_stats = dict(engine.stats)
         return best
 
 

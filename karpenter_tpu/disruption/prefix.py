@@ -85,16 +85,37 @@ def _pad_groups(groups: List[PodGroup]) -> List[PodGroup]:
 
 
 class DisruptionSnapshot:
-    """Pass-level shared state for every disruption simulation."""
+    """Pass-level shared state for every disruption simulation.
 
-    def __init__(self, cluster: Cluster, provisioner: Provisioner):
+    `stream` (disruption.stream.StreamingDisruptionState) makes the
+    snapshot PERSISTENT: the stream keeps this object across passes and
+    re-invokes individual layer builders (`_build_pods`, `_build_context`,
+    `_build_scheduler`) only when their invalidation tokens changed,
+    and threads its cross-pass ProblemState into the scheduler so node
+    and group encodes are delta-applied. `prefetched` carries the
+    (nodepools, instance-types, pending-pods, catalog-token) the stream
+    already fetched for token capture so the layers don't re-list."""
+
+    def __init__(self, cluster: Cluster, provisioner: Provisioner,
+                 stream=None, prefetched=None):
+        self.stream = stream
+        self._prefetched = prefetched
         with TRACER.span("disruption.snapshot"):
             self._build(cluster, provisioner)
+        self._prefetched = None
 
     def _build(self, cluster: Cluster, provisioner: Provisioner):
-        from .helpers import build_pdb_limits, pods_by_node
         self.cluster = cluster
         self.provisioner = provisioner
+        self._build_pods(cluster, provisioner)
+        self._build_context(cluster, provisioner)
+        self._build_scheduler(cluster, provisioner)
+        self._encodings: Dict[tuple, object] = {}
+
+    def _build_pods(self, cluster: Cluster, provisioner: Provisioner):
+        """Pod-derived layer: valid while Cluster.topo_revision, the node
+        token, and the pending-pod token are unchanged."""
+        from .helpers import pods_by_node
         # one store pass -> node name -> active pods (shared by candidate
         # collection AND the ride-along scan below)
         self.pods_by_node_map: Dict[str, List[Pod]] = pods_by_node(cluster)
@@ -107,23 +128,35 @@ class DisruptionSnapshot:
                 if pod_utils.is_reschedulable(p):
                     self.ride_along_pods.append(p)
         self.deleting_pod_uids: Set[str] = {p.uid for p in self.ride_along_pods}
-        self.base_pods: List[Pod] = (provisioner.get_pending_pods()
-                                     + self.ride_along_pods)
+        pending = (self._prefetched[2] if self._prefetched is not None
+                   else provisioner.get_pending_pods())
+        self.base_pods: List[Pod] = list(pending) + self.ride_along_pods
         self.base_uids: Set[str] = {p.uid for p in self.base_pods}
         self.state_nodes = [sn for sn in cluster.state_nodes(deep_copy=False)
                             if not sn.deleting()]
 
-        # candidate context: what get_candidates / validation need, built
-        # once per pass instead of once per method
-        self.all_nodepools: Dict[str, NodePool] = {
-            np_.name: np_ for np_ in cluster.store.list(NodePool)}
-        self.instance_types_by_pool = {
-            name: provisioner.cloud_provider.get_instance_types(np_)
-            for name, np_ in self.all_nodepools.items()}
+    def _build_context(self, cluster: Cluster, provisioner: Provisioner):
+        """Candidate context: what get_candidates / validation need, built
+        once per pass instead of once per method. Valid while the nodepool,
+        catalog, PDB, and pod tokens are unchanged."""
+        from .helpers import build_pdb_limits
+        if self._prefetched is not None:
+            pools, its_by_pool = self._prefetched[0], self._prefetched[1]
+            self.all_nodepools = {np_.name: np_ for np_ in pools}
+            self.instance_types_by_pool = dict(its_by_pool)
+        else:
+            self.all_nodepools = {
+                np_.name: np_ for np_ in cluster.store.list(NodePool)}
+            self.instance_types_by_pool = {
+                name: provisioner.cloud_provider.get_instance_types(np_)
+                for name, np_ in self.all_nodepools.items()}
         self.it_maps = {name: {it.name: it for it in its}
                         for name, its in self.instance_types_by_pool.items()}
         self.pdb_limits = build_pdb_limits(cluster)
 
+    def _build_scheduler(self, cluster: Cluster, provisioner: Provisioner):
+        """Solver layer: valid while the node, nodepool, catalog, and
+        daemonset tokens are unchanged."""
         # solver-side nodepool view mirrors schedule_with: deleting pools
         # receive no new capacity, IT-less pools contribute nothing
         nodepools = order_by_weight(
@@ -141,12 +174,19 @@ class DisruptionSnapshot:
             # the unavailable-offerings mask rides into every disruption
             # encode too: consolidation must never plan a replacement onto
             # an offering a launch failure just proved dry
-            unavailable=getattr(provisioner, "unavailable", None))
+            unavailable=getattr(provisioner, "unavailable", None),
+            # streaming: node/group encode rows are delta-applied across
+            # passes through the stream's persistent ProblemState, and the
+            # content-keyed catalog token computed during token capture is
+            # pinned so repeated builds skip re-hashing 2k instance types
+            problem_state=(self.stream.problem_state
+                           if self.stream is not None else None),
+            catalog_token=(self._prefetched[3]
+                           if self._prefetched is not None else None))
         # candidate-build traffic: its fallback-ledger records must not
         # move the headline provisioning totals (explicit flag — the
         # tracing-based backstop is off when --trace-ring is 0)
         self.ts.ledger_subsystem = "disruption"
-        self._encodings: Dict[tuple, object] = {}
 
     # -- per-candidate-set encode (memoized) --------------------------------
 

@@ -270,6 +270,23 @@ CONSOLIDATION_TIMEOUTS = REGISTRY.counter(
     "karpenter_voluntary_disruption_consolidation_timeouts_total",
     "Consolidation searches abandoned at their timeout",
     ("consolidation_type",))
+# -- streaming disruption engine (ISSUE 14): cross-pass delta residency ----
+
+DISRUPTION_STREAM_LAYERS = REGISTRY.counter(
+    "karpenter_disruption_stream_reuse_total",
+    "Streaming-snapshot layer outcomes per disruption pass",
+    ("layer", "outcome"))
+DISRUPTION_STREAM_ROWS = REGISTRY.counter(
+    "karpenter_disruption_candidate_rows_total",
+    "Cached candidate-row outcomes per disruption pass", ("outcome",))
+DISRUPTION_CANDIDATE_BUILD = REGISTRY.histogram(
+    "karpenter_disruption_candidate_build_seconds",
+    "Wall clock of the streaming candidate/snapshot refresh per pass")
+DISRUPTION_SUBSET_VERDICTS = REGISTRY.counter(
+    "karpenter_disruption_subset_verdicts_total",
+    "Closed-form multi-node subset verdicts (ranked prefix search)",
+    ("kind",))
+
 NODEPOOL_USAGE = REGISTRY.gauge(
     "karpenter_nodepools_usage", "In-use resources per nodepool",
     ("nodepool", "resource_type"))

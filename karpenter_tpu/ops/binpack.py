@@ -788,6 +788,40 @@ class PackResult:
     limit_constrained: bool = False
 
 
+# -- donor-row headroom policy (sharded hierarchical pack) --------------------
+
+# the old fixed bar, kept as the ceiling for dense many-node groups
+DONOR_HEADROOM_DENSE = 0.25
+DONOR_HEADROOM_MEDIUM = 0.15
+DONOR_HEADROOM_SMALL = 0.05
+
+
+def donor_headroom(group_count: int, shards: int) -> float:
+    """Group-size-aware donor bar for the sharded pack's cross-shard
+    reconcile (retires the fixed 0.25, ROADMAP item 3): a single-node row
+    donates its pods to the merge mini-pack when its best surviving
+    instance type still has this much relative headroom over the
+    accumulated requests.
+
+    A group of ``group_count`` pods round-robined over ``shards`` blocks
+    leaves ~count/shards pods per shard — SMALL groups fragment into
+    per-shard tails that are each a large fraction of the whole group, so
+    coalescing them wins whole nodes and they donate at a low bar; HUGE
+    groups produce dense rows whose tail is one node in hundreds, so only
+    a clearly underfilled row is worth the re-pack. Deterministic pure
+    function of (group size, shard count): the sharded pack stays
+    seed-free and the policy is pinned by a directed vector
+    (tests/test_parallel_mesh.py)."""
+    if shards <= 1 or group_count <= 0:
+        return DONOR_HEADROOM_DENSE
+    frag = group_count / shards
+    if frag <= 16:
+        return DONOR_HEADROOM_SMALL
+    if frag <= 128:
+        return DONOR_HEADROOM_MEDIUM
+    return DONOR_HEADROOM_DENSE
+
+
 def waterfill(counts: np.ndarray, viable: np.ndarray, admitted: np.ndarray,
               c: int, max_skew: int,
               min_domains: Optional[int] = None,

@@ -549,23 +549,25 @@ def _group_per_node_cap(groups, g: int) -> Optional[int]:
     return 1 if host_spec.self_select else 0
 
 
-# a cohort row donates its pods to the reconcile mini-pack when its best
-# surviving instance type could hold this much more load on top of the
-# accumulated requests: underfilled tails are re-packed, dense rows are
-# left alone (re-offering EVERY row would just re-run the sequential pack)
-_DONOR_HEADROOM = 0.25
-
-
-def _donor_rows(p, cs) -> np.ndarray:
+def _donor_rows(p, cs, groups, shards: int) -> np.ndarray:
     """[C] bool: single-node rows whose best surviving instance type still
-    has >= _DONOR_HEADROOM relative headroom over the accumulated requests
-    — the per-shard tail fragments the cross-shard pass coalesces."""
+    has >= the group-size-aware donor bar (binpack.donor_headroom) of
+    relative headroom over the accumulated requests — the per-shard tail
+    fragments the cross-shard pass coalesces. A row holding several groups
+    takes the MOST EAGER (smallest) of its groups' bars: any small-group
+    fragment aboard makes the re-offer worthwhile."""
     C = cs.C
     if C == 0:
         return np.zeros(0, dtype=bool)
     m_c = cs.m[:C]
+    bar = np.fromiter(
+        (min((binpack.donor_headroom(len(groups[g].pods), shards)
+              for g in cs.pods_by_group[ci]),
+             default=binpack.DONOR_HEADROOM_DENSE)
+         for ci in range(C)),
+        dtype=np.float64, count=C)
     need = p.daemon_overhead[m_c] + np.ceil(
-        cs.requests[:C] * (1.0 + _DONOR_HEADROOM)).astype(np.int64)
+        cs.requests[:C] * (1.0 + bar[:, None])).astype(np.int64)
     fits = (p.it_alloc[None, :, :] >= need[:, None, :]).all(axis=2)  # [C,T]
     return (cs.n[:C] == 1) & (fits & cs.it_set[:C]).any(axis=1)
 
@@ -597,7 +599,7 @@ def _reconcile(p, t, groups, packers, results, izc, exist_counts,
     held = 0
     for res in results:
         cs = res.cohorts
-        donor = _donor_rows(p, cs)
+        donor = _donor_rows(p, cs, groups, len(results))
         for ci in range(cs.C):
             pbg = cs.pods_by_group[ci]
             caps = ([_group_per_node_cap(groups, g) for g in pbg]

@@ -561,6 +561,56 @@ class FleetSimulator:
             # the finalizer
             self.op.store.delete(node)
 
+    def _sim_claims(self, zone: Optional[str] = None) -> List[NodeClaim]:
+        """Live kwok-backed claims, oldest first — the deterministic wave
+        target order for drift/expiration events."""
+        out = []
+        for nc in self.op.store.list(NodeClaim):
+            if not (nc.status.provider_id or "").startswith("kwok://"):
+                continue
+            if nc.metadata.deletion_timestamp is not None:
+                continue
+            if zone and nc.metadata.labels.get(
+                    api_labels.LABEL_TOPOLOGY_ZONE) != zone:
+                continue
+            out.append(nc)
+        return sorted(out, key=lambda nc: (nc.metadata.creation_timestamp,
+                                           nc.metadata.name))
+
+    def _wave_targets(self, ev) -> List[NodeClaim]:
+        claims = self._sim_claims(zone=ev.params.get("zone"))
+        n = ev.params.get("count")
+        if n is None:
+            n = int(math.ceil(ev.params["fraction"] * len(claims)))
+        return claims[:min(n, len(claims))]
+
+    def _ev_drift(self, ev, t: float) -> None:
+        """Drift wave: stamp a stale nodepool-hash annotation onto the
+        targeted claims — the NodeClaimDisruptionMarker controller flags
+        them Drifted through its normal static-drift path, and the Drift
+        method replaces them under the pool's disruption budgets."""
+        from ..api.nodepool import NODEPOOL_HASH_VERSION
+        doomed = self._wave_targets(ev)
+        for nc in doomed:
+            nc.metadata.annotations[
+                api_labels.NODEPOOL_HASH_ANNOTATION_KEY] = "sim-drift-wave"
+            nc.metadata.annotations[
+                api_labels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = \
+                NODEPOOL_HASH_VERSION
+            self.op.store.update(nc)
+        self.ledger.append(t, "event", event="drift", claims=len(doomed))
+
+    def _ev_expire(self, ev, t: float) -> None:
+        """Expiration wave: give the targeted claims a finite expireAfter
+        so the expiration controller retires them as they age out — a
+        rolling graceful replacement front."""
+        doomed = self._wave_targets(ev)
+        for nc in doomed:
+            nc.spec.expire_after = ev.params["expire_after"]
+            self.op.store.update(nc)
+        self.ledger.append(t, "event", event="expire", claims=len(doomed),
+                           expire_after=ev.params["expire_after"])
+
     def _ev_flaky(self, ev, t: float) -> None:
         rate, duration = ev.params["rate"], ev.params["duration"]
         # window stack, the _ev_slo shape: an earlier window's close must

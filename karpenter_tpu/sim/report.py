@@ -70,8 +70,15 @@ class Ledger:
 SUBSYSTEM_SPANS = {
     "provisioning": ("provisioner.pass",),
     "disruption": ("disruption.pass",),
-    "disruption_candidate_build": ("disruption.snapshot",
-                                   "disruption.encode", "disruption.loo"),
+    # streaming engine (ISSUE 14): disruption.stream covers the per-pass
+    # delta refresh (and, on the rare fully-cold pass, nests the
+    # disruption.snapshot build — a bounded one-off overlap);
+    # disruption.snapshot alone still fires for validation-pass snapshots
+    "disruption_candidate_build": ("disruption.stream",
+                                   "disruption.candidates",
+                                   "disruption.snapshot",
+                                   "disruption.encode", "disruption.loo",
+                                   "disruption.mnloo"),
     "device": ("device.upload", "device.dispatch", "device.execute",
                "device.fetch", "compile"),
     "wire": ("sidecar.rpc", "sidecar.queue"),

@@ -212,6 +212,25 @@ EVENT_KINDS: Dict[str, Dict[str, tuple]] = {
         "duration": (_pos, True, None),
         "terminal_rate": (_fraction, False, 0.0),
     },
+    # drift wave: stamp a stale nodepool-hash annotation onto `count` /
+    # `fraction` of the fleet's claims (oldest first, optionally one zone)
+    # — the disruption marker flags them Drifted and the Drift method
+    # replaces them under the pool's budgets (at least one of
+    # fraction / count, checked post-table)
+    "drift": {
+        "fraction": (_fraction, False, None),
+        "count": (_count, False, None),
+        "zone": (_str, False, None),
+    },
+    # expiration wave: set spec.expireAfter on the oldest `count` /
+    # `fraction` claims so they age out through the expiration controller
+    # (at least one of fraction / count, checked post-table)
+    "expire": {
+        "fraction": (_fraction, False, None),
+        "count": (_count, False, None),
+        "expire_after": (_pos, True, None),
+        "zone": (_str, False, None),
+    },
     # SLO-budget window: budgets applied to the live SLOWatcher at `at`,
     # restored after `duration` (None = until the end of the run)
     "slo": {
@@ -440,7 +459,7 @@ def _validate_event(raw, index: int, ctx: _Ctx) -> SimEvent:
         if sum(v is not None for v in have) != 1:
             ctx.fail(f"{what} needs exactly one of 'max_unavailable' / "
                      "'min_available'", line)
-    if kind == "spot_reclaim":
+    if kind in ("spot_reclaim", "drift", "expire"):
         if params.get("fraction") is None and params.get("count") is None:
             ctx.fail(f"{what} needs at least one of 'fraction' / 'count'",
                      line)

@@ -391,3 +391,82 @@ class TestMultihostHelpers:
         assert rows0 | rows1 == set(range(101))  # complete coverage
         # interleaving produced more than one span for process 0
         assert len(s0) == 2
+
+
+# ---------------------------------------------------------------------------
+# group-size-aware donor-row headroom (ISSUE 14 satellite, ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+def test_donor_headroom_policy_properties():
+    """The policy function: deterministic, bounded, monotone in fragment
+    size, and degenerate cases keep the old fixed bar."""
+    from karpenter_tpu.ops.binpack import (DONOR_HEADROOM_DENSE,
+                                           DONOR_HEADROOM_MEDIUM,
+                                           DONOR_HEADROOM_SMALL,
+                                           donor_headroom)
+    assert donor_headroom(1000, 1) == DONOR_HEADROOM_DENSE
+    assert donor_headroom(0, 4) == DONOR_HEADROOM_DENSE
+    assert donor_headroom(8, 4) == DONOR_HEADROOM_SMALL       # frag 2
+    assert donor_headroom(64 * 4, 4) == DONOR_HEADROOM_MEDIUM  # frag 64
+    assert donor_headroom(1000 * 4, 4) == DONOR_HEADROOM_DENSE
+    # monotone: a larger fragment never gets a LOWER bar
+    prev = 0.0
+    for frag in (1, 4, 16, 17, 64, 128, 129, 10000):
+        bar = donor_headroom(frag * 4, 4)
+        assert bar >= prev, (frag, bar, prev)
+        prev = bar
+    assert DONOR_HEADROOM_SMALL < DONOR_HEADROOM_MEDIUM < DONOR_HEADROOM_DENSE
+
+
+def _reconcile_span():
+    from karpenter_tpu.obs.tracer import TRACER
+    trace = TRACER.last()
+    spans = [s for s in trace.spans if s.name == "pack.reconcile"]
+    assert len(spans) == 1, [s.name for s in trace.spans]
+    return spans[0]
+
+
+def test_group_size_aware_donor_bar_directed_vector(monkeypatch):
+    """Directed vector pinning the policy swap: small groups whose
+    per-shard tail rows sit at ~13% headroom on the only type that fits
+    them. Under the retired fixed 0.25 bar those rows never donate (the
+    13% headroom clears no 25% need) and fragments stay stranded one node
+    per shard; under the group-size-aware bar (fragment <= 16 pods ->
+    0.05) they donate and the cross-shard reconcile coalesces them."""
+    from karpenter_tpu.ops import binpack
+
+    # ONE instance type, so the tail-row shape is fully deterministic:
+    # ppn = 8, each 15-pod group leaves one 7/8-full tail node whose
+    # headroom (~18%) clears the small-group 0.05 bar but not the dense
+    # 0.25 bar
+    all_its = construct_instance_types()
+    big = max((it for it in all_its if it.capacity.get("cpu", 0) <= 4000),
+              key=lambda it: it.allocatable().get("cpu", 0))
+    its = [big]
+    alloc = big.allocatable()["cpu"]
+    pod_cpu = int(alloc * 0.117)
+    assert 7 * pod_cpu * 1.05 <= alloc < 7 * pod_cpu * 1.25
+    pods = []
+    for d in range(8):
+        pods += make_pods(15, cpu=f"{pod_cpu}m", memory="64Mi",
+                          labels={"app": f"donor{d}"})
+
+    r_seq = _solve(pods, its)
+    r_new = _solve(pods, its, pack_shards=4)
+    held_new = _reconcile_span().attrs.get("donor_rows", 0)
+
+    # force the retired fixed bar and re-pack the same problem
+    monkeypatch.setattr(
+        binpack, "donor_headroom",
+        lambda count, shards: binpack.DONOR_HEADROOM_DENSE)
+    r_old = _solve(pods, its, pack_shards=4)
+    held_old = _reconcile_span().attrs.get("donor_rows", 0)
+
+    assert held_new > held_old, (held_new, held_old)
+    # decision contract unchanged under the new policy (DEVIATIONS 22)
+    assert r_new.pod_errors == r_seq.pod_errors == {}
+    placed = sum(len(nc.pods) for nc in r_seq.new_nodeclaims)
+    assert sum(len(nc.pods) for nc in r_new.new_nodeclaims) == placed
+    assert sum(len(nc.pods) for nc in r_old.new_nodeclaims) == placed
+    # coalescing the donated tails never costs nodes vs the frozen bar
+    assert len(r_new.new_nodeclaims) <= len(r_old.new_nodeclaims)

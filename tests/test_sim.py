@@ -692,7 +692,8 @@ class TestScenarioSoaks:
                                       "spot-reclaim-wave.yaml",
                                       "zonal-drought.yaml",
                                       "pdb-drain.yaml",
-                                      "service-faults.yaml"])
+                                      "service-faults.yaml",
+                                      "disruption-wave.yaml"])
     def test_library_scenario_replays_clean(self, name):
         sc = load_scenario(os.path.join(SCENARIOS_DIR, name))
         sim = FleetSimulator(sc)
@@ -711,3 +712,54 @@ class TestScenarioSoaks:
         r1 = FleetSimulator(sc1).run()
         r2 = FleetSimulator(sc2).run()
         assert r1["ledger_digest"] == r2["ledger_digest"]
+
+
+# -- drift / expiration waves (ISSUE 14 satellite) ---------------------------
+
+class TestDisruptionWaveEvents:
+    def test_drift_and_expire_need_fraction_or_count(self):
+        for kind, extra in (("drift", {}),
+                            ("expire", {"expire_after": 600})):
+            doc = _doc()
+            doc["events"].append({"at": 9, "kind": kind, **extra})
+            with pytest.raises(ScenarioError, match="at least one of"):
+                parse_scenario(doc)
+
+    def test_expire_requires_expire_after(self):
+        doc = _doc()
+        doc["events"].append({"at": 9, "kind": "expire", "count": 1})
+        with pytest.raises(ScenarioError,
+                           match="missing required field 'expire_after'"):
+            parse_scenario(doc)
+
+    def test_drift_wave_replaces_flagged_claims(self):
+        """End to end: a drift wave stamps stale nodepool hashes, the
+        marker controller flags Drifted, and the Drift method replaces
+        the flagged claims — visible as reclaimed/terminated churn."""
+        sc = parse_scenario({
+            "name": "drift-wave-e2e", "seed": 7, "duration": 2400,
+            "tick": 20, "disruption_interval": 60,
+            "events": [
+                {"at": 30, "kind": "deploy", "name": "web", "replicas": 9,
+                 "cpu": "8", "memory": "8Gi", "spread": "zone"},
+                {"at": 600, "kind": "drift", "count": 2},
+            ]})
+        report = FleetSimulator(sc).run()
+        assert report["events_applied"].get("drift") == 1
+        assert report["churn"]["claims_terminated"] >= 2
+        assert report["final"]["pods_pending"] == 0
+
+    def test_expire_wave_retires_oldest_claims(self):
+        sc = parse_scenario({
+            "name": "expire-wave-e2e", "seed": 7, "duration": 3600,
+            "tick": 20, "disruption_interval": 60,
+            "events": [
+                {"at": 30, "kind": "deploy", "name": "web", "replicas": 9,
+                 "cpu": "8", "memory": "8Gi", "spread": "zone"},
+                {"at": 600, "kind": "expire", "count": 2,
+                 "expire_after": 700},
+            ]})
+        report = FleetSimulator(sc).run()
+        assert report["events_applied"].get("expire") == 1
+        assert report["churn"]["claims_terminated"] >= 2
+        assert report["final"]["pods_pending"] == 0

@@ -27,7 +27,10 @@ pytestmark = pytest.mark.sim
 
 @pytest.fixture(scope="module")
 def pin():
-    return sim_regression.current_pin()
+    # v2 multi-scenario pins: the clipped mixed-day replay plus the
+    # disruption-wave replay (drift/expiration waves + weighted pools,
+    # the streaming disruption engine's decision pin — ISSUE 14)
+    return sim_regression.current_pins()
 
 
 class TestSimRegressionGate:
@@ -50,25 +53,34 @@ class TestSimRegressionGate:
             + "\nintentional? refresh: python tools/sim_regression.py "
               "--update")
 
+    def test_both_scenarios_are_pinned(self, pin):
+        """The v2 golden covers BOTH library pins: mixed-day and the
+        ISSUE-14 disruption-wave (drift + expiration waves through the
+        streaming engine are part of the byte-exact contract)."""
+        names = {p["scenario"] for p in pin["pins"]}
+        assert names == {"mixed-day.yaml", "disruption-wave.yaml"}
+
     def test_report_shape_covers_new_sections(self, pin):
         """The ISSUE-12 report sections are part of the pinned shape: the
         fallback ledger and the per-subsystem attribution can't silently
         vanish from the report."""
-        paths = set(pin["report_shape"])
-        assert "fallbacks.classes:dict" in paths
-        assert "fallbacks.host_seconds:number" in paths
-        assert "fallbacks.host_cost_ratio:number" in paths
-        assert "attribution:dict" in paths
-        assert "ledger_digest:str" in paths
+        for entry in pin["pins"]:
+            paths = set(entry["report_shape"])
+            assert "fallbacks.classes:dict" in paths
+            assert "fallbacks.host_seconds:number" in paths
+            assert "fallbacks.host_cost_ratio:number" in paths
+            assert "attribution:dict" in paths
+            assert "ledger_digest:str" in paths
 
     def test_mismatch_fails_loudly_with_regen_command(self, pin, tmp_path,
                                                       capsys):
         """A digest regression exits 1 and the message names the exact
         regeneration command — the failing-loudly contract."""
-        bad = dict(pin)
-        bad["ledger_digest"] = "0" * 64
-        bad["report_shape"] = [p for p in pin["report_shape"]
-                               if not p.startswith("fallbacks.")]
+        first = dict(pin["pins"][0])
+        first["ledger_digest"] = "0" * 64
+        first["report_shape"] = [p for p in first["report_shape"]
+                                 if not p.startswith("fallbacks.")]
+        bad = {"pins": [first] + [dict(p) for p in pin["pins"][1:]]}
         golden = tmp_path / "golden.json"
         golden.write_text(json.dumps(bad))
         rc = sim_regression.main(["--golden", str(golden)], pin=pin)
@@ -77,6 +89,24 @@ class TestSimRegressionGate:
         assert "ledger digest changed" in err
         assert "report keys NEW vs golden" in err
         assert "python tools/sim_regression.py --update" in err
+
+    def test_missing_scenario_pin_fails_loudly(self, pin, tmp_path, capsys):
+        """A pinned scenario silently dropped from the golden (or a new
+        scenario with no pin) is its own loud failure."""
+        bad = {"pins": [dict(pin["pins"][0])]}
+        golden = tmp_path / "golden.json"
+        golden.write_text(json.dumps(bad))
+        rc = sim_regression.main(["--golden", str(golden)], pin=pin)
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "has no golden pin" in err
+
+    def test_legacy_single_pin_golden_still_compares(self, pin):
+        """The pre-v2 single-dict golden format compares without
+        crashing (it reads as one scenario's pin)."""
+        legacy = dict(pin["pins"][0])
+        problems = sim_regression.compare(pin["pins"][0], legacy)
+        assert problems == []
 
     def test_missing_golden_is_a_distinct_failure(self, pin, tmp_path,
                                                   capsys):

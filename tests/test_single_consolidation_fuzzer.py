@@ -262,6 +262,131 @@ def test_budget_gates_pools_but_never_decrements():
     assert [c.name for c in cmd.candidates] == [winner.name]
 
 
+# -- ranked multi-node subset search (ISSUE 14) ------------------------------
+
+
+def run_multi_node(env, spot_to_spot: bool, batched: bool):
+    """One MultiNodeConsolidation compute_command pass; batched=False
+    forces the engine-off binary search (every midpoint replays — the
+    parity oracle for the closed-form subset verdicts)."""
+    from karpenter_tpu.disruption.methods import MultiNodeConsolidation
+    saved = methods_mod.MULTI_NODE_BATCH_MIN_CANDIDATES
+    methods_mod.MULTI_NODE_BATCH_MIN_CANDIDATES = 2 if batched else 10**9
+    try:
+        m = MultiNodeConsolidation(env.cluster, env.provisioner,
+                                   spot_to_spot_enabled=spot_to_spot,
+                                   clock=env.clock)
+        cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt)
+        budgets = build_disruption_budget_mapping(env.cluster, m.reason)
+        cmd, results = m.compute_command(budgets, cands)
+        stats = m.last_multi_engine_stats
+    finally:
+        methods_mod.MULTI_NODE_BATCH_MIN_CANDIDATES = saved
+    return cands, cmd, results, stats
+
+
+@pytest.mark.parametrize("seed", list(range(7100, 7124)))
+def test_multi_node_subset_engine_matches_binary_search_oracle(seed):
+    """The exactness contract end to end: skipping provably-rejected
+    midpoints must never change the binary search's decision."""
+    env, spot_to_spot = build_cluster(seed)
+    cands_b, cmd_b, res_b, _ = run_multi_node(env, spot_to_spot, True)
+    cands_o, cmd_o, res_o, _ = run_multi_node(env, spot_to_spot, False)
+    assert [c.name for c in cands_b] == [c.name for c in cands_o]
+    got, want = summarize(cmd_b, res_b), summarize(cmd_o, res_o)
+    assert got == want, (seed, got, want)
+
+
+def _count_replays(monkeypatch):
+    from karpenter_tpu.disruption import prefix as prefix_mod
+    calls = {"n": 0}
+    orig = prefix_mod.SnapshotEncoding.simulate_subset
+
+    def counted(self, idxs):
+        calls["n"] += 1
+        return orig(self, idxs)
+
+    monkeypatch.setattr(prefix_mod.SnapshotEncoding, "simulate_subset",
+                        counted)
+    return calls
+
+
+def test_multi_node_all_stuck_spot_rejects_without_any_replay(monkeypatch):
+    """Directed: 17 stuck SPOT nodes with spot-to-spot disabled — every
+    prefix is provably rejected in closed form (single group, overflow,
+    spot gate), so the whole binary search runs with ZERO host replays
+    and agrees with the oracle's empty command."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(SPOT)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=SPOT, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")
+    env.clock.step(600)
+    env.settle(rounds=1)
+    calls = _count_replays(monkeypatch)
+    cands, cmd, _, stats = run_multi_node(env, False, True)
+    assert len(cands) == 17
+    assert cmd.is_empty()
+    assert calls["n"] == 0, calls
+    assert stats is not None and stats["probes_saved"] > 0, stats
+    _, cmd_o, _, _ = run_multi_node(env, False, False)
+    assert cmd_o.is_empty()
+
+
+def test_multi_node_uninitialized_target_rejects_without_any_replay(
+        monkeypatch):
+    """Directed: the only headroom is an uninitialized managed node —
+    every prefix's fill provably reaches it, so every midpoint rejects
+    closed-form with zero replays (the multi-excluded-column threshold
+    math), and the oracle agrees."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(OD)
+    for _ in range(17):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=OD, instance_type=it,
+            allocatable={"cpu": "1", "memory": "8Gi", "pods": "110"})
+        bind_pod(env, node, cpu="600m", memory="128Mi")
+    make_nodeclaim_and_node(
+        env, capacity_type=OD, instance_type=it,
+        allocatable={"cpu": "32", "memory": "64Gi", "pods": "110"},
+        initialized=False, consolidatable=False)
+    env.clock.step(600)
+    env.settle(rounds=1)
+    calls = _count_replays(monkeypatch)
+    cands, cmd, _, stats = run_multi_node(env, False, True)
+    assert len(cands) == 17
+    assert cmd.is_empty()
+    assert calls["n"] == 0, calls
+    assert stats["probes_saved"] > 0, stats
+    _, cmd_o, _, _ = run_multi_node(env, False, False)
+    assert cmd_o.is_empty()
+
+
+def test_multi_node_win_found_with_engine_on():
+    """Directed: lightly-loaded identical nodes whose pods all fit
+    elsewhere — the search must land a non-empty command (here the full
+    prefix replaced by one cheaper node beats a shorter delete), and the
+    engine-on search must find exactly what the oracle finds."""
+    from expectations import most_expensive_instance
+    env = make_env()
+    it = most_expensive_instance(OD)
+    for _ in range(8):
+        _, node = make_nodeclaim_and_node(
+            env, capacity_type=OD, instance_type=it,
+            allocatable={"cpu": "4", "memory": "16Gi", "pods": "110"})
+        bind_pod(env, node, cpu="100m", memory="64Mi")
+    env.clock.step(600)
+    env.settle(rounds=1)
+    _, cmd_b, res_b, _ = run_multi_node(env, False, True)
+    _, cmd_o, res_o, _ = run_multi_node(env, False, False)
+    assert not cmd_b.is_empty()
+    assert summarize(cmd_b, res_b) == summarize(cmd_o, res_o)
+
+
 def test_fuzz_covers_the_feature_space():
     """Meta-check: across the pinned seeds the generator exercised spot
     candidates, both spot-to-spot settings, minValues pools, uninitialized
@@ -291,3 +416,50 @@ def test_fuzz_covers_the_feature_space():
         saw["classified_rows"] |= bool(stats and stats["classified"] > 0)
     missing = [k for k, v in saw.items() if not v]
     assert not missing, f"fuzzer never generated: {missing}"
+
+
+# -- KARPENTER_LOO_MIN_CANDIDATES (ISSUE 14 satellite) -----------------------
+
+
+class TestLooMinCandidatesKnob:
+    def test_default_when_unset(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_LOO_MIN_CANDIDATES", raising=False)
+        assert methods_mod._loo_min_candidates_from_env() == 16
+
+    def test_valid_values_apply(self, monkeypatch):
+        for raw, want in (("0", 0), ("1", 1), ("42", 42)):
+            monkeypatch.setenv("KARPENTER_LOO_MIN_CANDIDATES", raw)
+            assert methods_mod._loo_min_candidates_from_env() == want
+
+    @pytest.mark.parametrize("raw", ["sixteen", "1.5", "", " ", "-3"])
+    def test_invalid_values_reject_loudly(self, monkeypatch, raw):
+        monkeypatch.setenv("KARPENTER_LOO_MIN_CANDIDATES", raw)
+        with pytest.raises(SystemExit) as exc:
+            methods_mod._loo_min_candidates_from_env()
+        assert "KARPENTER_LOO_MIN_CANDIDATES" in str(exc.value)
+        assert repr(raw) in str(exc.value)
+
+    def test_module_floor_reads_env_at_import(self):
+        """The module-level floor is initialized from the env parser (a
+        subprocess pins the end-to-end wiring without reloading the module
+        under other tests' feet)."""
+        import subprocess
+        import sys
+        code = ("import karpenter_tpu.disruption.methods as m; "
+                "print(m.SINGLE_NODE_BATCH_MIN_CANDIDATES)")
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "KARPENTER_LOO_MIN_CANDIDATES": "7",
+                 "PYTHONPATH": "."},
+            capture_output=True, text=True, cwd=".")
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "7"
+        bad = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "KARPENTER_LOO_MIN_CANDIDATES": "nope",
+                 "PYTHONPATH": "."},
+            capture_output=True, text=True, cwd=".")
+        assert bad.returncode != 0
+        assert "KARPENTER_LOO_MIN_CANDIDATES" in bad.stderr

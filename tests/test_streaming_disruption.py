@@ -1,0 +1,455 @@
+"""StreamingDisruptionState: delta-applied disruption snapshots (ISSUE 14).
+
+Every test enforces ONE contract: a disruption pass served from the
+persistent streaming state (cached snapshot layers, cached candidate rows,
+columnar budgets) produces decisions bit-identical to a cold
+`DisruptionSnapshot` + `helpers.get_candidates` +
+`build_disruption_budget_mapping` rebuild of the same cluster — across
+every row of the invalidation matrix (disruption/stream.py module
+docstring) and under a seeded churn stream interleaving pod churn, node
+churn, PDB edits, nodepool edits, nominations and deletion marks.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as api_labels
+from karpenter_tpu.api.objects import (LabelSelector, ObjectMeta, Pod,
+                                       PodSpec)
+from karpenter_tpu.api.policy import PDBSpec, PodDisruptionBudget
+from karpenter_tpu.disruption import methods as methods_mod
+from karpenter_tpu.disruption.helpers import (build_disruption_budget_mapping,
+                                              get_candidates)
+from karpenter_tpu.disruption.methods import (Drift, Emptiness,
+                                              MultiNodeConsolidation,
+                                              SingleNodeConsolidation)
+from karpenter_tpu.disruption.prefix import DisruptionSnapshot
+
+from expectations import (OD, SPOT, bind_pod, catalog,
+                          consolidation_nodepool, make_env,
+                          make_nodeclaim_and_node)
+
+pytestmark = pytest.mark.churn
+
+
+def summarize(cmd, results=None):
+    return {
+        "decision": cmd.decision,
+        "candidates": [c.name for c in cmd.candidates],
+        "replacements": [[it.name for it in r.instance_type_options]
+                         for r in cmd.replacements],
+    }
+
+
+METHODS = (Emptiness, Drift, MultiNodeConsolidation, SingleNodeConsolidation)
+
+
+def make_method(env, cls):
+    if cls in (MultiNodeConsolidation, SingleNodeConsolidation):
+        return cls(env.cluster, env.provisioner, spot_to_spot_enabled=False,
+                   clock=env.clock, recorder=env.recorder)
+    return cls(env.cluster, env.provisioner, recorder=env.recorder)
+
+
+def cold_pass(env, cls, disrupting=()):
+    """The oracle: a fresh snapshot + the cold candidate/budget path."""
+    m = make_method(env, cls)
+    snap = DisruptionSnapshot(env.cluster, env.provisioner)
+    if hasattr(m, "attach_snapshot"):
+        m.attach_snapshot(snap)
+    cands = get_candidates(env.cluster, env.provisioner, m.should_disrupt,
+                           disrupting_provider_ids=disrupting,
+                           disruption_class=m.disruption_class,
+                           context=snap)
+    budgets = build_disruption_budget_mapping(env.cluster, m.reason)
+    cmd, res = m.compute_command(budgets, cands)
+    return [c.name for c in cands], budgets, summarize(cmd, res)
+
+
+def stream_pass(env, cls, disrupting=()):
+    """The streaming path, THROUGH the controller-owned persistent state."""
+    stream = env.disruption.stream
+    m = make_method(env, cls)
+    snap = stream.refresh(env.cluster, env.provisioner)
+    if hasattr(m, "attach_snapshot"):
+        m.attach_snapshot(snap)
+    cands = stream.candidates_for(m.should_disrupt,
+                                  disrupting_provider_ids=disrupting,
+                                  disruption_class=m.disruption_class)
+    budgets = stream.budget_mapping(m.reason)
+    cmd, res = m.compute_command(budgets, cands)
+    return [c.name for c in cands], budgets, summarize(cmd, res)
+
+
+def assert_parity(env, disrupting=(), methods=METHODS):
+    for cls in methods:
+        got = stream_pass(env, cls, disrupting)
+        want = cold_pass(env, cls, disrupting)
+        assert got == want, (cls.__name__, got, want)
+
+
+def small_fleet(n=6, pods_per_node=(1, 1, 2, 0, 1, 1)):
+    env = make_env()
+    its = sorted(catalog(), key=lambda it: it.name)
+    nodes = []
+    for i in range(n):
+        it = its[i % 7]
+        cores = max(1, it.capacity.get("cpu", 4000) // 1000)
+        nc, node = make_nodeclaim_and_node(
+            env, capacity_type=OD if i % 3 else SPOT, instance_type=it,
+            allocatable={"cpu": str(cores), "memory": "16Gi", "pods": "110"})
+        for _ in range(pods_per_node[i % len(pods_per_node)]):
+            bind_pod(env, node, cpu="100m", memory="128Mi",
+                     labels={"app": "web"})
+        nodes.append((nc, node))
+    env.clock.step(600)
+    env.settle(rounds=1)
+    return env, nodes
+
+
+class TestInvalidationMatrix:
+    """One directed vector per matrix row: the reused/rebuilt layer split
+    is what the row promises, and decisions stay equal to cold."""
+
+    def test_idle_pass_reuses_every_layer(self):
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        snap1 = stream._snapshot
+        enc_map = snap1._encodings
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream._snapshot is snap1
+        assert stream.last["layers"] == {
+            "pods": "reused", "context": "reused", "scheduler": "reused",
+            "encodings": "reused"}
+        assert snap1._encodings is enc_map
+        assert stream.last["rows_rebuilt"] == 0
+        assert stream.last["rows_reused"] == len(env.cluster.nodes)
+        assert_parity(env)
+
+    def test_scheduled_pod_change_rebuilds_pod_layer_and_dirty_row_only(self):
+        env, nodes = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        bind_pod(env, nodes[2][1], cpu="100m", memory="64Mi")
+        env.settle(rounds=1)
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["pods"] == "rebuilt"
+        # the bind changed the node's available(): its exist row must
+        # re-encode, so the scheduler layer rebuilds — but the encode is
+        # delta-applied (only the dirty row, test_node_encode_rows below)
+        assert stream.last["layers"]["scheduler"] == "rebuilt"
+        # the bind bumped ONE node's revision: exactly one row re-derives
+        assert stream.last["rows_rebuilt"] == 1, stream.last
+        assert_parity(env)
+
+    def test_pending_pod_arrival_clears_encodings_keeps_rows(self):
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        env.store.create(Pod(
+            metadata=ObjectMeta(name="pending-1", namespace="default"),
+            spec=PodSpec(),
+            container_requests=[{"cpu": 100, "memory": 64 * 1000}]))
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["pods"] == "rebuilt"
+        assert stream.last["layers"]["encodings"] == "rebuilt"
+        assert stream.last["rows_rebuilt"] == 0
+        assert_parity(env)
+
+    def test_node_update_rebuilds_its_row_and_scheduler(self):
+        env, nodes = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        node = nodes[1][1]
+        live = env.store.get(type(node), node.metadata.name)
+        live.metadata.labels["example.com/extra"] = "yes"
+        env.store.update(live)
+        env.settle(rounds=1)
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["scheduler"] == "rebuilt"
+        assert stream.last["rows_rebuilt"] == 1, stream.last
+        assert_parity(env)
+
+    def test_pdb_change_rederives_rows_but_keeps_encodings(self):
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        snap = stream.refresh(env.cluster, env.provisioner)
+        # force an encoding into the memo so "kept" is observable
+        m = make_method(env, SingleNodeConsolidation)
+        cands = stream.candidates_for(m.should_disrupt)
+        assert cands
+        snap.simulate(cands[:1])
+        enc_keys = set(snap._encodings)
+        assert enc_keys
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="block-web", namespace="default"),
+            spec=PDBSpec(selector=LabelSelector(match_labels={"app": "web"}),
+                         max_unavailable="0")))
+        env.settle(rounds=1)
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["context"] == "rebuilt"
+        assert stream.last["layers"]["encodings"] == "reused"
+        assert set(snap._encodings) == enc_keys
+        # every row re-derives its eviction verdict under the new PDB
+        assert stream.last["rows_rebuilt"] == len(env.cluster.nodes)
+        # and the PDB now blocks: web-bearing nodes are no longer candidates
+        assert_parity(env)
+        names, _, _ = stream_pass(env, SingleNodeConsolidation)
+        blocked = [c.name for c in cands
+                   if any(p.metadata.labels.get("app") == "web"
+                          for p in c.reschedulable_pods)]
+        assert not set(blocked) & set(names)
+
+    def test_nodepool_edit_rebuilds_context_and_scheduler(self):
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        pool = env.store.list(type(consolidation_nodepool()))[0]
+        from karpenter_tpu.api.nodepool import Budget
+        pool.spec.disruption.budgets = [Budget(nodes="1")]
+        env.store.update(pool)
+        env.settle(rounds=1)
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["context"] == "rebuilt"
+        assert stream.last["layers"]["scheduler"] == "rebuilt"
+        assert stream.budget_mapping("underutilized") == \
+            build_disruption_budget_mapping(env.cluster, "underutilized")
+        assert_parity(env)
+
+    def test_nomination_and_deletion_mark_are_live_gates(self):
+        env, nodes = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        node = nodes[0][1]
+        pod = Pod(metadata=ObjectMeta(name="nom", namespace="default"),
+                  spec=PodSpec())
+        env.cluster.nominate_node_for_pod(node.metadata.name, pod)
+        stream.refresh(env.cluster, env.provisioner)
+        # no row rebuilt: nomination is a per-pass mask, not cached state
+        assert stream.last["rows_rebuilt"] == 0
+        assert_parity(env)
+        names, _, _ = stream_pass(env, SingleNodeConsolidation)
+        assert node.metadata.name not in names
+        # expire the nomination, then mark for deletion
+        env.clock.step(30)
+        sn = next(sn for sn in env.cluster.nodes.values()
+                  if sn.name() == node.metadata.name)
+        env.cluster.mark_for_deletion(sn.provider_id)
+        stream.refresh(env.cluster, env.provisioner)
+        names, _, _ = stream_pass(env, SingleNodeConsolidation)
+        assert node.metadata.name not in names
+        assert_parity(env)
+        env.cluster.unmark_for_deletion(sn.provider_id)
+        assert_parity(env)
+
+    def test_budget_mapping_matches_cold_mapping_across_reasons(self):
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        stream.refresh(env.cluster, env.provisioner)
+        for reason in ("underutilized", "empty", "drifted"):
+            assert stream.budget_mapping(reason) == \
+                build_disruption_budget_mapping(env.cluster, reason)
+
+    def test_node_encode_rows_are_delta_applied(self):
+        """The scheduler layer rides the stream's ProblemState: a warm
+        pass re-encodes ZERO node rows, a single node label change
+        re-encodes exactly the dirty row."""
+        env, nodes = small_fleet()
+        stream = env.disruption.stream
+        snap = stream.refresh(env.cluster, env.provisioner)
+        m = make_method(env, SingleNodeConsolidation)
+        cands = stream.candidates_for(m.should_disrupt)
+        snap.simulate(cands)  # forces an encode through build_problem
+        first = stream.problem_state.last["node_rows_reencoded"]
+        assert first == len(snap.state_nodes)
+        # warm: a pending pod invalidates encodings but NOT node rows
+        env.store.create(Pod(
+            metadata=ObjectMeta(name="warm-pending", namespace="default"),
+            spec=PodSpec(),
+            container_requests=[{"cpu": 100, "memory": 64 * 1000}]))
+        snap = stream.refresh(env.cluster, env.provisioner)
+        cands = stream.candidates_for(m.should_disrupt)
+        snap.simulate(cands)
+        assert stream.problem_state.last["node_rows_reencoded"] == 0
+        assert stream.problem_state.last["encode_kind"] == "delta"
+
+
+SEEDS = list(range(8100, 8106))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_streaming_churn_fuzzer_matches_cold_every_step(seed):
+    """Seeded churn: after EVERY mutation the streaming pass (accumulated
+    deltas) must agree with a cold rebuild for all four methods."""
+    rng = random.Random(seed)
+    env, nodes = small_fleet(n=8)
+    its = sorted(catalog(), key=lambda it: it.name)
+    assert_parity(env)
+    pools = env.store.list(type(consolidation_nodepool()))
+    seq = 0
+    for step in range(10):
+        action = rng.choice(
+            ["bind", "unbind", "pending", "add_node", "pdb", "budget",
+             "nominate", "mark", "drift"])
+        seq += 1
+        if action == "bind":
+            _, node = rng.choice(nodes)
+            if env.store.get(type(node), node.metadata.name) is not None:
+                bind_pod(env, node, cpu="100m", memory="64Mi",
+                         labels={"app": rng.choice(("web", "api"))})
+        elif action == "unbind":
+            pods = [p for p in env.store.list(Pod) if p.spec.node_name]
+            if pods:
+                env.store.delete(rng.choice(pods))
+        elif action == "pending":
+            env.store.create(Pod(
+                metadata=ObjectMeta(name=f"churn-pend-{seed}-{seq}",
+                                    namespace="default"),
+                spec=PodSpec(),
+                container_requests=[{"cpu": 50, "memory": 32 * 1000}]))
+        elif action == "add_node":
+            it = rng.choice(its[:7])
+            cores = max(1, it.capacity.get("cpu", 4000) // 1000)
+            nc, node = make_nodeclaim_and_node(
+                env, capacity_type=OD, instance_type=it,
+                allocatable={"cpu": str(cores), "memory": "16Gi",
+                             "pods": "110"})
+            nodes.append((nc, node))
+            env.clock.step(600)
+        elif action == "pdb":
+            env.store.create(PodDisruptionBudget(
+                metadata=ObjectMeta(name=f"churn-pdb-{seed}-{seq}",
+                                    namespace="default"),
+                spec=PDBSpec(
+                    selector=LabelSelector(
+                        match_labels={"app": rng.choice(("web", "api"))}),
+                    max_unavailable=rng.choice(("0", "1")))))
+        elif action == "budget":
+            from karpenter_tpu.api.nodepool import Budget
+            pool = rng.choice(pools)
+            pool.spec.disruption.budgets = [
+                Budget(nodes=rng.choice(("0", "1", "50%", "100%")))]
+            env.store.update(pool)
+        elif action == "nominate":
+            _, node = rng.choice(nodes)
+            env.cluster.nominate_node_for_pod(
+                node.metadata.name,
+                Pod(metadata=ObjectMeta(name=f"nom-{seq}",
+                                        namespace="default"),
+                    spec=PodSpec()))
+        elif action == "mark":
+            sn = rng.choice(list(env.cluster.nodes.values()))
+            if rng.random() < 0.5:
+                env.cluster.mark_for_deletion(sn.provider_id)
+            else:
+                env.cluster.unmark_for_deletion(sn.provider_id)
+        elif action == "drift":
+            nc, _ = rng.choice(nodes)
+            live = env.store.get(type(nc), nc.name)
+            if live is not None:
+                live.metadata.annotations[
+                    api_labels.NODEPOOL_HASH_ANNOTATION_KEY] = "stale"
+                from karpenter_tpu.api.nodepool import NODEPOOL_HASH_VERSION
+                live.metadata.annotations[
+                    api_labels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = \
+                    NODEPOOL_HASH_VERSION
+                env.store.update(live)
+        env.settle(rounds=1)
+        if rng.random() < 0.3:
+            env.clock.step(rng.choice((1, 30, 400)))
+        assert_parity(env)
+
+
+def test_controller_pass_uses_streaming_state():
+    """End to end through DisruptionController.reconcile: the second pass
+    is served warm (rows reused) and still finds the same decision a cold
+    controller would."""
+    env, nodes = small_fleet()
+    env.disruption.reconcile()
+    stream = env.disruption.stream
+    assert stream.stats["passes"] == 1
+    env.disruption.pending = None  # drop any TTL wait; fresh decision
+    env.disruption.reconcile()
+    assert stream.stats["passes"] == 2
+    assert stream.last["rows_reused"] == len(env.cluster.nodes)
+    assert stream.last["rows_rebuilt"] == 0
+
+
+class TestReviewRegressionPins:
+    """Pins for the two parity bugs the PR review caught: the pinned
+    catalog token must describe the scheduler's OWN pool ordering, and
+    the encodings token must see drought-mask TTL expiry."""
+
+    def test_catalog_token_matches_scheduler_pool_order(self):
+        """Per-pool instance-type lists + a weight swap: the pinned
+        catalog token must be computed over the weight-ordered, IT-less-
+        pools-dropped ordering _build_scheduler hands the scheduler —
+        _ordered_union is order-sensitive, and a name-ordered token would
+        key the device-encoding cache with misaligned IT columns."""
+        from expectations import Env
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.provisioning.tensor_scheduler import \
+            catalog_cache_token
+
+        its = sorted(catalog(), key=lambda it: it.name)
+
+        class PerPoolProvider(KwokCloudProvider):
+            def get_instance_types(self, nodepool):
+                if getattr(nodepool, "name", "") == "b-pool":
+                    return its[:40]
+                return its[20:60]
+
+        env = Env(provider=lambda store: PerPoolProvider(store=store))
+        pool_a = consolidation_nodepool(name="a-pool")
+        pool_a.spec.weight = 10
+        pool_b = consolidation_nodepool(name="b-pool")
+        pool_b.spec.weight = 50
+        env.store.create(pool_a)
+        env.store.create(pool_b)
+        for i in range(3):
+            _, node = make_nodeclaim_and_node(
+                env, capacity_type=OD, instance_type=its[25],
+                allocatable={"cpu": "4", "memory": "16Gi", "pods": "110"},
+                nodepool="b-pool" if i % 2 else "a-pool")
+            bind_pod(env, node, cpu="100m", memory="64Mi")
+        env.clock.step(600)
+        env.settle(rounds=1)
+
+        stream = env.disruption.stream
+        for _ in range(2):  # before and after the weight swap
+            snap = stream.refresh(env.cluster, env.provisioner)
+            # the structural invariant: the pinned token equals the token
+            # of the scheduler's OWN (weight-ordered) pool list
+            assert stream._tok["catalog"] == catalog_cache_token(
+                snap.nodepools, snap.instance_types_by_pool)
+            assert_parity(env)
+            pool_a.spec.weight, pool_b.spec.weight = \
+                pool_b.spec.weight, pool_a.spec.weight
+            env.store.update(pool_a)
+            env.store.update(pool_b)
+            env.settle(rounds=1)
+
+    def test_drought_mask_ttl_expiry_invalidates_encodings(self):
+        """An unavailable-offerings entry whose TTL lapses WITHOUT any
+        intervening provisioner reconcile (nothing called expire()) must
+        still invalidate the reused encodings: a cold rebuild would prune
+        the entry and encode without the mask, and the streaming pass
+        must match it (the token reads live(), which prunes)."""
+        env, _ = small_fleet()
+        stream = env.disruption.stream
+        snap = stream.refresh(env.cluster, env.provisioner)
+        m = make_method(env, SingleNodeConsolidation)
+        cands = stream.candidates_for(m.should_disrupt)
+        snap.simulate(cands[:1])  # seed the encoding memo
+        env.unavailable.mark(zone="test-zone-a")
+        snap = stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["encodings"] == "rebuilt"
+        snap.simulate(cands[:1])
+        # the TTL lapses silently: no reconcile, no expire() call
+        env.clock.step(400)
+        stream.refresh(env.cluster, env.provisioner)
+        assert stream.last["layers"]["encodings"] == "rebuilt", (
+            "lapsed drought mask kept stale encodings alive")
+        assert_parity(env)

@@ -38,6 +38,11 @@ if REPO not in sys.path:  # runnable from anywhere, venv or not
 GOLDEN_PATH = os.path.join(REPO, "tests", "goldens", "sim-regression.json")
 SCENARIO = "mixed-day.yaml"
 CLIP_SECONDS = 7200.0
+# every (scenario, clip) pair the gate pins; the first entry is the
+# historical mixed-day pin, disruption-wave (ISSUE 14) clips past its
+# drift wave so the streaming disruption engine's decisions are part of
+# the byte-exact contract
+SCENARIOS = ((SCENARIO, CLIP_SECONDS), ("disruption-wave.yaml", 9000.0))
 
 # report sections whose KEYS are data (shape classes seen, event kinds
 # applied, ...): compared as opaque "dict" leaves, not recursed — their
@@ -67,23 +72,25 @@ def report_shape(obj, prefix: str = "") -> list:
     return [f"{path}:str"]
 
 
-def run_clipped(clip_seconds: float = CLIP_SECONDS) -> dict:
-    """One clipped deterministic run of the library scenario; returns the
+def run_clipped(clip_seconds: float = CLIP_SECONDS,
+                scenario: str = SCENARIO) -> dict:
+    """One clipped deterministic run of a library scenario; returns the
     report dict (ledger digest included)."""
     import karpenter_tpu.sim as sim_pkg
     from karpenter_tpu.sim import FleetSimulator, load_scenario
     sc = load_scenario(os.path.join(os.path.dirname(sim_pkg.__file__),
-                                    "scenarios", SCENARIO))
+                                    "scenarios", scenario))
     clip = min(clip_seconds, sc.duration)
     sc.events = [e for e in sc.events if e.at <= clip]
     sc.duration = clip
     return FleetSimulator(sc).run()
 
 
-def current_pin(clip_seconds: float = CLIP_SECONDS) -> dict:
-    report = run_clipped(clip_seconds)
+def current_pin(clip_seconds: float = CLIP_SECONDS,
+                scenario: str = SCENARIO) -> dict:
+    report = run_clipped(clip_seconds, scenario)
     return {
-        "scenario": SCENARIO,
+        "scenario": scenario,
         "clip_seconds": clip_seconds,
         "ledger_digest": report["ledger_digest"],
         "ledger_entries": report["ledger_entries"],
@@ -91,8 +98,35 @@ def current_pin(clip_seconds: float = CLIP_SECONDS) -> dict:
     }
 
 
+def current_pins() -> dict:
+    """Every pinned scenario's clipped pin (the golden's v2 shape)."""
+    return {"pins": [current_pin(clip, scenario)
+                     for scenario, clip in SCENARIOS]}
+
+
+def _golden_pins(golden: dict) -> list:
+    """v2 golden ({"pins": [...]}) or the legacy single-pin dict."""
+    return golden["pins"] if "pins" in golden else [golden]
+
+
 def compare(pin: dict, golden: dict) -> list:
-    """Human-readable mismatch lines ([] = green)."""
+    """Human-readable mismatch lines ([] = green). Accepts either one
+    pin vs one golden entry, or the v2 multi-scenario shapes."""
+    if "pins" in pin or "pins" in golden:
+        cur = {p["scenario"]: p for p in _golden_pins(pin)}
+        want = {p["scenario"]: p for p in _golden_pins(golden)}
+        problems = []
+        for name in sorted(set(cur) | set(want)):
+            if name not in want:
+                problems.append(
+                    f"scenario {name!r} has no golden pin — regenerate")
+            elif name not in cur:
+                problems.append(
+                    f"pinned scenario {name!r} no longer runs — regenerate")
+            else:
+                problems.extend(f"[{name}] {p}"
+                                for p in compare(cur[name], want[name]))
+        return problems
     problems = []
     if pin["ledger_digest"] != golden["ledger_digest"]:
         problems.append(
@@ -121,16 +155,18 @@ def main(argv=None, pin: dict = None) -> int:
                         help=f"golden file (default {GOLDEN_PATH})")
     args = parser.parse_args(argv)
     if pin is None:
-        pin = current_pin()
+        pin = current_pins()
     if args.update:
         os.makedirs(os.path.dirname(args.golden), exist_ok=True)
         with open(args.golden, "w") as f:
             json.dump(pin, f, indent=1, sort_keys=True)
             f.write("\n")
-        print(f"golden updated: {args.golden}\n"
-              f"  ledger_digest {pin['ledger_digest'][:16]}… "
-              f"({pin['ledger_entries']} entries, "
-              f"{len(pin['report_shape'])} report keys)")
+        lines = "\n".join(
+            f"  [{p['scenario']}] ledger_digest {p['ledger_digest'][:16]}… "
+            f"({p['ledger_entries']} entries, "
+            f"{len(p['report_shape'])} report keys)"
+            for p in _golden_pins(pin))
+        print(f"golden updated: {args.golden}\n{lines}")
         return 0
     if not os.path.exists(args.golden):
         print(f"sim regression gate: no golden at {args.golden}\n"
@@ -140,16 +176,18 @@ def main(argv=None, pin: dict = None) -> int:
     with open(args.golden) as f:
         golden = json.load(f)
     problems = compare(pin, golden)
+    names = ", ".join(p["scenario"] for p in _golden_pins(golden))
     if problems:
         print("sim regression gate FAILED — the clipped "
-              f"{golden['scenario']} replay diverged from the pin:\n"
+              f"{names} replays diverged from the pin:\n"
               + "\n".join(f"- {p}" for p in problems)
               + "\n\nIf this behavior change is intentional, refresh the "
                 "pin and commit it:\n    python tools/sim_regression.py "
                 "--update", file=sys.stderr)
         return 1
-    print(f"sim regression gate green: digest "
-          f"{pin['ledger_digest'][:16]}… matches the pin")
+    digests = " ".join(p["ledger_digest"][:16] + "…"
+                       for p in _golden_pins(pin))
+    print(f"sim regression gate green: digests {digests} match the pin")
     return 0
 
 
