@@ -466,6 +466,32 @@ SIDECAR_CLIENT_HEDGES = REGISTRY.counter(
     "dedupes by request digest)",
     ("outcome",), max_series=8)
 
+# -- replicated sidecar fleet (ISSUE 17): session checkpoint/migration,
+# consistent-hash tenant routing, zero-downtime rolling restarts. ------------
+
+SIDECAR_MIGRATIONS = REGISTRY.counter(
+    "karpenter_sidecar_migrations_total",
+    "Session checkpoint movements in a sidecar fleet: 'drain' = exported "
+    "to the handoff store by a draining replica, 'restore' = rebuilt warm "
+    "on a peer from its checkpoint, 'rollback' = a digest-mismatched "
+    "session reloaded from its last acked checkpoint for delta catch-up, "
+    "'restore_rejected' = a checkpoint the codec loudly refused "
+    "(corrupt/truncated/version skew), 'export_error' = a post-solve "
+    "checkpoint write that failed",
+    ("reason",), max_series=16)
+SIDECAR_REPLICA_SESSIONS = REGISTRY.gauge(
+    "karpenter_sidecar_replica_sessions",
+    "Live delta sessions held by each sidecar fleet replica (bounded "
+    "replica label)",
+    ("replica",), max_series=32)
+SIDECAR_REPLICA_FAILOVERS = REGISTRY.counter(
+    "karpenter_sidecar_replica_failovers_total",
+    "Client-side replica switches by the consistent-hash fleet router: "
+    "'migrated' = followed a draining replica's migrated_to rider, "
+    "'unavailable' = re-routed to the ring successor after consecutive "
+    "UNAVAILABLE answers marked the replica down",
+    ("reason",), max_series=8)
+
 # -- whole-fleet causal observability (ISSUE 12) ---------------------------
 # Fallback cost ledger: every host-oracle escape classified by the shape
 # class that forced it (obs/fallbacks.py), so ROADMAP item 1 gets its

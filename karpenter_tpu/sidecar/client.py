@@ -8,10 +8,14 @@ star.
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import os
 import random
+import re
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -29,10 +33,16 @@ from .server import SERVICE
 # request digest (at-most-once apply) and the one-shot Solve is stateless.
 _RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED,
               grpc.StatusCode.RESOURCE_EXHAUSTED)
+# fleet mode additionally retries CANCELLED: a replica hard-stopping mid-RPC
+# cancels the in-flight call, and the request-digest dedupe makes resending
+# the identical bytes to the ring successor at-most-once apply. Single-server
+# clients keep the narrow set — there is nowhere else to send the retry.
+_RETRYABLE_FLEET = _RETRYABLE + (grpc.StatusCode.CANCELLED,)
 _RETRY_LABELS = {
     grpc.StatusCode.UNAVAILABLE: "unavailable",
     grpc.StatusCode.DEADLINE_EXCEEDED: "deadline_exceeded",
     grpc.StatusCode.RESOURCE_EXHAUSTED: "resource_exhausted",
+    grpc.StatusCode.CANCELLED: "cancelled",
 }
 
 
@@ -80,7 +90,7 @@ class RetryPolicy:
 
 
 def _retry_attempts(attempt, rp: RetryPolicy, rng: random.Random,
-                    spend_token, refund_token):
+                    spend_token, refund_token, retryable=_RETRYABLE):
     """The one attempt loop both client surfaces share: retryable wire
     faults (UNAVAILABLE / DEADLINE_EXCEEDED) back off with jitter and
     resend the IDENTICAL bytes until max_attempts or the token retry
@@ -95,7 +105,7 @@ def _retry_attempts(attempt, rp: RetryPolicy, rng: random.Random,
             response = attempt()
         except grpc.RpcError as e:
             code = getattr(e, "code", lambda: None)()
-            if code not in _RETRYABLE or attempt_no >= rp.max_attempts \
+            if code not in retryable or attempt_no >= rp.max_attempts \
                     or not spend_token():
                 raise
             SIDECAR_CLIENT_RETRIES.inc({"code": _RETRY_LABELS[code]})
@@ -125,6 +135,91 @@ class _RetryBudgetMixin:
     def _refund_retry_token(self) -> None:
         self._retry_tokens = min(self.retry.retry_budget,
                                  self._retry_tokens + self.retry.refund)
+
+
+# -- sidecar fleet routing (ISSUE 17) ------------------------------------------
+
+
+def _parse_rider(details: str, key: str) -> str:
+    """Extract a `[key=value]` rider from a gRPC status detail string — the
+    fleet servers attach structured hints (migrated_to on a draining NACK,
+    server_digest on a digest-mismatch abort) inside the human-readable
+    message so no wire schema change is needed for error metadata."""
+    m = re.search(rf"\[{re.escape(key)}=([^\]\s]+)\]", details or "")
+    return m.group(1) if m else ""
+
+
+def _default_channel_factory(address: str) -> grpc.Channel:
+    from .server import GRPC_OPTIONS
+    return grpc.insecure_channel(address, options=GRPC_OPTIONS)
+
+
+class ConsistentHashRouter:
+    """Consistent-hash ring over the fleet's replica addresses: a tenant
+    always lands on the same replica while the fleet is stable (session
+    affinity keeps the server-side delta mirrors warm), adding/removing a
+    replica only moves ~1/N of tenants, and a down replica's tenants walk
+    to the ring SUCCESSOR — the same replica every client picks without
+    coordination, so the handoff-store restore happens exactly once.
+    mark_down() is a cooldown, not a tombstone: after `cooldown` seconds
+    the replica is routable again (a restarted process rejoins without any
+    control-plane signal)."""
+
+    def __init__(self, addresses, vnodes: int = 64, cooldown: float = 5.0,
+                 clock=time.monotonic):
+        self.addresses = tuple(dict.fromkeys(addresses))
+        if not self.addresses:
+            raise ValueError("fleet router needs at least one replica "
+                             "address")
+        self.vnodes = max(1, int(vnodes))
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self._down: Dict[str, float] = {}
+        ring = sorted((self._point(f"{addr}#{v}"), addr)
+                      for addr in self.addresses
+                      for v in range(self.vnodes))
+        self._ring = ring
+        self._keys = [k for k, _ in ring]
+
+    @staticmethod
+    def _point(key: str) -> int:
+        return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8],
+                              "big")
+
+    def mark_down(self, address: str) -> None:
+        self._down[address] = self._clock()
+
+    def mark_up(self, address: str) -> None:
+        self._down.pop(address, None)
+
+    def _alive(self, address: str) -> bool:
+        stamp = self._down.get(address)
+        if stamp is None:
+            return True
+        if self._clock() - stamp >= self.cooldown:
+            del self._down[address]
+            return True
+        return False
+
+    def _walk(self, key: str, exclude=()) -> str:
+        start = bisect.bisect(self._keys, self._point(key))
+        seen = set()
+        for step in range(len(self._ring)):
+            addr = self._ring[(start + step) % len(self._ring)][1]
+            if addr in seen:
+                continue
+            seen.add(addr)
+            if addr not in exclude and self._alive(addr):
+                return addr
+        # the whole fleet is down/excluded: hand back the raw ring owner —
+        # retry backoff (not the router) is the right tool from here
+        return self._ring[start % len(self._ring)][1]
+
+    def route(self, tenant: str) -> str:
+        return self._walk(tenant or "default")
+
+    def successor(self, tenant: str, exclude=()) -> str:
+        return self._walk(tenant or "default", exclude=tuple(exclude))
 
 
 @dataclass
@@ -284,9 +379,116 @@ class SolverSession(_RetryBudgetMixin):
         self.last_parity = ""
         self.last_queue_wait_ms = 0.0
         self._hedged_last = False
+        # -- fleet routing (ISSUE 17) -----------------------------------------
+        # consistent-hash router over N replica addresses (enable_fleet);
+        # committed-state history backs the digest-rider catch-up: when a
+        # restored replica reports an OLDER digest we roll the mirrors back
+        # to that acked state and resend only the delta since — a bounded
+        # catch-up instead of a full resync
+        self.router: Optional[ConsistentHashRouter] = None
+        self._channel_factory = _default_channel_factory
+        self._unavailable_streak = 0
+        self.failovers = 0           # replica switches (fleet mode)
+        self.catchups = 0            # digest-rider rollbacks that avoided
+        #                              a full resync
+        self._digest_history: deque = deque(maxlen=8)
 
     def close(self) -> None:
         self._channel.close()
+
+    # -- fleet routing ---------------------------------------------------------
+
+    def enable_fleet(self, addresses, channel_factory=None) -> None:
+        """Route this session's tenant across a replica fleet: build the
+        consistent-hash ring, dial the tenant's home replica, and make
+        every subsequent UNAVAILABLE answer failover-aware (migrated_to
+        rider → follow the drain's named peer; repeated connection-level
+        UNAVAILABLE → mark the replica down and walk to the ring
+        successor). Safe to call on a live session — the existing retry/
+        hedge/dedupe machinery is unchanged, only the channel management
+        moves under the router."""
+        if channel_factory is not None:
+            self._channel_factory = channel_factory
+        self.router = ConsistentHashRouter(addresses)
+        self._switch_address(self.router.route(self.tenant))
+
+    def _switch_address(self, address: str) -> None:
+        old = self._channel
+        self.address = address
+        self._channel = self._channel_factory(address)
+        try:
+            old.close()
+        except Exception:
+            pass
+
+    def _failover(self, address: str, reason: str) -> None:
+        from ..metrics.registry import SIDECAR_REPLICA_FAILOVERS
+        SIDECAR_REPLICA_FAILOVERS.inc({"reason": reason})
+        self.failovers += 1
+        self._unavailable_streak = 0
+        self._switch_address(address)
+
+    def _fleet_attempt(self, method: str, payload: bytes) -> bytes:
+        """One attempt through the router: an UNAVAILABLE answer re-aims
+        the channel BEFORE _retry_attempts' backoff fires, so the retry of
+        the identical bytes lands on a live replica (the server-side
+        handoff restore + request-digest dedupe make that seamless — the
+        peer either replays the cached response or applies the delta onto
+        the checkpointed state)."""
+        try:
+            response = self._call_hedged(method, payload)
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            if code == grpc.StatusCode.UNAVAILABLE:
+                details = getattr(e, "details", lambda: "")() or ""
+                target = _parse_rider(details, "migrated_to")
+                if target:
+                    # a draining replica told us exactly where its
+                    # sessions went: follow it, and keep the drainer off
+                    # the ring until the cooldown (its restart) passes
+                    self.router.mark_down(self.address)
+                    self._failover(target, "migrated")
+                else:
+                    self._unavailable_streak += 1
+                    if self._unavailable_streak >= 2:
+                        # connection-level failure (killed process, no
+                        # drain): mark it down and walk the ring
+                        self.router.mark_down(self.address)
+                        succ = self.router.successor(
+                            self.tenant, exclude=(self.address,))
+                        if succ != self.address:
+                            self._failover(succ, "unavailable")
+            elif code == grpc.StatusCode.CANCELLED:
+                # a replica stopping mid-RPC cancels the in-flight call:
+                # same treatment as a connection-level UNAVAILABLE — the
+                # dedupe cache makes the resend at-most-once apply
+                self._unavailable_streak += 1
+                if self._unavailable_streak >= 2:
+                    self.router.mark_down(self.address)
+                    succ = self.router.successor(
+                        self.tenant, exclude=(self.address,))
+                    if succ != self.address:
+                        self._failover(succ, "unavailable")
+            raise
+        self._unavailable_streak = 0
+        self.router.mark_up(self.address)
+        return response
+
+    def _rollback_to(self, digest: str) -> bool:
+        """Roll the delta mirrors back to the acked state whose digest a
+        restored replica reported (the server_digest rider): the next
+        _delta_request diffs against THAT state, producing the bounded
+        catch-up delta instead of a full snapshot."""
+        for past, state in reversed(self._digest_history):
+            if past == digest:
+                (self._tmpl_ids, self._tmpl_keys, self._tmpl_constrained,
+                 self._tmpl_digest, self._rows, self._pod_rows,
+                 self._node_tokens, self._node_revs, self._node_dicts,
+                 self._ds_sent, self._ds_token,
+                 self._cluster_token) = state
+                self._synced = True
+                return True
+        return False
 
     def force_resync(self) -> None:
         """Drop every delta mirror: the next solve ships a full snapshot
@@ -360,9 +562,14 @@ class SolverSession(_RetryBudgetMixin):
         non-retryable statuses propagate to the structural handler in
         solve() (NOT_FOUND -> session recreate, FAILED_PRECONDITION ->
         resync)."""
+        attempt = ((lambda: self._fleet_attempt(method, payload))
+                   if self.router is not None
+                   else (lambda: self._call_hedged(method, payload)))
         response, retries = _retry_attempts(
-            lambda: self._call_hedged(method, payload), self.retry,
-            self._rng, self._spend_retry_token, self._refund_retry_token)
+            attempt, self.retry,
+            self._rng, self._spend_retry_token, self._refund_retry_token,
+            retryable=(_RETRYABLE_FLEET if self.router is not None
+                       else _RETRYABLE))
         self.retries += retries
         return response
 
@@ -628,6 +835,14 @@ class SolverSession(_RetryBudgetMixin):
             self._ds_sent = ds
             self._ds_token = ds_token
             self._cluster_token = cluster_token
+            # committed-state history for the fleet digest catch-up:
+            # aliasing is safe — every value above is freshly built per
+            # request (_delta_request copies the mirrors before mutating)
+            # and commit only ever REBINDS the attributes
+            self._digest_history.append((header["digest"], (
+                tmpl_ids, tmpl_keys, tmpl_constrained, tmpl_digest,
+                merged, new_pod_rows, node_tokens, node_revs, node_dicts,
+                ds, ds_token, cluster_token)))
 
         by_uid = {p.uid: p for p in pods}
         order = [by_uid[r[0]] for r in merged]
@@ -728,11 +943,23 @@ class SolverSession(_RetryBudgetMixin):
                     # rejected BEFORE the handshake (e.g. a retry-budget
                     # exhaustion left our template/row mirrors behind the
                     # server's, so re-sent registrations violate
-                    # contiguity). Both mean the mirrors can't be trusted:
-                    # full-snapshot resync and rebuild — a genuinely
-                    # broken request fails again and raises.
-                    self.resyncs += 1
-                    self.force_resync()
+                    # contiguity). Both mean the mirrors can't be trusted.
+                    # Fleet catch-up first: a restored/rolled-back replica
+                    # reports the digest of the acked state it HOLDS in a
+                    # [server_digest=..] rider — if that state is in our
+                    # committed history, roll the mirrors back to it and
+                    # resend only the delta since (bounded catch-up). A
+                    # full-snapshot resync is the last resort.
+                    server_digest = ""
+                    if code == grpc.StatusCode.FAILED_PRECONDITION:
+                        server_digest = _parse_rider(
+                            getattr(e, "details", lambda: "")() or "",
+                            "server_digest")
+                    if server_digest and self._rollback_to(server_digest):
+                        self.catchups += 1
+                    else:
+                        self.resyncs += 1
+                        self.force_resync()
                 else:
                     raise
         commit()

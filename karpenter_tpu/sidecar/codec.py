@@ -1198,3 +1198,166 @@ def encode_solve_response(results, fallback_reason: str = "") -> bytes:
 
 def decode_solve_response(data: bytes) -> dict:
     return json.loads(data.decode())
+
+
+# -- session checkpoints (fleet migration) ------------------------------------
+#
+# A checkpoint serializes everything a server-side `_Session` IS — the
+# template table, pod row columns, state-node mirrors and their revision
+# tokens, daemonset/cluster snapshots and tokens, the dedupe nonces
+# (last_req_seq + response cache) and the last acked state digest — so a
+# session can be rebuilt on ANY replica without the client re-sending full
+# state. Checkpoints ride the same KTPW framing as delta solves and follow
+# the same loud-reject rules: a truncated frame, an unexpected message
+# kind, an unknown checkpoint schema version or a digest that does not
+# recompute from the restored parts all refuse loudly instead of
+# resurrecting a half-understood session.
+#
+# Version skew is one-directional, like the delta schema above: replicas
+# both PRODUCE and CONSUME checkpoints, so the whole fleet rolls before
+# any replica starts emitting a newer `ckpt` version (roll servers first;
+# a mixed fleet mid-roll only ever hands newer readers older frames).
+
+CHECKPOINT_KIND = "session_checkpoint"
+CHECKPOINT_SCHEMA_VERSION = 1
+CHECKPOINT_SCHEMA_ACCEPTED = (1,)
+
+
+class CheckpointVersionError(ValueError):
+    """An unknown session-checkpoint schema version: refuse loudly instead
+    of misparsing half-understood session state into a silently-wrong
+    restore (the DeltaVersionError contract, applied to migration)."""
+
+
+def check_checkpoint_version(header: dict) -> None:
+    v = header.get("ckpt")
+    if v not in CHECKPOINT_SCHEMA_ACCEPTED:
+        raise CheckpointVersionError(
+            f"unknown session checkpoint schema version {v!r} (this end "
+            f"speaks v{CHECKPOINT_SCHEMA_VERSION}, accepts "
+            f"{list(CHECKPOINT_SCHEMA_ACCEPTED)}); refusing to guess at a "
+            "session's state — roll every sidecar replica before emitting "
+            "newer checkpoints")
+
+
+def encode_session_checkpoint(st: dict) -> bytes:
+    """Serialize a session-state dict (the server's `_Session` bridged to
+    plain JSON shapes + the raw bootstrap payload bytes) into one KTPW
+    checkpoint frame. Pod rows ride as typed columns; the response cache
+    rides as one concatenated blob with (digest, length) offsets."""
+    from . import wire
+    rows = st.get("rows", [])
+    responses = [(k, bytes(v)) for k, v in st.get("responses", ())]
+    header = {
+        "kind": CHECKPOINT_KIND,
+        "ckpt": CHECKPOINT_SCHEMA_VERSION,
+        # the delta schema the mirrors speak: a restore onto a replica
+        # that cannot speak this wire version must reject up front, not
+        # fail every subsequent solve
+        "v": DELTA_SCHEMA_VERSION,
+        "session": st["session"],
+        "tenant": st.get("tenant", ""),
+        "templates": list(st.get("templates", ())),
+        "state_nodes": list(st.get("state_nodes", ())),
+        "state_revs": {str(k): str(v)
+                       for k, v in st.get("state_revs", {}).items()},
+        "daemonset": list(st.get("daemonset", ())),
+        "ds_token": str(st.get("ds_token", "")),
+        "cluster": st.get("cluster"),
+        "cluster_token": str(st.get("cluster_token", "")),
+        "topo_revision": int(st.get("topo_revision", 0)),
+        "last_req_seq": int(st.get("last_req_seq", 0)),
+        "responses": [[k, len(v)] for k, v in responses],
+        "counters": {k: int(st.get("counters", {}).get(k, 0))
+                     for k in ("solves", "resyncs", "dedup_hits")},
+        "digest": str(st.get("digest", "")),
+    }
+    blobs = {
+        "row_tid": wire.pack_u32([r[0] for r in rows]),
+        "row_ts": wire.pack_f64([r[1] for r in rows]),
+        "bootstrap": bytes(st["bootstrap"]),
+    }
+    if responses:
+        blobs["responses"] = b"".join(v for _k, v in responses)
+    return wire.pack(header, blobs)
+
+
+def decode_session_checkpoint(data: bytes) -> dict:
+    """Parse + verify one checkpoint frame back into the session-state
+    dict shape encode_session_checkpoint consumed. Loud rejects: ValueError
+    on truncation/bad framing/missing fields, CheckpointVersionError on an
+    unknown `ckpt` version, DeltaVersionError on a delta-wire skew, and
+    DigestMismatchError when the recomputed state digest disagrees with
+    the frame's — a corrupt checkpoint must never become a live session."""
+    from . import wire
+    try:
+        header, blobs = wire.unpack(data)
+    except ValueError:
+        raise
+    except Exception as e:
+        raise ValueError(f"truncated or corrupt checkpoint frame: {e}")
+    if header.get("kind") != CHECKPOINT_KIND:
+        raise ValueError(
+            f"not a session checkpoint frame (kind={header.get('kind')!r})")
+    check_checkpoint_version(header)
+    check_delta_version(header)
+    for key in ("session", "templates", "state_nodes", "state_revs",
+                "daemonset", "ds_token", "cluster_token", "topo_revision",
+                "last_req_seq", "digest"):
+        if key not in header:
+            raise ValueError(f"checkpoint frame missing field {key!r}")
+    for blob in ("row_tid", "row_ts", "bootstrap"):
+        if blob not in blobs:
+            raise ValueError(f"checkpoint frame missing blob {blob!r}")
+    tids = wire.unpack_u32(blobs["row_tid"]).tolist()
+    tss = [float(x) for x in wire.unpack_f64(blobs["row_ts"]).tolist()]
+    if len(tids) != len(tss):
+        raise ValueError(
+            f"checkpoint row columns disagree ({len(tids)} template ids, "
+            f"{len(tss)} timestamps)")
+    n_templates = len(header["templates"])
+    for tid in tids:
+        if tid >= n_templates:
+            raise ValueError(
+                f"checkpoint pod row references template {tid} but the "
+                f"table has {n_templates} entries")
+    buf = bytes(blobs.get("responses", b""))
+    responses, off = [], 0
+    for item in header.get("responses", ()):
+        k, n = str(item[0]), int(item[1])
+        responses.append((k, buf[off:off + n]))
+        off += n
+    if off != len(buf):
+        raise ValueError(
+            f"checkpoint response-cache blob length mismatch (offsets "
+            f"cover {off} bytes, blob has {len(buf)})")
+    # the content-digest handshake, applied to the restore: the frame's
+    # digest must recompute from the restored parts byte-for-byte, exactly
+    # as the client's next delta solve will expect
+    keys = [template_content_key(d) for d in header["templates"]]
+    digest = batch_digest(tids, tss, templates_digest(keys),
+                          header["state_revs"], header["ds_token"],
+                          header["cluster_token"])
+    want = str(header.get("digest", ""))
+    if want and digest != want:
+        raise DigestMismatchError(
+            f"checkpoint digest mismatch (frame {want[:12]}.. != restored "
+            f"{digest[:12]}..): refusing to resurrect a corrupt session")
+    return {
+        "session": str(header["session"]),
+        "tenant": str(header.get("tenant", "")),
+        "templates": list(header["templates"]),
+        "rows": list(zip(tids, tss)),
+        "state_nodes": list(header["state_nodes"]),
+        "state_revs": dict(header["state_revs"]),
+        "daemonset": list(header["daemonset"]),
+        "ds_token": str(header["ds_token"]),
+        "cluster": header.get("cluster"),
+        "cluster_token": str(header["cluster_token"]),
+        "topo_revision": int(header["topo_revision"]),
+        "last_req_seq": int(header["last_req_seq"]),
+        "responses": responses,
+        "counters": dict(header.get("counters", {})),
+        "digest": want or digest,
+        "bootstrap": bytes(blobs["bootstrap"]),
+    }

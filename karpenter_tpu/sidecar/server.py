@@ -132,17 +132,54 @@ class _Session:
         self.dedup_hits = 0
         self.last_digest = ""        # post-apply state digest of the last solve
         self.last_solve_at = 0.0     # monotonic stamp of the last solve
+        # -- fleet checkpoint sources (export_session_checkpoint) -------------
+        # the exact CreateSession payload bytes plus the RAW daemonset /
+        # cluster dicts off the wire, kept by reference: the per-solve
+        # checkpoint export reuses them instead of re-serializing
+        # catalog-sized state on every solve
+        self.bootstrap: bytes = b""
+        self.daemonset_raw: list = []
+        self.cluster_raw: Optional[dict] = None
+
+
+def _max_sessions_from_env(default: int = 8) -> int:
+    """Session-table bound from $KARPENTER_SIDECAR_MAX_SESSIONS. A typo'd
+    value must fail LOUDLY at boot (the KARPENTER_LOO_MIN_CANDIDATES
+    contract): silently falling back to the default would let an operator
+    believe a larger fleet of tenants fits than the LRU will actually
+    keep."""
+    raw = os.environ.get("KARPENTER_SIDECAR_MAX_SESSIONS")
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"invalid KARPENTER_SIDECAR_MAX_SESSIONS={raw!r}: must be a "
+            "positive integer (concurrent delta sessions one replica keeps "
+            "before LRU eviction)")
+    if value <= 0:
+        raise SystemExit(
+            f"invalid KARPENTER_SIDECAR_MAX_SESSIONS={raw!r}: must be a "
+            "positive integer (concurrent delta sessions one replica keeps "
+            "before LRU eviction)")
+    return value
 
 
 _SESSIONS: "OrderedDict[str, _Session]" = OrderedDict()
 _SESSIONS_LOCK = threading.Lock()
-_SESSIONS_MAX = 8
+_SESSIONS_MAX = _max_sessions_from_env()
 _session_seq = itertools.count(1)
 
 
 def _count_resync(reason: str) -> None:
     from ..metrics.registry import SIDECAR_RESYNCS
     SIDECAR_RESYNCS.inc({"reason": reason})
+
+
+def _count_migration(reason: str) -> None:
+    from ..metrics.registry import SIDECAR_MIGRATIONS
+    SIDECAR_MIGRATIONS.inc({"reason": reason})
 
 
 # -- admission: bounded, tenant-fair device sharing ---------------------------
@@ -325,40 +362,217 @@ ADMISSION = AdmissionQueue(
     max_queued=int(os.environ.get("KARPENTER_SIDECAR_MAX_QUEUED", "64")))
 
 
+# -- fleet replication: handoff store + per-replica state ---------------------
+
+
+class HandoffStore:
+    """Shared session-checkpoint plane for a sidecar fleet: each replica
+    writes a checkpoint frame after every acked delta solve and a draining
+    replica exports its whole table, so ANY peer can rebuild a session
+    warm on first contact (lazy restore in _get_session) instead of
+    NACKing the client into a cold bootstrap. In-process fleets (the
+    simulator, tests, bench) share one instance; a real deployment would
+    back the same three-method contract with an external store."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ckpts: Dict[str, bytes] = {}
+        self.puts = 0       # checkpoint writes (post-solve + drain export)
+        self.restores = 0   # checkpoints handed to a restoring replica
+
+    def put(self, session_id: str, data: bytes) -> None:
+        with self._lock:
+            self._ckpts[session_id] = data
+            self.puts += 1
+
+    def get(self, session_id: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._ckpts.get(session_id)
+            if data is not None:
+                self.restores += 1
+            return data
+
+    def discard(self, session_id: str) -> None:
+        with self._lock:
+            self._ckpts.pop(session_id, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ckpts)
+
+
+class Replica:
+    """One sidecar replica's isolated serving state: its session table,
+    admission queue, in-flight request counters and (optionally) the fleet
+    handoff store + peer addresses. Every handler below reads through a
+    Replica so N replicas can serve from ONE process (the simulator's
+    fleet) without sharing the session table the way the old process
+    globals forced — a kill or drain of one replica must never clear a
+    sibling's sessions."""
+
+    def __init__(self, name: str = "replica-0",
+                 max_sessions: Optional[int] = None,
+                 max_concurrent: int = 1,
+                 max_queued: Optional[int] = None,
+                 handoff: Optional[HandoffStore] = None,
+                 peers=()):
+        self.name = name
+        self.sessions: "OrderedDict[str, _Session]" = OrderedDict()
+        self.sessions_lock = threading.Lock()
+        self.max_sessions = (_max_sessions_from_env() if max_sessions is None
+                             else max(1, int(max_sessions)))
+        self.session_seq = itertools.count(1)
+        self.admission = AdmissionQueue(
+            max_concurrent=max_concurrent,
+            max_queued=(int(os.environ.get("KARPENTER_SIDECAR_MAX_QUEUED",
+                                           "64"))
+                        if max_queued is None else int(max_queued)))
+        self.handoff = handoff
+        self.peers = tuple(peers)
+        self.last_request_at = 0.0
+        self.active_requests = 0
+        self.request_lock = threading.Lock()
+
+    def request_started(self) -> None:
+        with self.request_lock:
+            self.active_requests += 1
+            self.last_request_at = time.monotonic()
+
+    def request_finished(self) -> None:
+        with self.request_lock:
+            self.active_requests -= 1
+            self.last_request_at = time.monotonic()
+
+    def active_count(self) -> int:
+        with self.request_lock:
+            return self.active_requests
+
+    def idle_for(self, seconds: float) -> bool:
+        with self.request_lock:
+            return (self.active_requests == 0 and bool(self.last_request_at)
+                    and time.monotonic() - self.last_request_at > seconds)
+
+    def _set_session_gauge(self, count: int) -> None:
+        from ..metrics.registry import SIDECAR_REPLICA_SESSIONS
+        SIDECAR_REPLICA_SESSIONS.set(float(count), {"replica": self.name})
+
+
+class _ModuleReplica(Replica):
+    """The DEFAULT replica: its state IS the module globals. Single-process
+    deployments (and every pre-fleet test/bench harness) reach _SESSIONS /
+    _SESSIONS_LOCK / _SESSIONS_MAX / ADMISSION directly — monkeypatching or
+    clearing those module names must keep working, so this replica reads
+    them through properties at call time instead of snapshotting them."""
+
+    def __init__(self):
+        self.name = "default"
+        self.handoff = None
+        self.peers = ()
+
+    sessions = property(lambda self: _SESSIONS)
+    sessions_lock = property(lambda self: _SESSIONS_LOCK)
+    max_sessions = property(lambda self: _SESSIONS_MAX)
+    session_seq = property(lambda self: _session_seq)
+    admission = property(lambda self: ADMISSION)
+    request_lock = property(lambda self: _request_lock)
+
+    def request_started(self) -> None:
+        _request_started()
+
+    def request_finished(self) -> None:
+        _request_finished()
+
+    def active_count(self) -> int:
+        with _request_lock:
+            return _active_requests
+
+    def idle_for(self, seconds: float) -> bool:
+        with _request_lock:
+            return (_active_requests == 0 and bool(_last_request_at)
+                    and time.monotonic() - _last_request_at > seconds)
+
+
+DEFAULT_REPLICA = _ModuleReplica()
+
+
+def _replica(replica: Optional[Replica]) -> Replica:
+    return replica if replica is not None else DEFAULT_REPLICA
+
+
 # -- session lifecycle --------------------------------------------------------
 
 
-def _create_session(request: bytes, context=None) -> bytes:
+def _evict_for_insert_locked(rep: Replica) -> None:
+    """LRU eviction under rep.sessions_lock that NEVER reaps a session
+    with a queued or in-flight solve: tearing live state out from under a
+    request would crash it mid-flight — briefly exceeding the cap when
+    every session is busy is the cheaper failure."""
+    while len(rep.sessions) >= rep.max_sessions:
+        victim = next((s for s in rep.sessions.values() if s.active == 0),
+                      None)
+        if victim is None:
+            break
+        del rep.sessions[victim.id]
+        _count_resync("evicted_lru")
+
+
+def _create_session(request: bytes, context=None, replica=None) -> bytes:
     import uuid
+    rep = _replica(replica)
     nodepools, instance_types, tenant = codec.decode_session_request(request)
     # random id: sequential ids reset on restart, letting a stale client
     # silently attach to a DIFFERENT client's new session instead of
     # getting the NOT_FOUND that triggers its recreate-and-retry path
-    sid = f"s{next(_session_seq)}-{uuid.uuid4().hex[:12]}"
+    sid = f"s{next(rep.session_seq)}-{uuid.uuid4().hex[:12]}"
     session = _Session(sid, nodepools, instance_types, tenant=tenant)
-    with _SESSIONS_LOCK:
-        while len(_SESSIONS) >= _SESSIONS_MAX:
-            # LRU eviction that NEVER reaps a session with a queued or
-            # in-flight solve: tearing live state out from under a request
-            # would crash it mid-flight — briefly exceeding the cap when
-            # every session is busy is the cheaper failure
-            victim = next((s for s in _SESSIONS.values() if s.active == 0),
-                          None)
-            if victim is None:
-                break
-            del _SESSIONS[victim.id]
-            _count_resync("evicted_lru")
-        _SESSIONS[sid] = session
+    session.bootstrap = bytes(request)
+    with rep.sessions_lock:
+        _evict_for_insert_locked(rep)
+        rep.sessions[sid] = session
+        rep._set_session_gauge(len(rep.sessions))
     return json.dumps({"session": sid}).encode()
 
 
-def _get_session(sid: str, context=None) -> _Session:
-    with _SESSIONS_LOCK:
-        session = _SESSIONS.get(sid)
+def _restore_from_handoff(rep: Replica, sid: str) -> Optional[_Session]:
+    """Lazy fleet restore: an unknown session id is looked up in the
+    shared handoff store before the NOT_FOUND that would cost the client a
+    cold bootstrap. A checkpoint that fails its loud decode checks is
+    rejected (counted), never half-restored."""
+    data = rep.handoff.get(sid)
+    if data is None:
+        return None
+    try:
+        session = restore_session_checkpoint(data)
+    except ValueError:
+        _count_migration("restore_rejected")
+        return None
+    with rep.sessions_lock:
+        existing = rep.sessions.get(sid)
+        if existing is not None:
+            # a concurrent request restored it first: use the winner
+            rep.sessions.move_to_end(sid)
+            existing.active += 1
+            existing.last_used = time.monotonic()
+            return existing
+        _evict_for_insert_locked(rep)
+        rep.sessions[sid] = session
+        session.active += 1
+        session.last_used = time.monotonic()
+        rep._set_session_gauge(len(rep.sessions))
+    _count_migration("restore")
+    return session
+
+
+def _get_session(sid: str, context=None, replica=None) -> _Session:
+    rep = _replica(replica)
+    with rep.sessions_lock:
+        session = rep.sessions.get(sid)
         if session is not None:
-            _SESSIONS.move_to_end(sid)
+            rep.sessions.move_to_end(sid)
             session.active += 1
             session.last_used = time.monotonic()
+    if session is None and rep.handoff is not None:
+        session = _restore_from_handoff(rep, sid)
     if session is None:
         _count_resync("unknown_session")
         if context is not None:
@@ -367,27 +581,148 @@ def _get_session(sid: str, context=None) -> _Session:
     return session
 
 
-def _release_session(session: _Session) -> None:
-    with _SESSIONS_LOCK:
+def _release_session(session: _Session, replica=None) -> None:
+    rep = _replica(replica)
+    with rep.sessions_lock:
         session.active -= 1
         session.last_used = time.monotonic()
 
 
-def _reap_idle_sessions(now: Optional[float] = None) -> List[str]:
+def _reap_idle_sessions(now: Optional[float] = None,
+                        replica=None) -> List[str]:
     """Drop sessions untouched for SESSION_IDLE_SECONDS — but never one
     with a queued or in-flight solve (`active > 0`): the idle clock only
     starts once the last request releases. Runs from the idle-GC loop; the
     client recovers from a reap transparently (NOT_FOUND -> recreate +
     full-snapshot resync)."""
+    rep = _replica(replica)
     now = time.monotonic() if now is None else now
-    with _SESSIONS_LOCK:
-        stale = [s for s in _SESSIONS.values()
+    with rep.sessions_lock:
+        stale = [s for s in rep.sessions.values()
                  if s.active == 0 and now - s.last_used > SESSION_IDLE_SECONDS]
         for s in stale:
-            del _SESSIONS[s.id]
+            del rep.sessions[s.id]
+        if stale:
+            rep._set_session_gauge(len(rep.sessions))
     for _ in stale:
         _count_resync("evicted_idle")
     return [s.id for s in stale]
+
+
+# -- session checkpoint/restore (fleet migration) ------------------------------
+
+
+def export_session_checkpoint(session: _Session) -> bytes:
+    """Serialize everything the session IS into one versioned checkpoint
+    frame (codec.encode_session_checkpoint). Caches — wire_pods, the
+    ProblemState, the pinned catalog encoding — are deliberately absent:
+    they rebuild from content on the restoring replica; only the state the
+    digest handshake covers (plus dedupe nonces and the response cache)
+    must migrate. Call under session.lock."""
+    return codec.encode_session_checkpoint({
+        "session": session.id,
+        "tenant": session.tenant,
+        "bootstrap": session.bootstrap or codec.encode_session_request(
+            session.nodepools, session.instance_types,
+            tenant=session.tenant),
+        "templates": session.template_list,
+        "rows": session.rows,
+        "state_nodes": [sn._d for sn in session.state_nodes.values()],
+        "state_revs": session.state_tokens,
+        "daemonset": session.daemonset_raw,
+        "ds_token": session.ds_token,
+        "cluster": session.cluster_raw,
+        "cluster_token": session.cluster_token,
+        "topo_revision": session.cluster_view.cluster.topo_revision,
+        "last_req_seq": session.last_req_seq,
+        "responses": list(session.response_cache.items()),
+        "counters": {"solves": session.solves, "resyncs": session.resyncs,
+                     "dedup_hits": session.dedup_hits},
+        "digest": session.last_digest,
+    })
+
+
+def _load_checkpoint_state(session: _Session, st: dict,
+                           counters: bool = True) -> None:
+    """Overwrite the session's delta state from a decoded checkpoint dict.
+    Caches reset: wire pods rebuild from the restored rows, state nodes get
+    fresh identity stamps (the ProblemState re-encodes them dirty — its
+    caches are content-keyed, so correctness never depended on them)."""
+    session.template_list = list(st["templates"])
+    session.template_keys = [codec.template_content_key(d)
+                             for d in session.template_list]
+    session.tmpl_digest = codec.templates_digest(session.template_keys)
+    session.proto_cache = []
+    session.rows = list(st["rows"])
+    session.wire_pods = None
+    session.state_nodes = OrderedDict()
+    for d in st["state_nodes"]:
+        sn = codec.WireStateNode(d)
+        sn.identity = next(session._node_identity)
+        sn.revision = 0
+        session.state_nodes[d["name"]] = sn
+    session.state_tokens = dict(st["state_revs"])
+    session.daemonset_pods = [codec.pod_from_dict(p)
+                              for p in st["daemonset"]]
+    session.daemonset_raw = list(st["daemonset"])
+    session.ds_token = st["ds_token"]
+    session.cluster_view = codec.WireClusterView(st["cluster"])
+    session.cluster_view.cluster = _ClusterRev(st["topo_revision"])
+    session.cluster_raw = st["cluster"]
+    session.cluster_token = st["cluster_token"]
+    session.last_req_seq = st["last_req_seq"]
+    session.response_cache = OrderedDict(st["responses"])
+    session.last_digest = st["digest"]
+    if counters:
+        c = st.get("counters", {})
+        session.solves = int(c.get("solves", 0))
+        session.resyncs = int(c.get("resyncs", 0))
+        session.dedup_hits = int(c.get("dedup_hits", 0))
+
+
+def restore_session_checkpoint(data: bytes) -> _Session:
+    """Rebuild a live _Session from a checkpoint frame on ANY replica —
+    the client never re-sends full state. Loud-reject rules are the
+    codec's (ValueError / CheckpointVersionError / DeltaVersionError /
+    DigestMismatchError propagate)."""
+    st = codec.decode_session_checkpoint(data)
+    nodepools, instance_types, tenant = codec.decode_session_request(
+        st["bootstrap"])
+    session = _Session(st["session"], nodepools, instance_types,
+                       tenant=tenant or st["tenant"])
+    session.bootstrap = st["bootstrap"]
+    _load_checkpoint_state(session, st)
+    return session
+
+
+def _rollback_session_to_checkpoint(rep: Replica, session: _Session) -> bool:
+    """Digest-mismatch recovery on a fleet replica: reload the session's
+    state from its last acked checkpoint (the apply that just failed its
+    handshake mutated the session in place). Returns False when no usable
+    checkpoint exists — the caller falls back to the full-resync answer."""
+    data = rep.handoff.get(session.id)
+    if data is None:
+        return False
+    try:
+        st = codec.decode_session_checkpoint(data)
+    except ValueError:
+        _count_migration("restore_rejected")
+        return False
+    _load_checkpoint_state(session, st, counters=False)
+    _count_migration("rollback")
+    return True
+
+
+def _checkpoint_session(rep: Replica, session: _Session) -> None:
+    """Post-solve checkpoint write (under session.lock): the handoff store
+    always holds the session's LAST ACKED state, so a kill at any instant
+    costs a restoring peer nothing but cache warmth. An export failure
+    must not fail the solve that already produced its answer — it is
+    counted loudly instead."""
+    try:
+        rep.handoff.put(session.id, export_session_checkpoint(session))
+    except Exception:
+        _count_migration("export_error")
 
 
 # -- solve paths --------------------------------------------------------------
@@ -399,9 +734,34 @@ def _bad_request(context, message: str):
     raise ValueError(message)
 
 
-def _solve_session(request: bytes, context=None) -> bytes:
+def _reject_inapplicable_delta(session: _Session, replica, context,
+                               message: str):
+    """A delta whose structure cannot apply to the session (out-of-order
+    template id, row pointing past the template table, row-column skew).
+    On a standalone replica that is a client bug: loud INVALID_ARGUMENT.
+    On a fleet replica holding an acked checkpoint it is usually restore
+    lag — the session was rebuilt from an OLDER checkpoint than the state
+    the client's delta was diffed against, so the delta's template ids and
+    row indices don't line up. The digest handshake would catch the same
+    divergence, but these deltas die before reaching it. Recover the same
+    way: roll the session back to its checkpoint and NACK with the
+    server-digest rider so the client can ship a bounded catch-up delta
+    instead of a full resync."""
+    if replica is not None and replica.handoff is not None \
+            and _rollback_session_to_checkpoint(replica, session):
+        _count_resync("restore_skew")
+        full = (f"session delta inapplicable to restored state ({message}):"
+                f" full resync required [server_digest={session.last_digest}]")
+        if context is not None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, full)
+        raise codec.DigestMismatchError(full)
+    _bad_request(context, message)
+
+
+def _solve_session(request: bytes, context=None, replica=None) -> bytes:
+    rep = _replica(replica)
     header, blobs = wire.unpack(request)
-    session = _get_session(header["session"], context)
+    session = _get_session(header["session"], context, replica=rep)
     try:
         legacy = "v" not in header
         if not legacy:
@@ -443,7 +803,7 @@ def _solve_session(request: bytes, context=None) -> bytes:
                 try:
                     with (TRACER.span("sidecar.queue") if traced
                           else nullcontext()) as qsp:
-                        wait = ADMISSION.acquire(session.tenant)
+                        wait = rep.admission.acquire(session.tenant)
                         if qsp is not None:
                             qsp.set(wait_ms=round(wait * 1e3, 3))
                 except QueueFullError as e:
@@ -465,7 +825,7 @@ def _solve_session(request: bytes, context=None) -> bytes:
                                       "the device")
                     return run(wait)
                 finally:
-                    ADMISSION.release()
+                    rep.admission.release()
 
         if legacy:
             return admitted(lambda wait: _solve_session_legacy(
@@ -513,19 +873,25 @@ def _solve_session(request: bytes, context=None) -> bytes:
                             "loser of a superseded solve")
                     raise ValueError("stale request nonce")
             response = admitted(lambda wait: _solve_session_delta(
-                session, header, blobs, context, wait), traced=True)
+                session, header, blobs, context, wait, replica=rep),
+                traced=True)
             if req_digest is not None:
                 session.response_cache[req_digest] = response
                 session.last_req_seq = max(session.last_req_seq, req_seq)
                 while len(session.response_cache) > 2:
                     session.response_cache.popitem(last=False)
+            if rep.handoff is not None:
+                # checkpoint AFTER the response is cached: a failover
+                # retry of this exact request against the restoring peer
+                # must hit the dedupe cache, never re-apply the delta
+                _checkpoint_session(rep, session)
             return response
     finally:
-        _release_session(session)
+        _release_session(session, replica=rep)
 
 
 def _apply_session_delta(session: _Session, header: dict, blobs,
-                         context) -> str:
+                         context, replica: Optional[Replica] = None) -> str:
     """Apply the request's delta fields to the session state and verify the
     content-digest handshake; returns the server-computed digest. Must run
     under session.lock."""
@@ -546,15 +912,17 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
         session.state_nodes = OrderedDict()
         session.state_tokens = {}
         session.daemonset_pods = []
+        session.daemonset_raw = []
         session.ds_token = ""
         session.cluster_token = ""
+        session.cluster_raw = None
         rev = session.cluster_view.cluster.topo_revision + 1
         session.cluster_view = codec.WireClusterView(None)
         session.cluster_view.cluster = _ClusterRev(rev)
     new_templates = header.get("templates_new", ())
     for tid, d in new_templates:
         if tid != len(session.template_list):
-            _bad_request(context, (
+            _reject_inapplicable_delta(session, replica, context, (
                 f"template id {tid} out of order (table has "
                 f"{len(session.template_list)} entries; registrations must "
                 "be contiguous)"))
@@ -565,13 +933,13 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
     try:
         session.rows = codec.apply_pod_delta(session.rows, header, blobs)
     except ValueError as e:
-        _bad_request(context, str(e))
+        _reject_inapplicable_delta(session, replica, context, str(e))
     n_added = _n_added(blobs)
     if n_added:
         n_templates = len(session.template_list)
         for tid, _ts in session.rows[-n_added:]:
             if tid >= n_templates:
-                _bad_request(context, (
+                _reject_inapplicable_delta(session, replica, context, (
                     f"pod row references template {tid} but the table has "
                     f"{n_templates} entries"))
     # mirror the row delta onto the built wire-pod batch: survivors keep
@@ -611,6 +979,7 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
     if "daemonset" in header:
         session.daemonset_pods = [codec.pod_from_dict(p)
                                   for p in header["daemonset"]]
+        session.daemonset_raw = header["daemonset"]
     if "ds_token" in header:
         session.ds_token = str(header["ds_token"])
     if "cluster" in header:
@@ -618,6 +987,7 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
         cv.cluster = _ClusterRev(session.cluster_view.cluster.topo_revision
                                  + 1)
         session.cluster_view = cv
+        session.cluster_raw = header["cluster"]
     if "cluster_token" in header:
         session.cluster_token = str(header["cluster_token"])
     digest = codec.batch_digest(
@@ -627,8 +997,22 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
     want = header.get("digest")
     if want and digest != want:
         _count_resync("digest_mismatch")
+        # the FULL server digest rides the abort details: a fleet client
+        # that still holds an acked mirror snapshot with this digest rolls
+        # back to it and sends a bounded forward delta (catch-up) instead
+        # of a full resync — the last-resort path stays available either
+        # way. The apply above already mutated the session, so a fleet
+        # replica first rolls the session back to its last acked
+        # checkpoint: the digest it reports must name a state it actually
+        # HOLDS, or the client's catch-up delta would land on the
+        # franken-state the failed apply left behind.
+        report = digest
+        if replica is not None and replica.handoff is not None \
+                and _rollback_session_to_checkpoint(replica, session):
+            report = session.last_digest
         msg = (f"session state digest mismatch (client {want[:12]}.. != "
-               f"server {digest[:12]}..): full resync required")
+               f"server {digest[:12]}..): full resync required "
+               f"[server_digest={report}]")
         if context is not None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
         raise codec.DigestMismatchError(msg)
@@ -697,14 +1081,16 @@ def _parity_probe(session: _Session, results, ts_sched, pods) -> str:
 
 
 def _solve_session_delta(session: _Session, header: dict, blobs,
-                         context, queue_wait: float) -> bytes:
+                         context, queue_wait: float,
+                         replica: Optional[Replica] = None) -> bytes:
     from ..obs.tracer import TRACER
     # runs INSIDE the sidecar.solve root span traced_admitted opened (the
     # queue wait is already a sibling span); annotate the root so the SLO
     # watcher and phase histograms see how the pass was produced
     TRACER.annotate(queue_wait_ms=round(queue_wait * 1e3, 3))
     with TRACER.span("sidecar.apply"):
-        digest = _apply_session_delta(session, header, blobs, context)
+        digest = _apply_session_delta(session, header, blobs, context,
+                                      replica=replica)
     # another tenant's catalog traffic may have LRU-evicted our
     # encoding; reinstating the PINNED object keeps vocab identity
     # (and with it every ProblemState row cache and the warm-pack
@@ -800,11 +1186,12 @@ def _solve_session_legacy(session: _Session, header: dict, blobs) -> bytes:
         session.it_idx_by_id, session.it_idx_by_name)
 
 
-def _solve(request: bytes, context=None) -> bytes:
+def _solve(request: bytes, context=None, replica=None) -> bytes:
+    rep = _replica(replica)
     nodepools, instance_types, pods, state_nodes, daemonset_pods, cluster = \
         codec.decode_solve_request(request)
     try:
-        ADMISSION.acquire("")
+        rep.admission.acquire("")
     except QueueFullError as e:
         if context is not None:
             context.abort(_shed_status(e), str(e))
@@ -818,7 +1205,7 @@ def _solve(request: bytes, context=None) -> bytes:
                              daemonset_pods=daemonset_pods, cluster=cluster)
         results = ts.solve(pods)
     finally:
-        ADMISSION.release()
+        rep.admission.release()
     return codec.encode_solve_response(results, ts.fallback_reason)
 
 
@@ -835,9 +1222,11 @@ class SolverServicer(grpc.GenericRpcHandler):
     code the resilient client backs off on and re-aims at the replacement
     server (in-flight requests entered before the drain and finish)."""
 
-    def __init__(self, draining: Optional[threading.Event] = None):
+    def __init__(self, draining: Optional[threading.Event] = None,
+                 replica: Optional[Replica] = None):
         self.draining = draining if draining is not None \
             else threading.Event()
+        self.replica = _replica(replica)
 
     def service(self, handler_call_details):
         fn = _METHODS.get(handler_call_details.method)
@@ -847,16 +1236,24 @@ class SolverServicer(grpc.GenericRpcHandler):
                 # that passes the check is already visible to drain()'s
                 # in-flight wait, so drain can never sample zero and
                 # return while an admitted solve is still starting
-                _request_started()
+                rep = self.replica
+                rep.request_started()
                 try:
                     if self.draining.is_set():
-                        context.abort(
-                            grpc.StatusCode.UNAVAILABLE,
-                            "sidecar draining: not accepting new solves; "
-                            "retry against the replacement server")
-                    return fn(request, context)
+                        msg = ("sidecar draining: not accepting new "
+                               "solves; retry against the replacement "
+                               "server")
+                        if rep.peers:
+                            # migrated_to rider: the drain exported every
+                            # session to the handoff store, so the named
+                            # peer can rebuild them warm — the fleet
+                            # client re-aims there instead of waiting
+                            # out retry backoff against a dying process
+                            msg += f" [migrated_to={rep.peers[0]}]"
+                        context.abort(grpc.StatusCode.UNAVAILABLE, msg)
+                    return fn(request, context, replica=rep)
                 finally:
-                    _request_finished()
+                    rep.request_finished()
             return grpc.unary_unary_rpc_method_handler(
                 handler,
                 request_deserializer=None,   # raw bytes
@@ -894,7 +1291,8 @@ def _request_finished() -> None:
         _last_request_at = time.monotonic()
 
 
-def _idle_gc_loop(stop: threading.Event) -> None:
+def _idle_gc_loop(stop: threading.Event,
+                  replica: Optional[Replica] = None) -> None:
     """Cyclic GC is disabled in the solver process: a 50k-pod solve allocates
     ~10^5 short-lived objects and the collector's unpredictable pauses cost
     up to 400 ms MID-SOLVE (measured: 990 ms vs 545 ms steady-state).
@@ -904,22 +1302,21 @@ def _idle_gc_loop(stop: threading.Event) -> None:
     same cadence (never one with a queued/in-flight solve — the `active`
     guard in _reap_idle_sessions)."""
     import gc
+    rep = _replica(replica)
     while not stop.wait(1.0):
-        _reap_idle_sessions()
-        with _request_lock:
-            idle = (_active_requests == 0 and _last_request_at
-                    and time.monotonic() - _last_request_at > 0.5)
-        if idle:
+        _reap_idle_sessions(replica=rep)
+        if rep.idle_for(0.5):
             gc.collect()
 
 
-def sessions_snapshot() -> List[dict]:
+def sessions_snapshot(replica: Optional[Replica] = None) -> List[dict]:
     """Point-in-time view of every live session for /debug/sessions (the
     /debug/offerings snapshot pattern: HTTP threads race the solve
     threads, so the session list is copied under the lock and per-session
     fields read as GIL-atomic scalars afterwards)."""
-    with _SESSIONS_LOCK:
-        sessions = list(_SESSIONS.values())
+    rep = _replica(replica)
+    with rep.sessions_lock:
+        sessions = list(rep.sessions.values())
     now = time.monotonic()
     out = []
     for s in sessions:
@@ -931,7 +1328,7 @@ def sessions_snapshot() -> List[dict]:
             "nodes": len(s.state_nodes),
             "templates": len(s.template_list),
             "in_flight": s.active,
-            "queue_depth": ADMISSION.depth(s.tenant),
+            "queue_depth": rep.admission.depth(s.tenant),
             "last_solve_age_s": (round(now - s.last_solve_at, 3)
                                  if s.last_solve_at else -1.0),
             "solves": s.solves,
@@ -958,48 +1355,70 @@ def start_serving(metrics_port: int = 0, health_port: int = 0,
 
 def serve(port: int = 0, max_workers: int = 4,
           max_concurrent: Optional[int] = None,
-          max_queued: Optional[int] = None):
+          max_queued: Optional[int] = None,
+          replica: Optional[Replica] = None,
+          handoff: Optional[HandoffStore] = None,
+          peers=()):
     """Start the sidecar; returns (server, bound_port). `max_concurrent` /
-    `max_queued` reconfigure the process-wide admission queue (the device
-    is shared, so the queue is too). The returned server additionally
+    `max_queued` reconfigure the replica's admission queue (the device
+    is shared, so the queue is too). `replica` serves an isolated Replica
+    (fleet mode) instead of the module-global default; `handoff` / `peers`
+    attach a fleet checkpoint store and the peer addresses the draining
+    NACK's `migrated_to` rider names. The returned server additionally
     carries `server.drain(grace)` — graceful drain: stop accepting
     (UNAVAILABLE NACKs), NACK the queued waiters with the same retryable
-    code, wait up to `grace` seconds for in-flight solves — and
-    `server.draining` (the event start_serving's readiness probe reads)."""
+    code, wait up to `grace` seconds for in-flight solves, then export
+    every session checkpoint to the handoff store (when one is attached)
+    so a peer resumes them warm — and `server.draining` (the event
+    start_serving's readiness probe reads)."""
     import gc
+    rep = _replica(replica)
+    if handoff is not None:
+        rep.handoff = handoff
+    if peers:
+        rep.peers = tuple(peers)
     if max_concurrent is not None:
-        ADMISSION.max_concurrent = max(1, int(max_concurrent))
+        rep.admission.max_concurrent = max(1, int(max_concurrent))
     if max_queued is not None:
-        ADMISSION.max_queued = max(1, int(max_queued))
+        rep.admission.max_queued = max(1, int(max_queued))
     gc.collect()
     gc.freeze()     # baseline objects never participate in collection
     gc.disable()    # idle-time sweeps only (see _idle_gc_loop)
     stop = threading.Event()
-    t = threading.Thread(target=_idle_gc_loop, args=(stop,), daemon=True,
-                         name="sidecar-idle-gc")
+    t = threading.Thread(target=_idle_gc_loop, args=(stop, rep), daemon=True,
+                         name=f"sidecar-idle-gc-{rep.name}")
     t.start()
     draining = threading.Event()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
-    server.add_generic_rpc_handlers((SolverServicer(draining),))
+    server.add_generic_rpc_handlers((SolverServicer(draining, replica=rep),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     _orig_stop = server.stop
 
     def drain(grace: float = 10.0) -> int:
         """Graceful drain; returns how many queued waiters were NACKed.
-        The admission queue is process-wide (it guards the device), so
-        the drain of its waiters is too."""
+        The admission queue is replica-wide (it guards the device), so
+        the drain of its waiters is too. With a handoff store attached,
+        every live session is exported AFTER the in-flight wait (the
+        checkpoints capture final acked state) — the peer named in the
+        draining NACK rebuilds them without a cold bootstrap."""
         from ..metrics.registry import SIDECAR_DRAINING
         draining.set()
         SIDECAR_DRAINING.set(1.0)
-        shed = ADMISSION.shed_all("draining")
+        shed = rep.admission.shed_all("draining")
         deadline = time.monotonic() + max(0.0, grace)
         while time.monotonic() < deadline:
-            with _request_lock:
-                if _active_requests == 0:
-                    break
+            if rep.active_count() == 0:
+                break
             time.sleep(0.01)
+        if rep.handoff is not None:
+            with rep.sessions_lock:
+                sessions = list(rep.sessions.values())
+            for session in sessions:
+                with session.lock:
+                    _checkpoint_session(rep, session)
+                _count_migration("drain")
         return shed
 
     def stop_server(grace):

@@ -173,3 +173,17 @@ class ChaosChannel:
 
     def __getattr__(self, item):
         return getattr(self._channel, item)
+
+
+def chaos_channel_factory(injector: WireFaultInjector, options=None):
+    """Channel factory for the fleet client (SolverSession.enable_fleet):
+    every replica the router dials gets the SAME seeded injector, so a
+    failover mid-chaos-window keeps drawing from one deterministic fault
+    stream — the simulator's ledger digest stays replica-count-invariant."""
+    def factory(address: str) -> grpc.Channel:
+        from .server import GRPC_OPTIONS
+        ch = grpc.insecure_channel(address,
+                                   options=(options if options is not None
+                                            else GRPC_OPTIONS))
+        return ChaosChannel(ch, injector)
+    return factory

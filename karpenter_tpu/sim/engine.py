@@ -188,10 +188,35 @@ class FleetSimulator:
         self.wire_injector = None
         self.solver_session = None
         self._wire_windows: List[dict] = []
+        # fleet mode (scenario.replicas >= 1): N isolated Replica serving
+        # states sharing ONE handoff checkpoint store, so kills/drains of
+        # any replica resume warm on a peer. replicas == 0 keeps the
+        # legacy single module-global server byte-identical to before.
+        self.fleet = scenario.backend == "sidecar" and scenario.replicas > 0
+        self.sidecar_replicas: List[list] = []   # [server, port, Replica]
+        self.replica_addresses: List[str] = []
+        self.handoff = None
+        self.fleet_restarts = 0
         if scenario.backend == "sidecar":
             from ..sidecar import server as sidecar_server
-            self.sidecar_server, self._sidecar_port = \
-                sidecar_server.serve(port=0)
+            if self.fleet:
+                self.handoff = sidecar_server.HandoffStore()
+                for i in range(scenario.replicas):
+                    rep = sidecar_server.Replica(name=f"replica-{i}",
+                                                 handoff=self.handoff)
+                    server, port = sidecar_server.serve(port=0, replica=rep)
+                    self.sidecar_replicas.append([server, port, rep])
+                self.replica_addresses = [
+                    f"127.0.0.1:{p}" for _, p, _ in self.sidecar_replicas]
+                for i, (_, _, rep) in enumerate(self.sidecar_replicas):
+                    rep.peers = tuple(a for j, a
+                                      in enumerate(self.replica_addresses)
+                                      if j != i)
+                self.sidecar_server = self.sidecar_replicas[0][0]
+                self._sidecar_port = self.sidecar_replicas[0][1]
+            else:
+                self.sidecar_server, self._sidecar_port = \
+                    sidecar_server.serve(port=0)
             opts.solver_backend = "sidecar"
             opts.solver_address = f"127.0.0.1:{self._sidecar_port}"
         self.op = Operator(options=opts, cloud_provider=self.chaos,
@@ -202,7 +227,19 @@ class FleetSimulator:
             from ..utils.chaos import WireFaultInjector
             self.wire_injector = WireFaultInjector(seed=scenario.seed)
             sess = self.op.solver_session
-            sess._channel = ChaosChannel(sess._channel, self.wire_injector)
+            if self.fleet:
+                # the consistent-hash router owns the channel; every
+                # replica it dials is wrapped in the SAME seeded injector,
+                # so the fault stream (and with it the ledger digest) is
+                # replica-count-invariant
+                from ..sidecar.wire_chaos import chaos_channel_factory
+                sess.enable_fleet(
+                    self.replica_addresses,
+                    channel_factory=chaos_channel_factory(
+                        self.wire_injector))
+            else:
+                sess._channel = ChaosChannel(sess._channel,
+                                             self.wire_injector)
             # wire retries sleep WALL seconds while the FakeClock stands
             # still: a tight backoff keeps fault recovery from costing
             # the compression headline, and a deep retry budget reflects
@@ -641,7 +678,12 @@ class FleetSimulator:
         inj = self.wire_injector
         p = ev.params
         if p["kill_server"]:
-            self._restart_sidecar()
+            # fleet: the scenario's `replica` index picks the victim
+            # (modulo the fleet size, so the same scenario runs at any
+            # replica count); legacy single-server mode ignores it
+            idx = (int(p.get("replica", 0)) % len(self.sidecar_replicas)
+                   if self.fleet else 0)
+            self._restart_sidecar(idx)
         window = {k: p[k] for k in ("drop", "delay", "duplicate",
                                     "disconnect", "delay_seconds")}
         self._wire_windows.append(window)
@@ -663,13 +705,44 @@ class FleetSimulator:
 
         self._after(p["duration"], calm)
 
-    def _restart_sidecar(self) -> None:
+    def _restart_sidecar(self, idx: int = 0, ledgered: bool = True) -> None:
         """Server-kill fault: the listener dies and every session dies
-        with it (the session table is process state), then a fresh server
-        binds the same port. Clients must recover transparently —
-        UNAVAILABLE retries while the listener is down, then NOT_FOUND ->
-        session recreate + full resync against the replacement."""
+        with it (the session table is per-replica state), then a fresh
+        server binds the same port. Clients must recover transparently —
+        UNAVAILABLE retries while the listener is down, then either a warm
+        handoff-store restore (fleet) or NOT_FOUND -> session recreate +
+        full resync (legacy single server). `ledgered=False` is the
+        rolling-restart path: its per-replica restarts are intentionally
+        absent from the ledger, which must stay byte-identical across
+        replica counts (the scenario-level event entry IS ledgered)."""
         from ..sidecar import server as sidecar_server
+        if self.fleet:
+            entry = self.sidecar_replicas[idx]
+            server, port, rep = entry
+            done = server.stop(0)
+            if done is not None:
+                done.wait(5.0)
+            with rep.sessions_lock:
+                rep.sessions.clear()
+            new_server, new_port = sidecar_server.serve(port=port,
+                                                        replica=rep)
+            if new_port != port:
+                # a silent rebind failure (add_insecure_port returns 0)
+                # would surface as an unrelated retry-exhaustion RpcError
+                # minutes later — name the replica loudly instead
+                raise RuntimeError(
+                    f"sidecar replica-{idx} restart could not rebind "
+                    f"127.0.0.1:{port} (got port {new_port}): the "
+                    "kill_server window cannot be simulated")
+            entry[0] = new_server
+            if idx == 0:
+                self.sidecar_server = new_server
+            if ledgered:
+                # `replica` is volatile (report.VOLATILE_KEYS): the victim
+                # index depends on the fleet size, and the digest must not
+                self.ledger.append(self._rel(), "sidecar_restart",
+                                   replica=idx)
+            return
         done = self.sidecar_server.stop(0)
         if done is not None:
             done.wait(5.0)
@@ -687,6 +760,31 @@ class FleetSimulator:
                 f"(got port {self._sidecar_port}): the kill_server "
                 "window cannot be simulated")
         self.ledger.append(self._rel(), "sidecar_restart")
+
+    def _ev_rolling_restart(self, ev, t: float) -> None:
+        """Zero-downtime rolling restart of the whole fleet (scenario
+        validation guarantees fleet mode): replica i drains at
+        t + i*interval — exporting every session checkpoint to the handoff
+        store — then restarts on the same port. A tenant whose solve lands
+        mid-drain follows the NACK's migrated_to rider to a peer and
+        resumes warm; one whose replica already restarted is restored from
+        its checkpoint on first contact. Per-replica restarts are NOT
+        ledgered (their count depends on the fleet size; the digest must
+        not) — only this scenario event entry is."""
+        p = ev.params
+        interval = p["interval"]
+        grace = p["drain_grace"]
+        self.ledger.append(t, "event", event="rolling_restart",
+                           interval=interval, drain_grace=grace)
+
+        def restart(idx):
+            self.sidecar_replicas[idx][0].drain(grace)
+            self._restart_sidecar(idx, ledgered=False)
+            self.fleet_restarts += 1
+
+        restart(0)
+        for i in range(1, len(self.sidecar_replicas)):
+            self._after(i * interval, lambda idx=i: restart(idx))
 
     def _ev_slo(self, ev, t: float) -> None:
         watcher = self.op.slo
@@ -725,15 +823,27 @@ class FleetSimulator:
             if self.sidecar_server is not None:
                 if self.solver_session is not None:
                     self.solver_session.close()
-                self.sidecar_server.stop(0)
-                self.sidecar_server = None
-                # the session table is process-global and this server's
-                # idle-GC reaper died with it: drop the run's sessions
-                # (each holds a fleet-sized ProblemState) instead of
-                # leaking them for the life of the process
-                from ..sidecar import server as sidecar_server
-                with sidecar_server._SESSIONS_LOCK:
-                    sidecar_server._SESSIONS.clear()
+                if self.fleet:
+                    # every replica's server + session table is per-replica
+                    # state: stop and clear each one (a single-server clear
+                    # would leak the siblings' fleet-sized ProblemStates)
+                    for entry in self.sidecar_replicas:
+                        entry[0].stop(0)
+                        rep = entry[2]
+                        with rep.sessions_lock:
+                            rep.sessions.clear()
+                    self.sidecar_replicas = []
+                    self.sidecar_server = None
+                else:
+                    self.sidecar_server.stop(0)
+                    self.sidecar_server = None
+                    # the session table is process-global and this server's
+                    # idle-GC reaper died with it: drop the run's sessions
+                    # (each holds a fleet-sized ProblemState) instead of
+                    # leaking them for the life of the process
+                    from ..sidecar import server as sidecar_server
+                    with sidecar_server._SESSIONS_LOCK:
+                        sidecar_server._SESSIONS.clear()
 
     def _run(self) -> dict:
         wall0 = time.perf_counter()

@@ -28,8 +28,10 @@ from ..obs.slo import percentile as _pct
 
 # ledger-entry keys EXCLUDED from the digest: process-volatile joins
 # (trace ids keep counting across runs in one process; dump paths carry
-# tempdirs; wall durations depend on the host)
-VOLATILE_KEYS = frozenset({"trace_id", "dump", "wall_s"})
+# tempdirs; wall durations depend on the host; the replica index a
+# kill_server hit depends on the fleet SIZE, and the digest must be
+# byte-identical across replica counts — the fleet acceptance criterion)
+VOLATILE_KEYS = frozenset({"trace_id", "dump", "wall_s", "replica"})
 
 
 class Ledger:
@@ -118,6 +120,16 @@ def build_report(sim) -> dict:
             "hedges": sess.hedges,
             "wire_faults": dict(sim.wire_injector.counts),
         }
+        if getattr(sim, "fleet", False):
+            # fleet mode: how the replica fleet moved sessions around —
+            # failovers the router took, digest catch-ups that avoided a
+            # resync, checkpoint restores/writes through the handoff store
+            service["replicas"] = sim.scenario.replicas
+            service["failovers"] = sess.failovers
+            service["catchups"] = sess.catchups
+            service["rolling_restarts"] = sim.fleet_restarts
+            service["checkpoint_puts"] = sim.handoff.puts
+            service["checkpoint_restores"] = sim.handoff.restores
     return {
         "scenario": sim.scenario.name,
         "seed": sim.scenario.seed,

@@ -252,6 +252,17 @@ EVENT_KINDS: Dict[str, Dict[str, tuple]] = {
         "delay_seconds": (_pos, False, 0.02),
         "duration": (_pos, True, None),
         "kill_server": (_bool, False, False),
+        # fleet mode: which replica `kill_server` hits (modulo the fleet
+        # size, so the same scenario runs at any replica count)
+        "replica": (_replicas, False, 0),
+    },
+    # zero-downtime rolling restart of the whole sidecar fleet (requires
+    # `replicas >= 1`): replica i drains — exporting session checkpoints
+    # to the handoff store — and restarts at `at + i*interval`; clients
+    # follow the drain NACK's migrated_to rider and resume warm
+    "rolling_restart": {
+        "interval": (_pos, False, 5.0),
+        "drain_grace": (_nonneg, False, 0.5),
     },
 }
 
@@ -303,6 +314,12 @@ _TOP_FIELDS: Dict[str, tuple] = {
     # provisioning runs through the session wire — `wire_chaos` events
     # can then target the wire itself
     "backend": (_backend, False, "tensor"),
+    # sidecar fleet size (requires `backend: sidecar`): 0 = the legacy
+    # single module-global server; >= 1 boots that many isolated replicas
+    # sharing one checkpoint handoff store, with the client's
+    # consistent-hash router spread across them — kills and rolling
+    # restarts then resume sessions warm on a peer
+    "replicas": (_replicas, False, 0),
 }
 
 
@@ -340,6 +357,7 @@ class Scenario:
     batch_max: float = 10.0
     slo_budgets: str = ""
     backend: str = "tensor"
+    replicas: int = 0
     nodepools: List[NodePoolSpec] = field(default_factory=list)
     events: List[SimEvent] = field(default_factory=list)
     source: str = "<dict>"
@@ -544,6 +562,18 @@ def parse_scenario(data, source: str = "<dict>") -> Scenario:
                 ctx.fail(f"wire_chaos event at t={ev.at:g}s requires "
                          "'backend: sidecar' (the tensor backend has no "
                          "wire to fault)", ev.line)
+        if top["replicas"]:
+            ctx.fail("'replicas' requires 'backend: sidecar' (there is no "
+                     "fleet to replicate on the tensor backend)",
+                     key_lines.get("replicas", line))
+    if not top["replicas"]:
+        # rolling_restart drains through the fleet handoff store; with no
+        # fleet there is nothing to migrate to and the event would silently
+        # cold-restart the only server — reject the typo'd experiment
+        for ev in events:
+            if ev.kind == "rolling_restart":
+                ctx.fail(f"rolling_restart event at t={ev.at:g}s requires "
+                         "'replicas: 1' or more (a fleet to roll)", ev.line)
     return Scenario(nodepools=pools, events=events, source=source, **top)
 
 

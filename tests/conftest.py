@@ -40,3 +40,9 @@ def pytest_configure(config):
         "full operator loop on the accelerated FakeClock — deterministic; "
         "tier-1 eligible EXCEPT multi-minute scenario soaks, which also "
         "carry `slow`)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: multi-replica sidecar fleet tests (checkpoint migration, "
+        "consistent-hash failover, rolling restarts across N in-process "
+        "replicas — deterministic; tier-1 eligible except soaks that also "
+        "carry `slow`)")

@@ -841,3 +841,50 @@ class TestDisruptionScaleBudget:
         m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
         assert m and "disruption-scale" in m.group(0), \
             "BENCH_MODE=disruption-scale missing from the unknown-mode list"
+
+
+class TestSvcFleetBudget:
+    """ISSUE 17 guard: the BENCH_MODE=svc-fleet line's scaffolding at test
+    scale. The headline run asserts in-bench: sim ledger digests
+    byte-identical at 1-vs-N replicas, zero resyncs (no cold bootstrap
+    after the initial connect), aggregate warm-solve scaling over one
+    server, and per-tenant p99 held through a whole-fleet rolling
+    restart. The full line boots subprocess replicas and replays the
+    service-fleet scenario twice — too heavy for tier-1 (the end-to-end
+    fleet behavior is covered by tests/test_sidecar_fleet.py's sim smoke
+    and the sim-regression digest pin) — so this class pins the pieces
+    that must not silently drift: the mode dispatch and the
+    floor-selection plan that decides when 2.5x actually binds."""
+
+    def test_bench_mode_svc_fleet_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "svc-fleet" in m.group(0), \
+            "BENCH_MODE=svc-fleet missing from the unknown-mode error list"
+
+    @pytest.mark.parametrize(
+        "cores,mode,want_proc,want_full_floor",
+        [
+            # auto picks subprocess replicas iff the box has a spare core
+            # per replica; only THAT shape can prove parallel scaling
+            (8, "auto", True, True),
+            (1, "auto", False, False),
+            (3, "auto", False, False),  # cores == replicas: starved
+            # forced proc on a starved box still runs the real subprocess
+            # shape but is held to the no-collapse floor, not 2.5x
+            (1, "proc", True, False),
+            (8, "proc", True, True),
+            # forced thread shares one GIL regardless of cores — the full
+            # floor never binds in-process
+            (8, "thread", False, False),
+            (1, "thread", False, False),
+        ])
+    def test_scaling_floor_binds_only_when_provable(
+            self, cores, mode, want_proc, want_full_floor):
+        use_proc, floor = bench.svcfleet_scaling_plan(cores, 3, mode)
+        assert use_proc is want_proc
+        want = bench.SVCFLEET_SCALING if want_full_floor \
+            else bench.SVCFLEET_SCALING_MIN
+        assert floor == want

@@ -1,0 +1,333 @@
+"""Session checkpoints over the wire codec (ISSUE 17): round-trips of
+everything a server-side session IS — template table, pod row columns,
+state-node mirrors and revision tokens, dedupe nonces, the response cache
+and the last acked digest — seeded from the parity fuzzer's generator
+corpus, plus the loud-reject matrix (truncation, wrong kind, unknown
+checkpoint schema version, delta-wire skew, corrupt digests, stripped
+fields/blobs) and the KARPENTER_SIDECAR_MAX_SESSIONS boot contract."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.sidecar import codec, wire
+from karpenter_tpu.sidecar import server as srv
+from karpenter_tpu.sidecar.client import RemoteScheduler, SolverSession
+
+from factories import make_pods, make_nodepool, make_state_node
+from test_parity_fuzzer import gen_nodepools, gen_pods
+
+
+@pytest.fixture(scope="module")
+def fleet_one():
+    """One isolated Replica (NOT the module default) with a handoff store
+    attached, so drain/export tests cannot leak into other modules."""
+    rep = srv.Replica(name="ckpt-test", handoff=srv.HandoffStore())
+    server, port = srv.serve(port=0, replica=rep)
+    yield f"127.0.0.1:{port}", rep
+    server.stop(grace=None)
+
+
+def _live_session(address, rep, tenant, pods, rounds=3, seed=5):
+    """Drive a real session to a non-trivial state: bootstrap + churned
+    delta solves so rows, templates, state nodes, the response cache and
+    the dedupe nonce are all populated. Returns the SERVER-side _Session."""
+    rng = random.Random(seed)
+    session = SolverSession(address, tenant=tenant)
+    rs = RemoteScheduler(address, [make_nodepool()],
+                         {"default": construct_instance_types()},
+                         state_nodes=[make_state_node(f"{tenant}-n1",
+                                                      zone="test-zone-a")],
+                         session=session)
+    for round_ in range(rounds):
+        rs.solve(pods)
+        rng.shuffle(pods)
+        pods = pods[:max(2, len(pods) - 2)] + make_pods(
+            2, cpu=f"{200 + 100 * round_}m")
+    with rep.sessions_lock:
+        server_session = rep.sessions[session._session_id]
+    return server_session, session
+
+
+class TestCheckpointRoundTrip:
+    """encode -> decode -> re-encode over REAL session state must be
+    lossless and byte-stable; the restored session must be
+    indistinguishable from the one that was exported."""
+
+    @pytest.mark.parametrize("seed", [3, 11, 29])
+    def test_fuzzer_corpus_sessions_round_trip(self, fleet_one, seed):
+        address, rep = fleet_one
+        rng = random.Random(seed)
+        pods = gen_pods(rng, gen_nodepools(rng))[:24]
+        live, _ = _live_session(address, rep, f"fuzz-{seed}", pods,
+                                seed=seed)
+        with live.lock:
+            data = srv.export_session_checkpoint(live)
+        st = codec.decode_session_checkpoint(data)
+        assert st["session"] == live.id
+        assert st["tenant"] == live.tenant
+        assert st["templates"] == live.template_list
+        assert st["rows"] == [(int(t), float(ts)) for t, ts in live.rows]
+        assert st["state_revs"] == live.state_tokens
+        assert st["ds_token"] == live.ds_token
+        assert st["cluster_token"] == live.cluster_token
+        assert st["last_req_seq"] == live.last_req_seq
+        assert st["digest"] == live.last_digest
+        assert st["counters"]["solves"] == live.solves
+        assert st["responses"] == list(live.response_cache.items())
+
+    def test_restore_rebuilds_an_equivalent_session(self, fleet_one):
+        address, rep = fleet_one
+        live, _ = _live_session(address, rep, "restore-me",
+                                make_pods(8, cpu="250m"))
+        with live.lock:
+            data = srv.export_session_checkpoint(live)
+        restored = srv.restore_session_checkpoint(data)
+        assert restored.id == live.id
+        assert restored.tenant == live.tenant
+        assert restored.last_digest == live.last_digest
+        assert restored.last_req_seq == live.last_req_seq
+        assert restored.template_list == live.template_list
+        assert restored.tmpl_digest == live.tmpl_digest
+        assert restored.state_tokens == live.state_tokens
+        assert list(restored.response_cache) == list(live.response_cache)
+        assert restored.solves == live.solves
+        # a restored session re-exports BYTE-IDENTICAL: the checkpoint is
+        # a fixed point, so a session can migrate replica-to-replica any
+        # number of times without drift
+        with restored.lock:
+            again = srv.export_session_checkpoint(restored)
+        assert again == data
+
+    def test_empty_session_round_trips(self):
+        """A session that never solved still checkpoints (rows/templates/
+        responses empty) — and with no bootstrap payload captured, the
+        export re-serializes the CreateSession request itself."""
+        live = srv._Session("empty-1", [make_nodepool()],
+                            {"default": construct_instance_types()[:8]},
+                            tenant="empty")
+        with live.lock:
+            data = srv.export_session_checkpoint(live)
+        st = codec.decode_session_checkpoint(data)
+        assert st["rows"] == [] and st["templates"] == []
+        assert st["responses"] == [] and st["tenant"] == "empty"
+        restored = srv.restore_session_checkpoint(data)
+        assert restored.id == live.id and restored.rows == []
+        assert restored.tenant == "empty"
+
+    def test_drain_exports_every_session_to_the_handoff(self):
+        """server.drain() with a handoff store attached checkpoints every
+        live session — the migration a rolling restart rides on."""
+        rep = srv.Replica(name="ckpt-drain", handoff=srv.HandoffStore())
+        server, port = srv.serve(port=0, replica=rep)
+        try:
+            address = f"127.0.0.1:{port}"
+            live, _ = _live_session(address, rep, "drainee",
+                                    make_pods(6, cpu="500m"))
+            sid, digest = live.id, live.last_digest
+            server.drain(grace=2.0)
+            data = rep.handoff.get(sid)
+            assert data is not None and rep.handoff.puts >= 1
+            assert srv.restore_session_checkpoint(data).last_digest == digest
+        finally:
+            server.stop(grace=None)
+
+
+# -- the loud-reject matrix ---------------------------------------------------
+
+
+def _synthetic_checkpoint(seed=7):
+    """A valid checkpoint frame built WITHOUT a server: the offline
+    session assembles a fuzzer-corpus delta, the codec mirror applies it,
+    and the mirror state becomes the session-state dict."""
+    rng = random.Random(seed)
+    pools = gen_nodepools(rng)
+    pods = gen_pods(rng, pools)[:16]
+    sess = SolverSession("127.0.0.1:1")
+    sess._session_id = "offline"
+    header, blobs, commit, _ = sess._delta_request(pods, [], [], None, None,
+                                                   False)
+    commit()
+    template_list = [d for _tid, d in header.get("templates_new", ())]
+    template_keys = [codec.template_content_key(d) for d in template_list]
+    rows = codec.apply_pod_delta([], header, blobs)
+    state_revs = {"n1": "3", "n2": "7"}
+    digest = codec.batch_digest(
+        [r[0] for r in rows], [r[1] for r in rows],
+        codec.templates_digest(template_keys), state_revs, "ds9", "c4")
+    st = {
+        "session": "synthetic-1",
+        "tenant": "acme",
+        "bootstrap": b"opaque bootstrap payload bytes",
+        "templates": template_list,
+        "rows": rows,
+        "state_nodes": [{"name": "n1"}, {"name": "n2"}],
+        "state_revs": state_revs,
+        "daemonset": [],
+        "ds_token": "ds9",
+        "cluster": None,
+        "cluster_token": "c4",
+        "topo_revision": 4,
+        "last_req_seq": 9,
+        "responses": [("a" * 16, b"first response"),
+                      ("b" * 16, b""),
+                      ("c" * 16, b"third")],
+        "counters": {"solves": 5, "resyncs": 0, "dedup_hits": 2},
+        "digest": digest,
+    }
+    return codec.encode_session_checkpoint(st), st
+
+
+def _mutate(data, header_fn=None, blob_fn=None):
+    header, blobs = wire.unpack(data)
+    blobs = {k: bytes(v) for k, v in blobs.items()}
+    if header_fn is not None:
+        header_fn(header)
+    if blob_fn is not None:
+        blob_fn(blobs)
+    return wire.pack(header, blobs)
+
+
+class TestCheckpointRejects:
+    """Every malformed frame refuses LOUDLY — a checkpoint that cannot be
+    proven whole must never become a live session."""
+
+    def test_synthetic_frame_decodes_clean(self):
+        data, st = _synthetic_checkpoint()
+        out = codec.decode_session_checkpoint(data)
+        assert out["digest"] == st["digest"]
+        assert out["rows"] == st["rows"]
+        assert out["responses"] == st["responses"]
+        assert out["bootstrap"] == st["bootstrap"]
+        assert out["counters"] == st["counters"]
+
+    def test_garbage_rejects(self):
+        with pytest.raises(ValueError):
+            codec.decode_session_checkpoint(b"not a frame at all")
+
+    @pytest.mark.parametrize("cut", [1, 7, 64])
+    def test_truncated_frame_rejects(self, cut):
+        data, _ = _synthetic_checkpoint()
+        with pytest.raises(ValueError):
+            codec.decode_session_checkpoint(data[:-cut])
+
+    def test_wrong_message_kind_rejects(self):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h.update(kind="delta_solve"))
+        with pytest.raises(ValueError, match="not a session checkpoint"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_unknown_checkpoint_version_rejects(self):
+        """The v1-downgrade skew vector: a frame from a NEWER replica
+        (ckpt=2) reaching a v1 reader mid-roll must refuse, not misparse
+        half-understood session state."""
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h.update(ckpt=2))
+        with pytest.raises(codec.CheckpointVersionError,
+                           match="roll every sidecar replica"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_missing_checkpoint_version_rejects(self):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h.pop("ckpt"))
+        with pytest.raises(codec.CheckpointVersionError):
+            codec.decode_session_checkpoint(bad)
+
+    def test_delta_wire_skew_rejects(self):
+        """A checkpoint whose MIRRORS speak a newer delta schema cannot be
+        restored onto this replica — reject at restore, not on every
+        subsequent solve."""
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data,
+                      lambda h: h.update(v=codec.DELTA_SCHEMA_VERSION + 1))
+        with pytest.raises(codec.DeltaVersionError):
+            codec.decode_session_checkpoint(bad)
+
+    @pytest.mark.parametrize("field", ["session", "templates", "state_revs",
+                                       "ds_token", "last_req_seq", "digest"])
+    def test_stripped_header_field_rejects(self, field):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h.pop(field))
+        with pytest.raises(ValueError, match="missing field"):
+            codec.decode_session_checkpoint(bad)
+
+    @pytest.mark.parametrize("blob", ["row_tid", "row_ts", "bootstrap"])
+    def test_stripped_blob_rejects(self, blob):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, blob_fn=lambda b: b.pop(blob))
+        with pytest.raises(ValueError, match="missing blob"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_row_column_disagreement_rejects(self):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, blob_fn=lambda b: b.update(
+            row_ts=b["row_ts"][:-8]))
+        with pytest.raises(ValueError, match="row columns disagree"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_row_template_reference_out_of_range_rejects(self):
+        data, st = _synthetic_checkpoint()
+        n = len(st["templates"])
+        bad = _mutate(data, blob_fn=lambda b: b.update(
+            row_tid=wire.pack_u32([n + 3] + [r[0] for r in st["rows"][1:]])))
+        with pytest.raises(ValueError, match="references template"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_response_cache_blob_length_mismatch_rejects(self):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, blob_fn=lambda b: b.update(
+            responses=b["responses"] + b"trailing junk"))
+        with pytest.raises(ValueError, match="length mismatch"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_corrupt_digest_rejects(self):
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h.update(digest="deadbeef" * 8))
+        with pytest.raises(codec.DigestMismatchError,
+                           match="refusing to resurrect"):
+            codec.decode_session_checkpoint(bad)
+
+    def test_tampered_state_rev_flips_the_digest_check(self):
+        """The digest covers the revision tokens: silently rewinding one
+        node's revision inside the frame is caught, not restored."""
+        data, _ = _synthetic_checkpoint()
+        bad = _mutate(data, lambda h: h["state_revs"].update(n1="999"))
+        with pytest.raises(codec.DigestMismatchError):
+            codec.decode_session_checkpoint(bad)
+
+    def test_empty_frame_digest_is_recomputed(self):
+        """A frame with no digest field VALUE (legacy empty string) still
+        decodes — the restored digest is recomputed from the parts, so the
+        handshake on the next solve stays sound."""
+        data, st = _synthetic_checkpoint()
+        tolerated = _mutate(data, lambda h: h.update(digest=""))
+        out = codec.decode_session_checkpoint(tolerated)
+        assert out["digest"] == st["digest"]
+
+
+# -- $KARPENTER_SIDECAR_MAX_SESSIONS (satellite a) ----------------------------
+
+
+class TestMaxSessionsEnv:
+    """The session-table bound is configurable and a typo fails LOUDLY at
+    boot — the KARPENTER_LOO_MIN_CANDIDATES contract."""
+
+    def test_default_without_env(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_SIDECAR_MAX_SESSIONS", raising=False)
+        assert srv._max_sessions_from_env() == 8
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SIDECAR_MAX_SESSIONS", "17")
+        assert srv._max_sessions_from_env() == 17
+
+    def test_replica_reads_the_env(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SIDECAR_MAX_SESSIONS", "3")
+        assert srv.Replica(name="env-read").max_sessions == 3
+
+    @pytest.mark.parametrize("bad", ["0", "-3", "abc", "8.5", ""])
+    def test_invalid_values_exit_loudly(self, monkeypatch, bad):
+        monkeypatch.setenv("KARPENTER_SIDECAR_MAX_SESSIONS", bad)
+        with pytest.raises(SystemExit) as exc:
+            srv._max_sessions_from_env()
+        assert "KARPENTER_SIDECAR_MAX_SESSIONS" in str(exc.value)

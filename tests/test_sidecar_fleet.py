@@ -1,0 +1,288 @@
+"""Replicated sidecar fleet (ISSUE 17): the client-side consistent-hash
+tenant router, drain-to-peer migration behind the `migrated_to` NACK
+rider, warm restore from the shared handoff store after a replica kill,
+the stale-checkpoint digest catch-up path, the fleet scenario schema's
+loud rejects, and a small fleet sim smoke proving the replica count is
+invisible to scheduling truth."""
+
+import os
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.sidecar import server as srv
+from karpenter_tpu.sidecar.client import (ConsistentHashRouter,
+                                          RemoteScheduler, RetryPolicy,
+                                          SolverSession)
+from karpenter_tpu.sim import (FleetSimulator, ScenarioError, load_scenario,
+                               parse_scenario)
+
+from factories import make_nodepool, make_pods
+
+pytestmark = pytest.mark.fleet
+
+SCENARIOS_DIR = os.path.join(os.path.dirname(__file__), "..",
+                             "karpenter_tpu", "sim", "scenarios")
+
+
+class TestConsistentHashRouter:
+    ADDRS = ("127.0.0.1:7001", "127.0.0.1:7002", "127.0.0.1:7003")
+
+    def test_empty_fleet_rejected(self):
+        with pytest.raises(ValueError, match="at least one replica"):
+            ConsistentHashRouter([])
+
+    def test_routing_is_deterministic_and_coordination_free(self):
+        """Two independent routers over the same fleet agree on every
+        tenant's home — no control plane, no shared state."""
+        a = ConsistentHashRouter(self.ADDRS)
+        b = ConsistentHashRouter(list(self.ADDRS))
+        for i in range(64):
+            assert a.route(f"tenant-{i}") == b.route(f"tenant-{i}")
+
+    def test_tenants_spread_across_the_fleet(self):
+        counts = Counter(ConsistentHashRouter(self.ADDRS).route(f"t{i}")
+                         for i in range(300))
+        assert set(counts) == set(self.ADDRS)
+        assert min(counts.values()) >= 300 * 0.15  # no starved replica
+
+    def test_growing_the_fleet_moves_a_bounded_slice(self):
+        """Consistent hashing's point: adding a replica re-homes ~1/N of
+        tenants, never a wholesale reshuffle."""
+        small = ConsistentHashRouter(self.ADDRS)
+        grown = ConsistentHashRouter(self.ADDRS + ("127.0.0.1:7004",))
+        moved = sum(small.route(f"t{i}") != grown.route(f"t{i}")
+                    for i in range(400))
+        assert 0 < moved <= 400 * 0.45
+
+    def test_down_replica_walks_to_the_same_successor_everywhere(self):
+        a = ConsistentHashRouter(self.ADDRS)
+        b = ConsistentHashRouter(self.ADDRS)
+        home = a.route("acme")
+        a.mark_down(home)
+        b.mark_down(home)
+        assert a.route("acme") == b.route("acme") != home
+        assert a.successor("acme", exclude=(home,)) == a.route("acme")
+
+    def test_mark_down_is_a_cooldown_not_a_tombstone(self):
+        clock = [0.0]
+        r = ConsistentHashRouter(self.ADDRS, cooldown=5.0,
+                                 clock=lambda: clock[0])
+        home = r.route("acme")
+        r.mark_down(home)
+        assert r.route("acme") != home
+        clock[0] = 5.1  # the restarted process rejoins, signal-free
+        assert r.route("acme") == home
+
+    def test_mark_up_restores_immediately(self):
+        r = ConsistentHashRouter(self.ADDRS)
+        home = r.route("acme")
+        r.mark_down(home)
+        r.mark_up(home)
+        assert r.route("acme") == home
+
+    def test_whole_fleet_down_hands_back_the_ring_owner(self):
+        r = ConsistentHashRouter(self.ADDRS)
+        for a in self.ADDRS:
+            r.mark_down(a)
+        assert r.route("acme") in self.ADDRS
+
+
+# -- live fleets: migration, failover, catch-up -------------------------------
+
+
+def _boot(n):
+    """N isolated replicas sharing one handoff store, peers wired."""
+    handoff = srv.HandoffStore()
+    entries = []
+    for i in range(n):
+        rep = srv.Replica(name=f"fleet-test-{i}", handoff=handoff)
+        server, port = srv.serve(port=0, replica=rep)
+        entries.append([server, port, rep])
+    addrs = [f"127.0.0.1:{p}" for _, p, _ in entries]
+    for i, entry in enumerate(entries):
+        entry[2].peers = tuple(a for j, a in enumerate(addrs) if j != i)
+    return entries, addrs, handoff
+
+
+def _stop(entries):
+    for server, _, _ in entries:
+        server.stop(grace=None)
+
+
+def _fleet_session(addrs, tenant):
+    policy = RetryPolicy(deadline=10.0, max_attempts=6, backoff_base=0.01,
+                         backoff_cap=0.05, retry_budget=32.0, refund=1.0)
+    session = SolverSession(addrs[0], tenant=tenant, retry=policy)
+    session.enable_fleet(addrs)
+    rs = RemoteScheduler(addrs[0], [make_nodepool()],
+                         {"default": construct_instance_types()[:32]},
+                         session=session)
+    return rs, session
+
+
+def _entry_for(entries, address):
+    return next(e for e in entries if f"127.0.0.1:{e[1]}" == address)
+
+
+class TestFleetMigration:
+    def test_drain_names_the_peer_and_the_tenant_follows_warm(self):
+        """server.drain() NACKs with a `migrated_to` rider; the client
+        follows it to the named peer, which rebuilds the session from the
+        drained replica's checkpoint — no cold bootstrap anywhere."""
+        entries, addrs, handoff = _boot(2)
+        try:
+            rs, session = _fleet_session(addrs, "drain-tenant")
+            pods = make_pods(6, cpu="500m")
+            rs.solve(pods)
+            home = session.address
+            _entry_for(entries, home)[0].drain(grace=2.0)
+            rs.solve(pods[1:] + make_pods(1, cpu="250m"))
+            assert session.address != home
+            assert session.failovers == 1
+            assert session.resyncs == 0, "the migration cost a cold resync"
+            assert handoff.restores >= 1
+            session.close()
+        finally:
+            _stop(entries)
+
+    def test_killed_replica_resumes_warm_on_the_ring_successor(self):
+        """A hard kill (no drain, no rider): repeated UNAVAILABLE marks
+        the replica down, the ring successor restores the session from
+        its last post-solve checkpoint, and the tenant never resyncs."""
+        entries, addrs, handoff = _boot(3)
+        try:
+            rs, session = _fleet_session(addrs, "kill-tenant")
+            pods = make_pods(8, cpu="500m")
+            rs.solve(pods)
+            rs.solve(pods[:6])
+            home = session.address
+            _entry_for(entries, home)[0].stop(grace=None)
+            rs.solve(pods[:6] + make_pods(2, cpu="750m"))
+            assert session.address != home
+            assert session.failovers >= 1
+            assert session.resyncs == 0, "the kill cost a cold resync"
+            assert handoff.restores >= 1
+            session.close()
+        finally:
+            _stop(entries)
+
+    def test_stale_checkpoint_catches_up_with_a_bounded_delta(self):
+        """The successor restored an OLDER acked state (checkpoint lag):
+        the digest handshake rejects, the server names its digest in the
+        rider, and the client rolls its mirrors back and ships the
+        bounded catch-up delta — counted as a catchup, NOT a resync."""
+        entries, addrs, handoff = _boot(2)
+        try:
+            rs, session = _fleet_session(addrs, "stale-tenant")
+            pods = make_pods(6, cpu="500m")
+            rs.solve(pods)
+            sid = session._session_id
+            stale = handoff.get(sid)
+            assert stale is not None  # post-solve checkpoint write
+            rs.solve(pods[:4])
+            rs.solve(pods[:4] + make_pods(2, cpu="250m"))
+            handoff.put(sid, stale)  # rewind the store to solve-1 state
+            home = session.address
+            _entry_for(entries, home)[0].stop(grace=None)
+            rs.solve(pods[:4] + make_pods(3, cpu="300m"))
+            assert session.catchups == 1, \
+                "the stale restore did not take the bounded catch-up path"
+            assert session.resyncs == 0, \
+                "the stale restore fell back to a full resync"
+            session.close()
+        finally:
+            _stop(entries)
+
+    def test_draining_replica_without_peers_still_nacks_retryably(self):
+        """A single-replica 'fleet' drain has nowhere to point the rider;
+        the retry lands back on the SAME (restarted) address. Here the
+        server never restarts, so the solve must fail loudly after the
+        budget — not hang, not corrupt."""
+        import grpc
+        entries, addrs, _ = _boot(1)
+        try:
+            rs, session = _fleet_session(addrs, "lonely")
+            rs.solve(make_pods(3, cpu="500m"))
+            entries[0][0].drain(grace=1.0)
+            with pytest.raises(grpc.RpcError):
+                rs.solve(make_pods(4, cpu="500m"))
+            session.close()
+        finally:
+            _stop(entries)
+
+
+# -- scenario schema: fleet keys reject loudly --------------------------------
+
+
+def _doc(**over):
+    doc = {
+        "name": "t", "seed": 1, "duration": 600.0, "tick": 20,
+        "events": [{"at": 5, "kind": "deploy", "name": "web", "replicas": 3,
+                    "cpu": "500m", "memory": "256Mi"}],
+    }
+    doc.update(over)
+    return doc
+
+
+class TestFleetScenarioSchema:
+    def test_replicas_require_the_sidecar_backend(self):
+        with pytest.raises(ScenarioError,
+                           match="requires 'backend: sidecar'"):
+            parse_scenario(_doc(replicas=3))
+
+    def test_rolling_restart_requires_a_fleet(self):
+        doc = _doc(backend="sidecar")
+        doc["events"].append({"at": 50, "kind": "rolling_restart"})
+        with pytest.raises(ScenarioError, match="requires 'replicas: 1'"):
+            parse_scenario(doc)
+
+    def test_service_fleet_library_scenario_validates(self):
+        sc = load_scenario(os.path.join(SCENARIOS_DIR, "service-fleet.yaml"))
+        assert sc.backend == "sidecar" and sc.replicas == 3
+        assert any(e.kind == "rolling_restart" for e in sc.events)
+        assert any(e.kind == "wire_chaos" and e.params.get("kill_server")
+                   for e in sc.events)
+
+
+# -- fleet sim smoke: replica count is invisible to scheduling truth ----------
+
+
+class TestFleetSimSmoke:
+    DOC = {
+        "name": "fleet-smoke", "seed": 23, "duration": 900.0, "tick": 20,
+        "backend": "sidecar", "replicas": 2,
+        "events": [
+            {"at": 5, "kind": "deploy", "name": "web", "replicas": 4,
+             "cpu": "500m", "memory": "256Mi"},
+            {"at": 200, "kind": "wire_chaos", "kill_server": True,
+             "replica": 1, "duration": 60},
+            {"at": 400, "kind": "rolling_restart", "interval": 20,
+             "drain_grace": 0.5},
+            {"at": 700, "kind": "scale", "name": "web", "replicas": 7},
+        ],
+    }
+
+    def _run(self, **over):
+        import copy
+        doc = copy.deepcopy(self.DOC)
+        doc.update(over)
+        sim = FleetSimulator(parse_scenario(doc))
+        return sim.run()
+
+    def test_fleet_run_restores_warm_and_never_resyncs(self):
+        report = self._run()
+        svc = report["service"]
+        assert svc["replicas"] == 2
+        assert svc["rolling_restarts"] == 2
+        assert svc["checkpoint_restores"] >= 1
+        assert svc["resyncs"] == 0, \
+            "a kill or roll cost a cold bootstrap despite the checkpoints"
+        assert report["final"]["pods_pending"] == 0
+
+    def test_replica_count_is_digest_invisible(self):
+        """The whole point of the fleet: same seed, 1 vs 2 replicas,
+        byte-identical scheduling truth."""
+        assert (self._run()["ledger_digest"]
+                == self._run(replicas=1)["ledger_digest"])

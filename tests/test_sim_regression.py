@@ -27,9 +27,11 @@ pytestmark = pytest.mark.sim
 
 @pytest.fixture(scope="module")
 def pin():
-    # v2 multi-scenario pins: the clipped mixed-day replay plus the
+    # multi-scenario pins: the clipped mixed-day replay, the
     # disruption-wave replay (drift/expiration waves + weighted pools,
-    # the streaming disruption engine's decision pin — ISSUE 14)
+    # the streaming disruption engine's decision pin — ISSUE 14), and
+    # the clipped service-fleet replay (replica kill + rolling restart,
+    # replica-count-invariant digest — ISSUE 17)
     return sim_regression.current_pins()
 
 
@@ -53,12 +55,16 @@ class TestSimRegressionGate:
             + "\nintentional? refresh: python tools/sim_regression.py "
               "--update")
 
-    def test_both_scenarios_are_pinned(self, pin):
-        """The v2 golden covers BOTH library pins: mixed-day and the
-        ISSUE-14 disruption-wave (drift + expiration waves through the
-        streaming engine are part of the byte-exact contract)."""
+    def test_all_library_scenarios_are_pinned(self, pin):
+        """The golden covers every library pin: mixed-day, the ISSUE-14
+        disruption-wave (drift + expiration waves through the streaming
+        engine), and the ISSUE-17 service-fleet roll (replicated sidecar
+        kill + rolling restart — the digest must not depend on the
+        replica count, so the fleet run is part of the byte-exact
+        contract)."""
         names = {p["scenario"] for p in pin["pins"]}
-        assert names == {"mixed-day.yaml", "disruption-wave.yaml"}
+        assert names == {"mixed-day.yaml", "disruption-wave.yaml",
+                         "service-fleet.yaml"}
 
     def test_report_shape_covers_new_sections(self, pin):
         """The ISSUE-12 report sections are part of the pinned shape: the

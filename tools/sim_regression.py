@@ -41,8 +41,11 @@ CLIP_SECONDS = 7200.0
 # every (scenario, clip) pair the gate pins; the first entry is the
 # historical mixed-day pin, disruption-wave (ISSUE 14) clips past its
 # drift wave so the streaming disruption engine's decisions are part of
-# the byte-exact contract
-SCENARIOS = ((SCENARIO, CLIP_SECONDS), ("disruption-wave.yaml", 9000.0))
+# the byte-exact contract, service-fleet (ISSUE 17) pins the 3-replica
+# sidecar fleet — checkpoint restores, kills and the rolling restart must
+# stay invisible to scheduling truth
+SCENARIOS = ((SCENARIO, CLIP_SECONDS), ("disruption-wave.yaml", 9000.0),
+             ("service-fleet.yaml", 7200.0))
 
 # report sections whose KEYS are data (shape classes seen, event kinds
 # applied, ...): compared as opaque "dict" leaves, not recursed — their
