@@ -137,6 +137,37 @@ MESHSCALE_PODS = int(os.environ.get("BENCH_MESHSCALE_PODS", "1000000"))
 MESHSCALE_DEPLOYS = int(os.environ.get("BENCH_MESHSCALE_DEPLOYS", "4000"))
 MESHSCALE_ITS = int(os.environ.get("BENCH_MESHSCALE_ITS", "4000"))
 MESHSCALE_SHARDS = int(os.environ.get("BENCH_MESHSCALE_SHARDS", "4"))
+# BENCH_MODE=meshchurn knobs (ISSUE 18): warm churn at the million-pod
+# frontier — a warm cluster of MESHCHURN_NODES initialized nodes carrying
+# MESHCHURN_PODS_PER_NODE bound pods each (~1M scheduled pods at defaults)
+# absorbs sustained batcher windows on the MESH_DEVICES mesh through a
+# persistent sharded ProblemState. Three gates, one per window flavor
+# (each a fraction of the same-run cold mesh solve): MESHCHURN_RATIO caps
+# p99 of the batch-churn windows (the batcher steady state — arrivals
+# wobble the batch, nothing churns node-side, the whole delta path
+# engages); MESHCHURN_CHURN_RATIO caps node-churn windows (bit-identical
+# decisions force a re-pack when node capacity changed — the win there is
+# the exist-only delta precompute and shard-local re-encode, not the
+# pack); MESHCHURN_ROLLOUT_RATIO caps rollout windows (a new deployment
+# signature re-runs the full mesh precompute, cold's dominant term, plus
+# warm-bookkeeping cold never pays — near cold-parity is the ceiling).
+# Default ceilings carry noise headroom over the measured ratios (steady
+# p50 ~0.10x, p99 ~0.13-0.15x; churn ~0.4-0.7x; rollout ~1.0-1.5x): the
+# gates are max-based and single samples of the big kernels jitter up to
+# 2x on a loaded 1-core box (the cold anchor is a median of 3 for the
+# same reason). Tier-1 runs a clipped shape (TestMeshChurnBudget).
+MESHCHURN_NODES = int(os.environ.get("BENCH_MESHCHURN_NODES", "4096"))
+MESHCHURN_PODS_PER_NODE = int(os.environ.get(
+    "BENCH_MESHCHURN_PODS_PER_NODE", "244"))
+MESHCHURN_DEPLOYS = int(os.environ.get("BENCH_MESHCHURN_DEPLOYS", "2000"))
+MESHCHURN_WINDOWS = int(os.environ.get("BENCH_MESHCHURN_WINDOWS", "10"))
+MESHCHURN_WOBBLE = int(os.environ.get("BENCH_MESHCHURN_WOBBLE", "24"))
+MESHCHURN_ITS = int(os.environ.get("BENCH_MESHCHURN_ITS", "4000"))
+MESHCHURN_RATIO = float(os.environ.get("BENCH_MESHCHURN_RATIO", "0.2"))
+MESHCHURN_CHURN_RATIO = float(os.environ.get(
+    "BENCH_MESHCHURN_CHURN_RATIO", "0.8"))
+MESHCHURN_ROLLOUT_RATIO = float(os.environ.get(
+    "BENCH_MESHCHURN_ROLLOUT_RATIO", "1.75"))
 # BENCH_MODE=disruption-scale knobs (ISSUE 14): fleet size for the
 # streaming disruption pass, pending-pod batch for the provisioning-pass
 # denominator, and the warm-pass/provisioning-pass ratio ceiling ("same
@@ -3107,6 +3138,420 @@ def bench_meshscale():
             print(line, flush=True)
 
 
+def bench_meshchurn_local():
+    """ISSUE 18 acceptance line (BENCH_MODE=meshchurn): sustained churn
+    windows against a warm ~million-pod cluster on the MESH_DEVICES
+    (pods_groups x catalog) mesh, solved through a persistent SHARDED
+    ProblemState. The cluster holds MESHCHURN_NODES initialized nodes each
+    carrying MESHCHURN_PODS_PER_NODE bound pods; every window re-solves a
+    standing backlog + MESHCHURN_DEPLOYS stable deployments + a rotating
+    wobble tail. Three window flavors stress the sharded state, each with
+    its own ratio gate against the same-run cold mesh solve:
+
+    - BATCH CHURN ("steady", most windows, gate MESHCHURN_RATIO): the
+      batcher steady state — arrivals wobble the batch every window but
+      nothing churns node-side. Zero node rows re-encode in any shard,
+      the tensors memo serves the precompute whole ("reused"), and the
+      warm pack restores the stable prefix from the last seed;
+    - NODE CHURN (every 4th window, gate MESHCHURN_CHURN_RATIO): a bound
+      pod completes on 8 nodes inside ONE shard's row span — only that
+      shard's rows re-encode (ps.last["shard_dirty"] asserted per shard)
+      and the precompute is served by the exist-only delta kernel
+      ("delta", no device traffic). The pack re-runs: node capacity
+      changed, and bit-identical decisions mean the FFD fills must be
+      re-searched against the new avail vectors (the warm checkpoints
+      record raw remaining capacity, so a prefix replay can't be proven
+      equal to cold without re-doing the search) — the gate reflects the
+      pack floor, not the delta encode;
+    - ROLLOUT (every 4th window, offset, gate MESHCHURN_ROLLOUT_RATIO):
+      node churn plus a brand-new deployment signature — the full mesh
+      precompute re-runs (cold's dominant term) and the exist-side upload
+      crosses the host->device boundary ONLY for shards dirtied since the
+      last upload (karpenter_problem_state_shard_rows uploaded/
+      upload_skipped deltas asserted per shard). Ceiling is near cold
+      parity: the delta machinery saves encode/upload but records warm
+      checkpoints cold never pays for.
+
+    One same-run COLD mesh solve (no ProblemState, same cluster + batch)
+    anchors all three gates and the parity gate: decisions bit-identical
+    to the warm window's."""
+    import jax
+
+    from karpenter_tpu.api import labels as api_labels
+    from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED, COND_LAUNCHED,
+                                             COND_REGISTERED, NodeClaim,
+                                             NodeClaimSpec)
+    from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
+                                           ObjectMeta, PodSpec)
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.metrics.registry import PROBLEM_STATE_SHARD_ROWS
+    from karpenter_tpu.ops.encode import shard_spans
+    from karpenter_tpu.parallel.mesh import PODS_GROUPS_AXIS, make_solver_mesh
+    from karpenter_tpu.provisioning.problem_state import (ProblemState,
+                                                          _pow2_bucket)
+    from karpenter_tpu.provisioning.provisioner import StateClusterView
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    assert len(jax.devices()) >= MESH_DEVICES, jax.devices()
+    mesh = make_solver_mesh(MESH_DEVICES)
+    n_shards = int(dict(mesh.shape)[PODS_GROUPS_AXIS])
+    catalog = _catalog(MESHCHURN_ITS)
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    pool = NodePool(metadata=ObjectMeta(name="default"),
+                    spec=NodePoolSpec(template=NodeClaimTemplate(
+                        spec=NodeClaimTemplateSpec())))
+    big = next(it for it in catalog
+               if it.capacity.get("cpu") == 4000 and "amd64-linux" in it.name)
+
+    # warm cluster: the ~million scheduled pods live HERE, bound to
+    # initialized nodes — the churn stream touches node avail vectors, not
+    # the pending batch
+    bound_by_node = {}
+    for i in range(MESHCHURN_NODES):
+        name = f"mchurn-node-{i:06d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: big.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: f"test-zone-{'abc'[i % 3]}",
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"mchurn-nc-{i:06d}",
+                                           namespace="", labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"mchurn://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"mchurn://{i}"),
+            status=NodeStatus(capacity=dict(big.capacity),
+                              allocatable=big.allocatable())))
+        requests = res.parse_list({"cpu": "50m", "memory": "100Mi"})
+        pods_here = []
+        for j in range(MESHCHURN_PODS_PER_NODE):
+            p = Pod(metadata=ObjectMeta(name=f"mwarm-{i}-{j}", namespace="default",
+                                        labels={"warm": f"w{i % 40}"}),
+                    spec=PodSpec(node_name=name),
+                    container_requests=[requests])
+            store.create(p)
+            pods_here.append(p)
+        bound_by_node[name] = pods_here
+    bound_total = MESHCHURN_NODES * MESHCHURN_PODS_PER_NODE
+    # the ~1M bound Pod objects are permanent fixtures of this process:
+    # move them out of the collector's reach so gen-2 collections during
+    # the timed windows don't scan a million-object store (the standard
+    # long-lived-heap move for steady-state servers; without it the
+    # collector adds multiple seconds of pure scan time to the larger
+    # windows)
+    import gc
+    gc.collect()
+    gc.freeze()
+
+    # standing unschedulable backlog: huge requests sort FIRST in FFD, so
+    # steady windows warm-restore this prefix from the previous seed
+    backlog = []
+    for d in range(16):
+        for j in range(4):
+            backlog.append(Pod(
+                metadata=ObjectMeta(name=f"mbacklog-{d}-{j}",
+                                    namespace="default",
+                                    labels={"app": f"mbacklog-{d}"}),
+                container_requests=[res.parse_list(
+                    {"cpu": "300", "memory": "2000Gi"})]))
+    # MESHCHURN_DEPLOYS standing deployments, one pending pod each, stable
+    # shapes (cpu tiers above the wobble tail's 50m so the warm prefix
+    # covers them); NO topology spread — selector scans over a million
+    # bound store pods are a different bench's business (BENCH_MODE=churn)
+    standing_reqs = [res.parse_list({"cpu": _CPUS[1 + d % 4],
+                                     "memory": _MEMS[1 + d % 4]})
+                     for d in range(MESHCHURN_DEPLOYS)]
+    rollouts = []  # (window, requests): new signatures introduced mid-run
+
+    def batch_for(window: int) -> list:
+        out = list(backlog)
+        for d in range(MESHCHURN_DEPLOYS):
+            out.append(Pod(
+                metadata=ObjectMeta(name=f"mstand-{window}-{d}",
+                                    namespace="default",
+                                    labels={"app": f"mstand-{d}"}),
+                container_requests=[standing_reqs[d]]))
+        for w0, reqs in rollouts:
+            for j in range(2):
+                out.append(Pod(
+                    metadata=ObjectMeta(name=f"mroll-{w0}-{window}-{j}",
+                                        namespace="default",
+                                        labels={"app": f"mroll-{w0}"}),
+                    container_requests=[reqs]))
+        # rotating wobble tail: 50m cpu sorts LAST in FFD, counts wobble
+        # every window so the warm prefix ends here, never before
+        for k in range(MESHCHURN_WOBBLE):
+            reqs = res.parse_list({"cpu": "50m", "memory": "64Mi"})
+            for j in range(1 + (window + k) % 3):
+                out.append(Pod(
+                    metadata=ObjectMeta(name=f"mwob-{window}-{k}-{j}",
+                                        namespace="default",
+                                        labels={"app": f"mwob-{k}"}),
+                    container_requests=[reqs]))
+        return out
+
+    ps = ProblemState()
+    # the catalog is immutable for the whole run: precompute its cache
+    # token once (the sidecar-session idiom) instead of hashing 4k
+    # instance types inside every window's scheduler construction
+    from karpenter_tpu.provisioning.tensor_scheduler import \
+        catalog_cache_token
+    cat_tok = catalog_cache_token([pool], {"default": catalog})
+
+    def scheduler(state):
+        state_nodes = sorted(
+            (sn for sn in cluster.state_nodes() if not sn.deleting()),
+            key=lambda sn: sn.node.metadata.name)
+        return TensorScheduler(
+            [pool], {"default": catalog}, state_nodes=state_nodes,
+            cluster=StateClusterView(store, cluster), mesh=mesh,
+            problem_state=state, catalog_token=cat_tok)
+
+    def digest(r):
+        return (sorted(
+            (nc.template.nodepool_name,
+             tuple(sorted(nc.requirements.get(
+                 api_labels.LABEL_TOPOLOGY_ZONE).values)),
+             tuple(it.name for it in nc.instance_type_options),
+             len(nc.pods),
+             tuple(sorted(p.metadata.name for p in nc.pods)))
+            for nc in r.new_nodeclaims),
+            sorted((en.name, tuple(sorted(p.metadata.name for p in en.pods)))
+                   for en in r.existing_nodes if en.pods),
+            {uid: msg for uid, msg in r.pod_errors.items()})
+
+    Np = _pow2_bucket(MESHCHURN_NODES, 16)
+    spans = shard_spans(Np, n_shards)
+    span_rows = {s: stop - start for s, (start, stop) in enumerate(spans)}
+    rows_per_shard = MESHCHURN_NODES // n_shards
+
+    def upload_counts():
+        return {(s, oc): PROBLEM_STATE_SHARD_ROWS.value(
+                    {"shard": str(s), "outcome": oc})
+                for s in range(n_shards)
+                for oc in ("uploaded", "upload_skipped")}
+
+    # untimed warmup: jit compile at the padded buckets, the cold node-row
+    # encode, the first full-shard exist upload
+    ts = scheduler(ps)
+    r = ts.solve(batch_for(0))
+    assert ts.fallback_reason == "", ts.fallback_reason
+    # untimed churn-flavor warmup: complete one bound pod so the next solve
+    # takes the exist-only delta kernel — its jit compile must not land in
+    # a TIMED churn window (it is a per-process one-off, not a per-window
+    # cost). The dirtied shard (0) is the first one the timed loop churns,
+    # so pending_upload bookkeeping below is unchanged.
+    if bound_by_node["mchurn-node-000000"]:
+        store.delete(bound_by_node["mchurn-node-000000"].pop())
+    ts = scheduler(ps)
+    r = ts.solve(batch_for(0))
+    assert ts.fallback_reason == "", ts.fallback_reason
+    # second freeze: the warmup solves allocated the long-lived rest of
+    # the run (jit executables, device arrays, the ProblemState's row and
+    # stack caches) — move those out of the collector's reach too, so the
+    # per-window garbage stays small enough that no gen-2 pass lands
+    # inside a timed window
+    gc.collect()
+    gc.freeze()
+
+    debug = os.environ.get("BENCH_MESHCHURN_DEBUG", "") not in ("", "0")
+    from karpenter_tpu.metrics.registry import phase_seconds_by_name
+
+    times = {"steady": [], "churn": [], "rollout": []}
+    churn_count = 0
+    pending_upload = {0}  # shards dirtied since the last device upload
+    residency_checks = 0
+    for w in range(1, MESHCHURN_WINDOWS + 1):
+        flavor = ("rollout" if w % 4 == 2 else
+                  "churn" if w % 4 == 0 else "steady")
+        s_t = None
+        if flavor in ("churn", "rollout"):
+            # complete a bound pod on 8 nodes inside ONE shard's row span:
+            # only that shard's rows may re-encode (and, on the next full
+            # precompute, re-upload)
+            s_t = churn_count % n_shards
+            churn_count += 1
+            for i in range(8):
+                idx = s_t * rows_per_shard + (i * 131) % rows_per_shard
+                name = f"mchurn-node-{idx:06d}"
+                if bound_by_node[name]:
+                    store.delete(bound_by_node[name].pop())
+            pending_upload.add(s_t)
+        if flavor == "rollout":
+            # a brand-new deployment signature joins the batch (and stays):
+            # the group side of the tensors memo misses, forcing the full
+            # mesh precompute and the per-shard exist delta upload
+            rollouts.append((w, res.parse_list(
+                {"cpu": "50m", "memory": f"{32 + w}Mi"})))
+        batch = batch_for(w)
+        before = upload_counts()
+        ph0 = phase_seconds_by_name() if debug else None
+        t0 = time.perf_counter()
+        ts = scheduler(ps)
+        r = ts.solve(batch)
+        dt = time.perf_counter() - t0
+        times[flavor].append(dt)
+        if debug:
+            ph1 = phase_seconds_by_name()
+            top = sorted(((ph1.get(k, 0.0) - ph0.get(k, 0.0), k)
+                          for k in ph1), reverse=True)[:6]
+            print(f"# w={w} {flavor} {dt:.3f}s " + " ".join(
+                f"{k}={s:.3f}" for s, k in top if s > 0.005), flush=True)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        assert ts.partition == (len(batch), 0), ts.partition
+        assert ts.encode_kind == "delta", \
+            f"window {w} fell back to a cold encode"
+        # per-shard delta residency: dirty rows land in exactly the
+        # churned shard, every other shard re-encodes nothing
+        sd = ps.last.get("shard_dirty")
+        assert sd is not None and len(sd) == n_shards, ps.last
+        for s in range(n_shards):
+            want = 8 if s == s_t else 0
+            assert sd[s] == want, (w, flavor, s, sd)
+        delta = {k: v - before[k] for k, v in upload_counts().items()}
+        if flavor == "steady":
+            assert ps.last["precompute"] == "reused", ps.last
+            assert ps.last["warm_restored"] > 0, ps.last
+            assert not any(delta.values()), (w, delta)
+        elif flavor == "churn":
+            # exist-only change with a stable group side: the delta kernel
+            # splices exist_ok/exist_cap on the host — no device traffic
+            assert ps.last["precompute"] == "delta", ps.last
+            assert not any(delta.values()), (w, delta)
+        else:  # rollout
+            assert ps.last["precompute"] == "computed", ps.last
+            for s in range(n_shards):
+                want_up = span_rows[s] if s in pending_upload else 0
+                want_skip = 0 if s in pending_upload else span_rows[s]
+                assert delta[(s, "uploaded")] == want_up, (w, s, delta)
+                assert delta[(s, "upload_skipped")] == want_skip, \
+                    (w, s, delta)
+            pending_upload.clear()
+        residency_checks += 1
+
+    # same-run cold reference: identical cluster + batch through a fresh
+    # ProblemState-free mesh scheduler — the 91.8 s-class cold solve this
+    # line's p99 is measured against, and the parity oracle
+    # three cold solves, median taken: the big kernels jitter +/-30% on a
+    # loaded box, and a ratio gate against a single unlucky (or lucky)
+    # cold sample flakes in both directions
+    import numpy as _np
+    cold_samples = []
+    r_cold = None
+    for _ in range(3):
+        cold = scheduler(None)
+        ph0 = phase_seconds_by_name() if debug else None
+        t0 = time.perf_counter()
+        r_c = cold.solve(batch)
+        cold_samples.append(time.perf_counter() - t0)
+        if r_cold is None:
+            r_cold = r_c
+        if debug:
+            ph1 = phase_seconds_by_name()
+            top = sorted(((ph1.get(k, 0.0) - ph0.get(k, 0.0), k)
+                          for k in ph1), reverse=True)[:6]
+            print(f"# cold {cold_samples[-1]:.3f}s " + " ".join(
+                f"{k}={s:.3f}" for s, k in top if s > 0.005), flush=True)
+    cold_s = float(_np.median(cold_samples))
+    assert cold.fallback_reason == "", cold.fallback_reason
+    assert digest(r) == digest(r_cold), \
+        "warm sharded solve diverged from the cold mesh solve"
+
+    # one gate per flavor (see the docstring for why their cost floors
+    # differ): batch-churn p99 is the sustained-churn line; node-churn
+    # windows carry the re-pack floor; rollout windows re-run the full
+    # mesh precompute — the same dominant term the cold solve pays.
+    sustained = times["steady"]
+    p50 = float(_np.percentile(sustained, 50))
+    p99 = float(_np.percentile(sustained, 99))
+    assert p99 <= MESHCHURN_RATIO * cold_s, (
+        f"warm p99 {p99:.2f}s > {MESHCHURN_RATIO:.2f} x cold {cold_s:.2f}s")
+    churn_max = max(times["churn"]) if times["churn"] else 0.0
+    assert churn_max <= MESHCHURN_CHURN_RATIO * cold_s, (
+        f"node-churn window {churn_max:.2f}s > "
+        f"{MESHCHURN_CHURN_RATIO:.2f} x cold {cold_s:.2f}s")
+    rollout_max = max(times["rollout"]) if times["rollout"] else 0.0
+    assert rollout_max <= MESHCHURN_ROLLOUT_RATIO * cold_s, (
+        f"rollout window {rollout_max:.2f}s > {MESHCHURN_ROLLOUT_RATIO:.2f}"
+        f" x cold {cold_s:.2f}s")
+    print(json.dumps({
+        "metric": (f"mesh churn: warm sharded-ProblemState windows against "
+                   f"a {bound_total}-pod / {MESHCHURN_NODES}-node cluster "
+                   f"x {MESHCHURN_ITS} instance types on a {MESH_DEVICES}-"
+                   f"device mesh ({n_shards} exist shards; dirty rows "
+                   "re-encode/re-upload per shard only; decisions "
+                   "bit-identical to the same-run cold mesh solve) "
+                   f"[platform={jax.devices()[0].platform}]"),
+        "value": round(cold_s / max(p99, 1e-9), 1),
+        "unit": "x cold mesh solve (p99 warm window)",
+        "seconds": round(sum(sum(v) for v in times.values()), 3),
+        "warm_p50_s": round(p50, 3),
+        "warm_p99_s": round(p99, 3),
+        "cold_s": round(cold_s, 3),
+        "ratio_p99": round(p99 / max(cold_s, 1e-9), 4),
+        "ratio_ceiling": MESHCHURN_RATIO,
+        "churn_max_s": round(churn_max, 3),
+        "churn_ratio": round(churn_max / max(cold_s, 1e-9), 4),
+        "rollout_max_s": round(rollout_max, 3),
+        "rollout_ratio": round(rollout_max / max(cold_s, 1e-9), 4),
+        "windows": MESHCHURN_WINDOWS,
+        "steady_windows": len(times["steady"]),
+        "churn_windows": len(times["churn"]),
+        "rollout_windows": len(times["rollout"]),
+        "nodes": MESHCHURN_NODES,
+        "bound_pods": bound_total,
+        "deploys": MESHCHURN_DEPLOYS,
+        "exist_shards": n_shards,
+        "rows_per_shard": span_rows[0],
+        "shard_residency_windows": residency_checks,
+        "parity_vs_cold": True,
+    }), flush=True)
+
+
+def bench_meshchurn():
+    """bench_meshchurn_local, re-execing under a virtual MESH_DEVICES-device
+    CPU platform when the host has fewer real chips."""
+    import jax
+
+    from __graft_entry__ import run_under_virtual_devices
+
+    if len(jax.devices()) >= MESH_DEVICES:
+        bench_meshchurn_local()
+        return
+    code = (
+        "import bench\n"
+        f"bench.MESHCHURN_NODES = {MESHCHURN_NODES}\n"
+        f"bench.MESHCHURN_PODS_PER_NODE = {MESHCHURN_PODS_PER_NODE}\n"
+        f"bench.MESHCHURN_DEPLOYS = {MESHCHURN_DEPLOYS}\n"
+        f"bench.MESHCHURN_WINDOWS = {MESHCHURN_WINDOWS}\n"
+        f"bench.MESHCHURN_WOBBLE = {MESHCHURN_WOBBLE}\n"
+        f"bench.MESHCHURN_ITS = {MESHCHURN_ITS}\n"
+        f"bench.MESHCHURN_RATIO = {MESHCHURN_RATIO}\n"
+        f"bench.MESHCHURN_CHURN_RATIO = {MESHCHURN_CHURN_RATIO}\n"
+        f"bench.MESHCHURN_ROLLOUT_RATIO = {MESHCHURN_ROLLOUT_RATIO}\n"
+        "bench.bench_meshchurn_local()\n")
+    out = run_under_virtual_devices(code, MESH_DEVICES, timeout=3600)
+    for line in out.splitlines():
+        # "#" lines are the BENCH_MESHCHURN_DEBUG per-window phase traces
+        if line.startswith("{") or line.startswith("# "):
+            print(line, flush=True)
+
+
 def bench_mesh():
     """Run bench_mesh_local, re-execing under a virtual MESH_DEVICES-device
     CPU platform when the host has fewer real chips (the driver box has one
@@ -3151,6 +3596,9 @@ def main():
     if MODE == "meshscale":
         bench_meshscale()
         return
+    if MODE == "meshchurn":
+        bench_meshchurn()
+        return
     if MODE == "sidecar":
         bench_sidecar()
         return
@@ -3191,7 +3639,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_MODE {MODE!r}; expected one of "
             "all|provisioning|consolidation|single|disruption-scale|spot|"
-            "mesh|mesh-local|mesh-headroom|meshscale|sidecar|service|"
+            "mesh|mesh-local|mesh-headroom|meshscale|meshchurn|sidecar|"
+            "service|"
             "svc-faults|svc-fleet|minvalues|faults|replay|drought|churn|"
             "trace|fallbacks|sim")
     pods = _pods()

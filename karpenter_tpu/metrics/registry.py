@@ -337,6 +337,12 @@ FLIGHTREC_DROPPED = REGISTRY.counter(
     "karpenter_flightrecorder_dropped_total",
     "Decision records dropped (ring eviction or capture failure)",
     ("reason",))
+PROBLEM_STATE_SHARD_ROWS = REGISTRY.counter(
+    "karpenter_problem_state_shard_rows_total",
+    "Existing-node rows handled per mesh shard of the sharded "
+    "ProblemState, by outcome: reencoded/clean at encode time, "
+    "uploaded/upload_skipped at device-placement time",
+    ("shard", "outcome"), max_series=256)
 
 def phase_seconds_by_name() -> Dict[str, float]:
     """Total observed seconds per phase (span name) across every label

@@ -128,6 +128,13 @@ class PackProblem:
     # unchanged node set skip the [N, ...] host->device upload exactly like
     # the catalog side. None (the default) preserves per-call uploads.
     exist_token: Optional[tuple] = None
+    # per-shard content tokens of the existing-node rows (sharded
+    # ProblemState over the mesh pods_groups axis): tuple of S tokens, one
+    # per contiguous Np/S row span (encode.shard_spans). When set, the mesh
+    # placer's put_exist_side re-uploads ONLY the spans whose token changed
+    # (a node revision bump re-uploads its shard's rows, not all N). None
+    # keeps the whole-side exist_token cache behaviour.
+    exist_shard_tokens: Optional[tuple] = None
 
 
 @dataclass
@@ -310,8 +317,19 @@ class ArgPlacer:
         enc/i32/array). Sharded placers device_put each with its spec."""
         return it_side
 
-    def put_exist_side(self, exist, exist_avail):
+    def put_exist_side(self, exist, exist_avail, p=None):
+        """``p`` is the (padded) problem: sharded placers read its
+        exist_shard_tokens to re-upload only dirty per-shard row blocks."""
         return exist, exist_avail
+
+    def device_token(self) -> tuple:
+        """Placement identity folded into the cached exist-upload's token:
+        the content token (PackProblem.exist_token) says WHAT the rows are,
+        this says WHERE they live. A mesh<->single-device flip in one
+        process, or a default-device change, must never serve the other
+        placement's arrays even if the node set is unchanged."""
+        d = jax.devices()[0]
+        return ("dev", jax.default_backend(), int(getattr(d, "id", 0)))
 
     def it_side_valid(self, p: "PackProblem", it_side) -> bool:
         """Guards the cached catalog upload against a differently-padded
@@ -342,16 +360,22 @@ def _device_args(p: PackProblem, placer: ArgPlacer):
         # node-only (exist_enc, exist_avail) pair is cacheable per
         # exist_token (see PackProblem.exist_token)
         ex_key = ("exist_side",) + placer.cache_ns
+        # the stored token pairs the CONTENT token with the placer's
+        # placement identity: a mesh<->single-device flip in one process
+        # reuses the same ProblemState (same exist_token) but must never be
+        # served the other placement's arrays
+        ex_tok = (p.exist_token, placer.device_token()) \
+            if p.exist_token is not None else None
         ex_slot = (p.device_cache.get(ex_key)
-                   if p.device_cache is not None and p.exist_token is not None
+                   if p.device_cache is not None and ex_tok is not None
                    else None)
-        if ex_slot is not None and ex_slot[0] == p.exist_token:
+        if ex_slot is not None and ex_slot[0] == ex_tok:
             exist, exist_avail = ex_slot[1]
         else:
             exist, exist_avail = placer.put_exist_side(
-                dev(p.exist_enc), i32(p.exist_avail))
-            if p.device_cache is not None and p.exist_token is not None:
-                p.device_cache[ex_key] = (p.exist_token, (exist, exist_avail))
+                dev(p.exist_enc), i32(p.exist_avail), p=p)
+            if p.device_cache is not None and ex_tok is not None:
+                p.device_cache[ex_key] = (ex_tok, (exist, exist_avail))
         tol_exist = arr(p.tol_exist)
     else:
         K, W = p.group_enc.mask.shape[1:]
@@ -542,6 +566,47 @@ def precompute(p: PackProblem) -> PackTensors:
         _split_packed(flat, _output_layout(p, statics["has_exist"]))
     return unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm,
                           exist_ok, exist_cap, p.zone_values.shape[0])
+
+
+def _exist_delta_kernel(group, group_req, exist, exist_avail, tol_exist,
+                        allow_undefined):
+    # EXACTLY the has_exist branch of precompute_kernel, lifted out so a
+    # node-churn pass can refresh the [G, N] exist tensors without paying
+    # the catalog axis. Same ops in the same order on the same dtypes:
+    # the outputs are bit-identical to the fused kernel's.
+    exist_compat = feas.compatible_matrix(exist, group,
+                                          jnp.zeros_like(allow_undefined))
+    exist_ok = exist_compat.T & tol_exist                    # [G, N]
+    per = jnp.where(group_req[:, None, :] > 0,
+                    exist_avail[None, :, :]
+                    // jnp.maximum(group_req[:, None, :], 1),
+                    jnp.int32(INT32_MAX))
+    exist_cap = jnp.clip(jnp.min(per, axis=-1), 0,
+                         INT32_MAX).astype(jnp.int32)
+    exist_ok = exist_ok & (exist_cap >= 1)
+    return exist_ok, exist_cap
+
+
+_exist_delta_jit = jax.jit(_exist_delta_kernel)
+
+
+def exist_delta(p: PackProblem) -> "Tuple[np.ndarray, np.ndarray]":
+    """(exist_ok, exist_cap) for this problem, computed by the exist-only
+    slice of the precompute. The sharded ProblemState's tensors memo calls
+    this when ONLY the existing-node side changed since the memoized
+    precompute: the group/catalog outputs are content-equal by token, and
+    this refresh costs O(G*N) instead of the full O(G*M*T*Z) kernel."""
+    from ..obs.tracer import TRACER
+    clip = lambda a: np.clip(a, -INT32_MAX - 1,
+                             INT32_MAX).astype(np.int32)
+    with TRACER.span("device.exist_delta",
+                     nodes=int(p.exist_avail.shape[0])):
+        out = _exist_delta_jit(
+            feas.to_device(p.group_enc), clip(p.group_req),
+            feas.to_device(p.exist_enc), clip(p.exist_avail),
+            np.asarray(p.tol_exist), np.asarray(p.allow_undefined))
+        exist_ok, exist_cap = jax.device_get(out)
+    return exist_ok, exist_cap
 
 
 def unpack_tensors(compat_tm, it_okz_packed, ppn, zone_adm, exist_ok,
@@ -772,6 +837,17 @@ class WarmStart:
     result_seed: Optional[PackSeed] = None
     restored_pos: int = 0
     matched: int = 0
+    # sharded hierarchical pack composition (parallel/mesh.sharded_pack):
+    # one PackSeed per round-robin FFD block. Each shard's Packer runs the
+    # SAME warm machinery over its block order (the seed's ffd_tokens are
+    # that block's per-group tokens), so a shard whose groups kept their
+    # tokens AND their block replays its whole pack; a group that moved
+    # shards breaks both affected blocks' prefixes from its position on.
+    shard_seeds: Optional[list] = None
+    result_shard_seeds: Optional[list] = None
+    # cross-shard reconcile fold memo (mesh._reconcile), carried across
+    # passes by the ProblemState; replaced in place when the fold re-runs
+    reconcile_memo: Optional[dict] = None
 
 
 @dataclass
@@ -1469,12 +1545,15 @@ class Packer:
     def pack(self, order: Optional[List[int]] = None) -> PackResult:
         """Pack every group of ``order`` (default: the full FFD order) into
         this packer's cohort set. An explicit order is the sharded-pack
-        entry: it packs only that block of groups and never engages the
-        warm-start machinery (per-shard state is not checkpointable)."""
-        explicit = order is not None
+        entry: it packs only that block of groups. The warm-start machinery
+        is order-generic — checkpoints record state after a prefix of
+        WHATEVER order this pack walks — so a per-shard WarmStart (its
+        global token carries the shard identity, its seed that block's
+        ffd_tokens) composes with an explicit block; callers that want a
+        cold block pack simply construct the Packer without ``warm``."""
         if order is None:
             order = self.ffd_order()
-        warm = self._warm if not explicit and self._warm_usable() else None
+        warm = self._warm if self._warm_usable() else None
         start = 0
         cks: List[PackCheckpoint] = []
         if warm is not None:

@@ -281,6 +281,19 @@ def pad_stacked(e: EncodedRequirements, total: int,
         gt=rep("gt"), lt=rep("lt"))
 
 
+def shard_spans(total: int, shards: int) -> "list":
+    """Contiguous equal [start, stop) row spans carving a stacked batch
+    axis into ``shards`` blocks, or a single full span when the axis does
+    not divide evenly (a pow2-bucketed axis always divides a pow2 shard
+    count). Shared by the sharded ProblemState's per-shard exist tokens
+    and the mesh placer's per-shard upload blocks, so the two sides can
+    never disagree about which rows a shard owns."""
+    if shards <= 1 or total % shards != 0:
+        return [(0, total)]
+    rows = total // shards
+    return [(s * rows, (s + 1) * rows) for s in range(shards)]
+
+
 def pow2_bucket(n: int, minimum: int) -> int:
     """Next power of two >= max(n, minimum): bounded distinct jit shapes.
     Shared by the group/node batch-axis buckets (tensor_scheduler) and the

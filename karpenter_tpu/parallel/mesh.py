@@ -174,7 +174,35 @@ def pad_problem(p: binpack.PackProblem, g_mult: int, t_mult: int,
     return dataclasses.replace(p, **fields), G, T
 
 
+# NamedSharding construction is pure metadata but happens on every dispatch
+# (dozens of leaves); placements are a function of device identity + grid
+# alone, so one cache entry per mesh shape serves every recreated Mesh over
+# the same devices (the same contract mesh_cache_key gives the executable
+# cache). Bounded: meshes come and go with process topology, not workload.
+_SHARDING_CACHE: dict = {}
+_SHARDING_CACHE_MAX = 8
+
+
+def _cached_shardings(mesh: Mesh, kind: str, build):
+    key = (mesh_cache_key(mesh), kind)
+    hit = _SHARDING_CACHE.get(key)
+    if hit is None:
+        if len(_SHARDING_CACHE) >= _SHARDING_CACHE_MAX:
+            _SHARDING_CACHE.clear()
+        hit = _SHARDING_CACHE[key] = build(mesh)
+    return hit
+
+
+def _replicated(mesh: Mesh) -> NamedSharding:
+    return _cached_shardings(mesh, "rep",
+                             lambda m: NamedSharding(m, P()))
+
+
 def _arg_shardings(mesh: Mesh):
+    return _cached_shardings(mesh, "args", _build_arg_shardings)
+
+
+def _build_arg_shardings(mesh: Mesh):
     """PartitionSpecs matching precompute_kernel's positional args."""
     g = P(PODS_GROUPS_AXIS)
     t = P(CATALOG_AXIS)
@@ -205,6 +233,10 @@ def _arg_shardings(mesh: Mesh):
 # (it_enc, it_alloc, off_zone, off_captype, off_available, zone_values,
 #  allow_undefined)
 def _it_side_shardings(mesh: Mesh):
+    return _cached_shardings(mesh, "it_side", _build_it_side_shardings)
+
+
+def _build_it_side_shardings(mesh: Mesh):
     t = NamedSharding(mesh, P(CATALOG_AXIS))
     rep = NamedSharding(mesh, P())
     enc_t = feas.Enc(*([t] * 6))
@@ -212,6 +244,10 @@ def _it_side_shardings(mesh: Mesh):
 
 
 def _out_shardings(mesh: Mesh):
+    return _cached_shardings(mesh, "out", _build_out_shardings)
+
+
+def _build_out_shardings(mesh: Mesh):
     g0 = NamedSharding(mesh, P(PODS_GROUPS_AXIS))
     mg = NamedSharding(mesh, P(None, PODS_GROUPS_AXIS))
     gmt = NamedSharding(mesh, P(PODS_GROUPS_AXIS, None, CATALOG_AXIS))
@@ -251,12 +287,54 @@ class _MeshPlacer(binpack.ArgPlacer):
         return jax.tree.map(jax.device_put, it_side,
                             _it_side_shardings(self.mesh))
 
-    def put_exist_side(self, exist, exist_avail):
+    def put_exist_side(self, exist, exist_avail, p=None):
         if self.multiproc:
             return exist, exist_avail
-        rep = NamedSharding(self.mesh, P())
-        put = lambda x: jax.device_put(x, rep)
-        return feas.Enc(*(put(x) for x in exist)), put(exist_avail)
+        rep = _replicated(self.mesh)
+        tokens = getattr(p, "exist_shard_tokens", None) \
+            if p is not None else None
+        cache = getattr(p, "device_cache", None) if p is not None else None
+        N = int(exist_avail.shape[0])
+        if (not tokens or len(tokens) < 2 or cache is None
+                or N % len(tokens) != 0):
+            put = lambda x: jax.device_put(x, rep)
+            return feas.Enc(*(put(x) for x in exist)), put(exist_avail)
+        # delta upload: the sharded ProblemState carved the exist stack into
+        # contiguous per-shard row blocks (encode.shard_spans) with one
+        # content token each. Only blocks whose token changed cross the
+        # host->device boundary; clean blocks reuse their cached replicated
+        # arrays and the full stack is reassembled device-side. This only
+        # runs on a full-token MISS (all-clean passes reuse the whole
+        # cached pair via device_args' exist_side slot).
+        from ..metrics.registry import PROBLEM_STATE_SHARD_ROWS
+        spans = enc.shard_spans(N, len(tokens))
+        key = ("exist_shards",) + self.cache_ns
+        prev = cache.get(key)
+        blocks = []
+        for s, (start, stop) in enumerate(spans):
+            if (prev is not None and s < len(prev[0])
+                    and prev[0][s] == tokens[s]):
+                blocks.append(prev[1][s])
+                PROBLEM_STATE_SHARD_ROWS.inc(
+                    {"shard": str(s), "outcome": "upload_skipped"},
+                    value=stop - start)
+            else:
+                put = lambda x: jax.device_put(
+                    np.ascontiguousarray(x[start:stop]), rep)
+                blocks.append((feas.Enc(*(put(x) for x in exist)),
+                               put(exist_avail)))
+                PROBLEM_STATE_SHARD_ROWS.inc(
+                    {"shard": str(s), "outcome": "uploaded"},
+                    value=stop - start)
+        cache[key] = (tuple(tokens), tuple(blocks))
+        import jax.numpy as jnp
+        full_enc = feas.Enc(*(jnp.concatenate([b[0][i] for b in blocks])
+                              for i in range(6)))
+        full_avail = jnp.concatenate([b[1] for b in blocks])
+        return full_enc, full_avail
+
+    def device_token(self) -> tuple:
+        return ("mesh", mesh_cache_key(self.mesh))
 
     def it_side_valid(self, p, it_side) -> bool:
         # the slot key embeds (device identity, Tp): a hit under a
@@ -476,7 +554,9 @@ def sharded_pack(p: binpack.PackProblem, t: binpack.PackTensors, groups,
                  initial_zone_counts: Optional[np.ndarray] = None,
                  exist_counts: Optional[np.ndarray] = None,
                  host_match_total: Optional[np.ndarray] = None,
-                 max_workers: Optional[int] = None) -> binpack.PackResult:
+                 max_workers: Optional[int] = None,
+                 warm: Optional[binpack.WarmStart] = None
+                 ) -> binpack.PackResult:
     """Hierarchical pods/groups-sharded pack (DEVIATIONS 22): carve the FFD
     order into ``n_shards`` round-robin interleaved blocks (_shard_blocks),
     pack each against its own cohort set in parallel (numpy releases the
@@ -484,6 +564,14 @@ def sharded_pack(p: binpack.PackProblem, t: binpack.PackTensors, groups,
     reconcile cross-shard: merge the cohort sets and re-offer every shard's
     single-group remainder nodes to the merged winners so stragglers
     coalesce onto spare capacity another shard opened.
+
+    ``warm`` composes the PR-6 checkpoint restore with the shard carve:
+    each block packs under its own per-shard WarmStart (global token +
+    shard identity, seed from warm.shard_seeds) and leaves its fresh seed
+    in warm.result_shard_seeds; restore/match stats aggregate onto the
+    parent. A group whose FFD position moved it to another shard breaks
+    both affected blocks' token prefixes from its position on — that shard
+    pair re-packs (cold past the prefix) while untouched shards replay.
 
     Decision contract vs the sequential oracle (pinned in
     tests/test_parallel_mesh.py):
@@ -494,25 +582,48 @@ def sharded_pack(p: binpack.PackProblem, t: binpack.PackTensors, groups,
     - claims may differ only in remainder-node composition; total placed
       pods are identical and the reconcile pass strictly reduces node count
       toward the oracle's.
+    - a warm restore replays checkpointed per-shard state recorded from an
+      identical-token prefix, so warm decisions are byte-identical to the
+      cold sharded pack (the sharded churn fuzzer pins this).
     """
     from concurrent.futures import ThreadPoolExecutor
 
     from ..obs.tracer import TRACER
 
-    def make_packer():
+    def make_packer(w: Optional[binpack.WarmStart] = None):
         return binpack.Packer(
             p, t, groups, [None] * p.daemon_overhead.shape[0], [],
             initial_zone_counts=initial_zone_counts,
-            exist_counts=exist_counts, host_match_total=host_match_total)
+            exist_counts=exist_counts, host_match_total=host_match_total,
+            warm=w)
 
     probe = make_packer()
     order = probe.ffd_order()
     blocks = _shard_blocks(order, max(1, n_shards))
     if len(blocks) <= 1:
+        # degenerate single block == the sequential pack: the parent warm
+        # applies directly (its seed interoperates with sequential passes)
+        if warm is not None:
+            return make_packer(warm).pack(order=order)
         return probe.pack(order=order)
 
+    shard_warms: List[Optional[binpack.WarmStart]] = [None] * len(blocks)
+    if warm is not None:
+        seeds = (warm.shard_seeds
+                 if warm.shard_seeds is not None
+                 and len(warm.shard_seeds) == len(blocks)
+                 else [None] * len(blocks))
+        shard_warms = [
+            binpack.WarmStart(
+                global_token=warm.global_token + ("shard", i, len(blocks)),
+                tokens=warm.tokens, seed=seeds[i])
+            for i in range(len(blocks))]
+
     with TRACER.span("pack.shards", shards=len(blocks)):
-        packers = [probe] + [make_packer() for _ in blocks[1:]]
+        if warm is not None:
+            packers = [make_packer(w) for w in shard_warms]
+        else:
+            packers = [probe] + [make_packer() for _ in blocks[1:]]
 
         def run(i: int) -> binpack.PackResult:
             return packers[i].pack(order=blocks[i])
@@ -524,10 +635,15 @@ def sharded_pack(p: binpack.PackProblem, t: binpack.PackTensors, groups,
         else:
             results = [run(i) for i in range(len(blocks))]
 
+    if warm is not None:
+        warm.result_shard_seeds = [w.result_seed for w in shard_warms]
+        warm.restored_pos = sum(w.restored_pos for w in shard_warms)
+        warm.matched = sum(w.matched for w in shard_warms)
+
     with TRACER.span("pack.reconcile") as sp:
         merged = _reconcile(p, t, groups, packers, results,
                             initial_zone_counts, exist_counts,
-                            host_match_total, sp)
+                            host_match_total, sp, blocks=blocks, warm=warm)
     return merged
 
 
@@ -573,7 +689,8 @@ def _donor_rows(p, cs, groups, shards: int) -> np.ndarray:
 
 
 def _reconcile(p, t, groups, packers, results, izc, exist_counts,
-               host_match_total, span) -> binpack.PackResult:
+               host_match_total, span, blocks=None, warm=None
+               ) -> binpack.PackResult:
     """Cross-shard pass over the merged cohort winners: fold every shard's
     cohorts into one set, holding back each shard's underfilled single-node
     tail rows (see _donor_rows); then re-pack the held-back pods through a
@@ -583,7 +700,15 @@ def _reconcile(p, t, groups, packers, results, izc, exist_counts,
     different shards recombine exactly the way the sequential pack mixes
     groups; a row holding a hostname-pod-affinity group is never held back
     (its pods must stay on ONE node, which a split re-offer could
-    violate)."""
+    violate).
+
+    With a ``warm`` whose tokens fully match the recorded pass, the fold is
+    memoized (warm.reconcile_memo, persisted across passes by the
+    ProblemState): the merged rows and the donor pool restore from the
+    snapshot with group indices positionally remapped — the same trick as
+    Packer._remap_checkpoint — and the per-row donor scan is skipped. The
+    donor re-pack itself always runs (it consults current tensors and
+    per-group caps), so decisions stay byte-identical either way."""
     rp = binpack.Packer(
         p, t, groups, [None] * p.daemon_overhead.shape[0], [],
         initial_zone_counts=izc, exist_counts=exist_counts,
@@ -597,23 +722,71 @@ def _reconcile(p, t, groups, packers, results, izc, exist_counts,
     # a combined fill across receivers exactly as per-fragment calls would)
     pool: dict = {}  # (g, zone_or_None, cap) -> [fill, donor_template_m]
     held = 0
-    for res in results:
-        cs = res.cohorts
-        donor = _donor_rows(p, cs, groups, len(results))
-        for ci in range(cs.C):
-            pbg = cs.pods_by_group[ci]
-            caps = ([_group_per_node_cap(groups, g) for g in pbg]
-                    if donor[ci] else [])
-            if donor[ci] and all(c is not None for c in caps):
-                zone = int(cs.zone[ci])
-                zone = None if zone < 0 else zone
-                m = int(cs.m[ci])
-                held += 1
-                for (g, fill), cap in zip(pbg.items(), caps):
-                    slot = pool.setdefault((g, zone, cap), [0, m])
-                    slot[0] += fill
-            else:
-                merged.append_row_from(cs, ci)
+    memo_token = None
+    order_flat: tuple = ()
+    if warm is not None and blocks is not None:
+        memo_token = (warm.global_token,
+                      tuple(tuple(warm.tokens[g] for g in b) for b in blocks))
+        order_flat = tuple(g for b in blocks for g in b)
+    memo = warm.reconcile_memo if warm is not None else None
+    hit = (memo is not None and memo_token is not None
+           and memo["token"] == memo_token
+           and len(memo["order"]) == len(order_flat))
+    if hit:
+        # identical per-block tokens => the shard packs replayed the
+        # recorded pass byte-for-byte (modulo group renumbering), so the
+        # fold's output is the snapshot with indices remapped positionally
+        remap = dict(zip(memo["order"], order_flat))
+        C = memo["C"]
+        cap = merged._cap
+        while cap < max(C, 1):
+            cap *= 2
+        merged._cap = cap
+        for name in binpack.CohortSet._ROW_FIELDS:
+            src = memo["rows"][name]
+            if name == "aboard":
+                rem = np.zeros_like(src)
+                for og, ng in remap.items():
+                    rem[:, ng] = src[:, og]
+                src = rem
+            out = np.zeros((cap,) + src.shape[1:], src.dtype)
+            out[:C] = src[:C]
+            setattr(merged, name, out)
+        merged.C = C
+        merged.pods_by_group = [{remap[g]: f for g, f in d.items()}
+                                for d in memo["pods_by_group"]]
+        merged._okz_rows = {}
+        pool = {(remap[g], zone, pc): list(v)
+                for (g, zone, pc), v in memo["pool"].items()}
+        held = memo["held"]
+    else:
+        for res in results:
+            cs = res.cohorts
+            donor = _donor_rows(p, cs, groups, len(results))
+            for ci in range(cs.C):
+                pbg = cs.pods_by_group[ci]
+                caps = ([_group_per_node_cap(groups, g) for g in pbg]
+                        if donor[ci] else [])
+                if donor[ci] and all(c is not None for c in caps):
+                    zone = int(cs.zone[ci])
+                    zone = None if zone < 0 else zone
+                    m = int(cs.m[ci])
+                    held += 1
+                    for (g, fill), cap in zip(pbg.items(), caps):
+                        slot = pool.setdefault((g, zone, cap), [0, m])
+                        slot[0] += fill
+                else:
+                    merged.append_row_from(cs, ci)
+        if memo_token is not None:
+            # snapshot BEFORE the donor re-pack mutates merged; indices in
+            # the snapshot are THIS pass's — future hits remap positionally
+            warm.reconcile_memo = {
+                "token": memo_token, "order": order_flat, "C": merged.C,
+                "rows": {name: getattr(merged, name)[:merged.C].copy()
+                         for name in binpack.CohortSet._ROW_FIELDS},
+                "pods_by_group": [dict(d) for d in merged.pods_by_group],
+                "pool": {k: list(v) for k, v in pool.items()},
+                "held": held}
     # merge shard errors (disjoint by group: each group packs in one shard)
     errors: dict = {}
     limit_constrained = False
@@ -647,7 +820,8 @@ def _reconcile(p, t, groups, packers, results, izc, exist_counts,
                 raise RuntimeError(
                     "sharded-pack reconcile lost capacity re-opening "
                     f"tail fragments of group {g} ({left - opened} pods)")
-    span.set(donor_rows=held, items=len(items), boarded_pods=boarded)
+    span.set(donor_rows=held, items=len(items), boarded_pods=boarded,
+             merged="memo" if hit else "fold")
     out = binpack.PackResult()
     out.errors = errors
     out.limit_constrained = limit_constrained

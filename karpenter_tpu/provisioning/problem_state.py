@@ -63,10 +63,33 @@ Invalidation matrix — every delta a pass can carry, and what it costs:
 |                                         | would (partition_pods runs     |
 |                                         | per pass)                      |
 
+Sharded-state rows (attach_mesh: the state carved along the mesh's
+pods_groups axis — per-shard exist-row tokens, per-shard pack seeds, the
+cross-shard reconcile fold memo):
+
+| delta (sharded state)                   | effect                         |
+|-----------------------------------------|--------------------------------|
+| node churn within one shard's row span  | that shard's rows re-encode    |
+|                                         | and re-upload; every other     |
+|                                         | shard's device block is reused |
+|                                         | (mesh placer exist_shards)     |
+| group moved shards (FFD position hop)   | both affected blocks re-pack   |
+|                                         | cold past their shared prefix; |
+|                                         | untouched shards replay their  |
+|                                         | seeds; reconcile fold re-runs  |
+| mesh attach / detach / shard-count flip | per-shard seeds + reconcile    |
+|                                         | memo dropped (attach_mesh);    |
+|                                         | row + stack caches unaffected  |
+| new vocab entry (overflow) /            | cold everywhere — same as the  |
+| catalog change                          | unsharded rows above, per      |
+|                                         | shard too (tokens carry vocab) |
+
 Anything the matrix cannot express falls back to a cold encode/pack; the
 fallback is always decision-equivalent, never semantic. The churn fuzzer
 (tests/test_problem_state.py) interleaves arrivals/deletions/node churn/
-drought marks and asserts delta == cold at every step.
+drought marks and asserts delta == cold at every step; its sharded variant
+replays the same matrix against an attached mesh and asserts byte-identical
+decisions vs a cold mesh solve per window.
 """
 
 from __future__ import annotations
@@ -118,6 +141,22 @@ class ProblemState:
         self._topo_memo: Dict[tuple, tuple] = {}
         # warm-start seed from the previous pack
         self.seed: Optional[binpack.PackSeed] = None
+        # sharded-state attachment (attach_mesh): per-shard pack seeds and
+        # the cross-shard reconcile fold memo are only meaningful against
+        # ONE (mesh identity, exist-shard count, pack-shard count) tuple
+        self._attach_key: tuple = (None, 0, 0)
+        self.shard_seeds: Optional[list] = None
+        self._reconcile_memo: Optional[dict] = None
+        # per-shard exist-row tokens of the LAST node_rows call (None when
+        # unsharded / the padded axis doesn't divide): build_problem copies
+        # them onto PackProblem.exist_shard_tokens for the mesh placer
+        self.exist_shard_tokens: Optional[tuple] = None
+        # ((group_part, exist_part), PackTensors) of the last precompute:
+        # the device kernel is factored so group_count is NOT an input and
+        # the exist side only feeds exist_ok/exist_cap — a node-churn pass
+        # under an unchanged group part re-runs ONLY the exist-only delta
+        # kernel (binpack.exist_delta) and splices the pair in
+        self.tensors_memo: Optional[tuple] = None
         # cumulative
         self.stats = {
             "solves": 0, "cold_encodes": 0, "delta_encodes": 0,
@@ -137,8 +176,27 @@ class ProblemState:
         self._sig_memo = {}
         self.last = {"encode_kind": "cold", "node_rows_reencoded": 0,
                      "group_rows_encoded": 0, "topo_groups_counted": 0,
-                     "warm": "none", "warm_restored": 0, "warm_matched": 0}
+                     "warm": "none", "warm_restored": 0, "warm_matched": 0,
+                     "precompute": "computed"}
         self.stats["solves"] += 1
+
+    def attach_mesh(self, mesh_token, exist_shards: int,
+                    pack_shards: int) -> None:
+        """Bind the state to a mesh/shard-count identity (called by each
+        TensorScheduler construction). A flip — mesh recreated over other
+        devices, shard count changed, mesh dropped — invalidates every
+        per-shard artifact: seeds are keyed by (shard index, shard count)
+        inside their global tokens and the reconcile memo by the block
+        carve, so none of them can describe the new carve. Row, stack and
+        topology caches are shard-independent and survive untouched."""
+        key = (mesh_token, int(exist_shards), int(pack_shards))
+        if key == self._attach_key:
+            return
+        self._attach_key = key
+        self.shard_seeds = None
+        self._reconcile_memo = None
+        self.exist_shard_tokens = None
+        self.tensors_memo = None
 
     def note_encode(self, vocab) -> str:
         """cold vs delta for this solve: delta iff the catalog encoding
@@ -182,9 +240,10 @@ class ProblemState:
             self._node_stack = None
         rows = self._node_rows
         reencoded = 0
+        dirty_idx: List[int] = []
         fresh: Dict[tuple, tuple] = {}
         keys = []
-        for sn in state_nodes:
+        for i, sn in enumerate(state_nodes):
             # cache key (name, identity); row-validity token (identity,
             # revision). The identity distinguishes both a deleted-and-
             # recreated node under the same name (whose replayed event
@@ -213,17 +272,44 @@ class ProblemState:
                        vocab.value_idx[zone_key].get(z, -1),
                        sn.taints())
                 reencoded += 1
+                dirty_idx.append(i)
             fresh[key] = row
         self._node_rows = fresh
         self.last["node_rows_reencoded"] = reencoded
         self.stats["node_rows_reencoded"] += reencoded
-        exist_token = (vocab, ds_token,
-                       tuple((k, getattr(sn, "revision", None))
-                             for k, sn in zip(keys, state_nodes)))
-        if self._node_stack_token == exist_token:
-            return self._node_stack + (exist_token,)
+        revs = tuple((k, getattr(sn, "revision", None))
+                     for k, sn in zip(keys, state_nodes))
+        exist_token = (vocab, ds_token, revs)
         N = len(state_nodes)
         Np = _pow2_bucket(N, 16)
+        # per-shard exist tokens over contiguous Np/S row spans: a dirty
+        # row only breaks ITS span's token, so the mesh placer re-uploads
+        # one shard's block (rows past N are padding — constant, so they
+        # ride the span token implicitly via s/S/Np)
+        S = int(self._attach_key[1])
+        if S > 1 and Np % S == 0:
+            from ..metrics.registry import PROBLEM_STATE_SHARD_ROWS
+            shard_dirty: Dict[int, int] = {}
+            toks = []
+            for s, (start, stop) in enumerate(enc.shard_spans(Np, S)):
+                real = max(0, min(stop, N) - start)
+                d = sum(1 for i in dirty_idx if start <= i < stop)
+                shard_dirty[s] = d
+                toks.append((vocab, ds_token, revs[start:start + real],
+                             s, S, Np))
+                if d:
+                    PROBLEM_STATE_SHARD_ROWS.inc(
+                        {"shard": str(s), "outcome": "reencoded"}, value=d)
+                if real - d:
+                    PROBLEM_STATE_SHARD_ROWS.inc(
+                        {"shard": str(s), "outcome": "clean"},
+                        value=real - d)
+            self.exist_shard_tokens = tuple(toks)
+            self.last["shard_dirty"] = shard_dirty
+        else:
+            self.exist_shard_tokens = None
+        if self._node_stack_token == exist_token:
+            return self._node_stack + (exist_token,)
         encs = [fresh[k][1] for k in keys]
         taint_lists = [fresh[k][4] for k in keys]
         if Np > N:
@@ -351,13 +437,30 @@ class ProblemState:
                 None if exist_counts is None else exist_counts[i].tobytes(),
                 None if host_total is None else int(host_total[i])))
         return binpack.WarmStart(global_token=global_token, tokens=tokens,
-                                 seed=self.seed)
+                                 seed=self.seed,
+                                 shard_seeds=self.shard_seeds,
+                                 reconcile_memo=self._reconcile_memo)
 
     def finish_pack(self, warm: Optional[binpack.WarmStart]) -> None:
         if warm is None:
             return
-        if warm.result_seed is not None:
+        # the reconcile memo is token-guarded on read, so it survives
+        # sequential passes untouched and is replaced when the fold re-ran
+        self._reconcile_memo = warm.reconcile_memo
+        if warm.result_shard_seeds is not None:
+            # sharded pack: one seed per FFD block. The sequential seed is
+            # dropped — it describes a pack this pass superseded — and
+            # symmetrically below a sequential pass drops the shard seeds.
+            self.shard_seeds = warm.result_shard_seeds
+            self.seed = None
+            self.last["warm"] = (f"shards:prefix:{warm.restored_pos}"
+                                 if warm.restored_pos else "shards:recorded")
+            self.last["warm_restored"] = warm.restored_pos
+            self.last["warm_matched"] = warm.matched
+            self.stats["warm_restored_groups"] += warm.restored_pos
+        elif warm.result_seed is not None:
             self.seed = warm.result_seed
+            self.shard_seeds = None
             self.last["warm"] = (f"prefix:{warm.restored_pos}"
                                  if warm.restored_pos else "recorded")
             self.last["warm_restored"] = warm.restored_pos
@@ -368,4 +471,5 @@ class ProblemState:
             # full pack, and the stale seed must not survive — its
             # checkpoints no longer describe the latest decisions
             self.seed = None
+            self.shard_seeds = None
             self.last["warm"] = "disabled:inexpressible"

@@ -42,6 +42,14 @@ def _pow2_bucket(n: int, minimum: int) -> int:
     return enc.pow2_bucket(n, minimum)
 
 
+def _single_process() -> bool:
+    """Gate for the exist-only delta kernel (binpack.exist_delta): it runs
+    a plain single-device jit over the full node axis, which a multi-
+    process fleet can't serve — each process holds only its local rows."""
+    import jax
+    return jax.process_count() == 1
+
+
 @dataclass
 class _CatalogEncoding:
     """Catalog-side tensors shared across solves. The instance-type catalog
@@ -329,7 +337,8 @@ class TensorScheduler:
         # sequential pack in remainder-node composition (pod errors stay
         # exact), so the default 0 keeps every caller on the oracle-exact
         # sequential pack. Engages only when the problem passes the
-        # pack_shardable() gate and no warm-start is in play.
+        # pack_shardable() gate; a ProblemState warm start composes (the
+        # pack carries per-shard seeds + a reconcile memo on the WarmStart).
         self.pack_shards = pack_shards
         # precomputed catalog cache key (catalog_cache_token): ONLY valid
         # when the caller guarantees the catalog is never mutated in place
@@ -359,6 +368,21 @@ class TensorScheduler:
         # seed). None (the default) keeps the self-contained cold path —
         # disruption simulation probes and ad-hoc schedulers never share it.
         self.problem_state = problem_state
+        if problem_state is not None:
+            # bind the state to this scheduler's mesh/shard identity: a
+            # flip (mesh recreated over other devices, shard count change,
+            # mesh dropped) drops the per-shard seeds + reconcile memo so
+            # a mesh<->single-device swap in one process can never replay
+            # artifacts recorded under the other carve
+            if mesh is not None:
+                from ..parallel.mesh import (PODS_GROUPS_AXIS,
+                                             mesh_cache_key)
+                problem_state.attach_mesh(
+                    mesh_cache_key(mesh),
+                    int(dict(mesh.shape).get(PODS_GROUPS_AXIS, 0)),
+                    pack_shards)
+            else:
+                problem_state.attach_mesh(None, 0, pack_shards)
         # trace id of the pass this scheduler's last solve() ran under
         # ("" when tracing is disabled): stamped onto flight-recorder
         # records and the provisioner's summary log line
@@ -810,6 +834,12 @@ class TensorScheduler:
                 tol_exist = _tol_exist_matrix(groups, taint_lists,
                                               exist_enc.mask.shape[0])
                 nsp.set(dirty=ps.last["node_rows_reencoded"])
+                sd = ps.last.get("shard_dirty")
+                if sd is not None:
+                    # per-shard dirty-row counts, "shard:count" pairs —
+                    # the sharded state's delta-residency trace signal
+                    nsp.set(shard_dirty=",".join(
+                        f"{s}:{d}" for s, d in sorted(sd.items())))
         elif self.state_nodes:
             with TRACER.span("encode.nodes", nodes=len(self.state_nodes)):
                 exist_enc, exist_avail, exist_zone, tol_exist = \
@@ -851,7 +881,10 @@ class TensorScheduler:
             exist_enc=exist_enc, exist_avail=exist_avail, exist_zone=exist_zone,
             tol_exist=tol_exist, allow_undefined=allow_undefined,
             device_cache=device_cache, min_its=min_its,
-            exist_token=exist_token)
+            exist_token=exist_token,
+            exist_shard_tokens=(ps.exist_shard_tokens
+                                if ps is not None and exist_token is not None
+                                else None))
         return problem, templates, catalog
 
     def _cold_node_rows(self, vocab, zone_key: int, groups, G: int):
@@ -1209,8 +1242,46 @@ class TensorScheduler:
         vocab = problem.vocab
         zone_key = problem.zone_key
 
-        with TRACER.span("precompute"):
-            tensors = self.precompute(problem)
+        ps = self.problem_state
+        with TRACER.span("precompute") as pcs:
+            # persistent tensors memo (sharded-state churn fast path): the
+            # device kernel's group side reads nothing that changes on a
+            # pure count-wobble/node-churn pass, and the exist side feeds
+            # ONLY exist_ok/exist_cap — so a group-part hit with a dirty
+            # exist part runs the exist-only delta kernel (bit-identical
+            # ops to the full kernel's exist branch) and splices the pair
+            tensors = None
+            memo_tok = None
+            if ps is not None:
+                memo_tok = (
+                    (vocab, tuple(ps.sig(g) for g in groups), len(groups),
+                     ps._daemon_token(self.daemonset_pods),
+                     ps._templates_token(templates),
+                     tuple(self.drought_patterns),
+                     None if problem.min_its is None
+                     else problem.min_its.tobytes(),
+                     zone_key, problem.captype_key),
+                    problem.exist_token)
+                memo = ps.tensors_memo
+                if memo is not None and memo[0] == memo_tok:
+                    tensors = memo[1]
+                    ps.last["precompute"] = "reused"
+                elif (memo is not None and memo[0][0] == memo_tok[0]
+                      and memo_tok[1] is not None
+                      and problem.exist_enc is not None
+                      and _single_process()):
+                    import dataclasses
+                    exist_ok, exist_cap = binpack.exist_delta(problem)
+                    tensors = dataclasses.replace(
+                        memo[1], exist_ok=exist_ok, exist_cap=exist_cap)
+                    ps.last["precompute"] = "delta"
+            if tensors is None:
+                tensors = self.precompute(problem)
+                if ps is not None:
+                    ps.last["precompute"] = "computed"
+            if ps is not None:
+                ps.tensors_memo = (memo_tok, tensors)
+                pcs.set(reused=ps.last["precompute"])
 
         # nodepool limits (scaled), minus existing node capacity per pool
         limits: List[Optional[dict]] = []
@@ -1281,7 +1352,10 @@ class TensorScheduler:
                 self, vocab, groups, templates, limits,
                 izc, exist_counts, host_total, problem.exist_token)
         use_sharded = False
-        if self.pack_shards > 1 and warm is None:
+        if self.pack_shards > 1:
+            # warm no longer forces the sequential pack: sharded_pack
+            # carries per-shard WarmStarts (warm.shard_seeds) through the
+            # same checkpoint machinery, so the sharded state warm-replays
             from ..parallel.mesh import pack_shardable
             use_sharded = pack_shardable(problem, limits, group_ports,
                                          vol_group_counts)
@@ -1293,7 +1367,8 @@ class TensorScheduler:
                                   self.pack_shards,
                                   initial_zone_counts=izc,
                                   exist_counts=exist_counts,
-                                  host_match_total=host_total)
+                                  host_match_total=host_total,
+                                  warm=warm)
             else:
                 packer = binpack.Packer(problem, tensors, groups, limits,
                                         limit_resources,

@@ -470,3 +470,115 @@ def test_group_size_aware_donor_bar_directed_vector(monkeypatch):
     assert sum(len(nc.pods) for nc in r_old.new_nodeclaims) == placed
     # coalescing the donated tails never costs nodes vs the frozen bar
     assert len(r_new.new_nodeclaims) <= len(r_old.new_nodeclaims)
+
+
+# ---------------------------------------------------------------------------
+# sharded ProblemState (ISSUE 18): device-identity exist keying + the
+# cross-shard reconcile memo
+# ---------------------------------------------------------------------------
+
+def test_exist_upload_reuse_keyed_on_device_identity(monkeypatch):
+    """The cached exist-side upload must key on (content token, PLACEMENT
+    identity), not the content token alone: a default-device change (or a
+    mesh<->single-device flip) between two solves of the same ProblemState
+    reuses the same exist_token but must never be served the other
+    placement's arrays."""
+    import dataclasses
+
+    from factories import make_state_node
+
+    its = construct_instance_types()[:24]
+    pool = make_nodepool(name="default")
+    nodes = [make_state_node(f"exist-{i}", cpu="16", memory="64Gi")
+             for i in range(3)]
+    ts = TensorScheduler([pool], {"default": its}, state_nodes=nodes)
+    groups, reason = group_pods(_mix_pods(4))
+    assert groups is not None, reason
+    problem, _, _ = ts.build_problem(groups)
+    p = dataclasses.replace(problem, exist_token=("content", 1),
+                            device_cache={})
+
+    args1, _ = binpack.device_args(p)
+    args2, _ = binpack.device_args(p)
+    # same content + same device: the pair is served from the slot
+    assert args2[-3] is args1[-3] and args2[-2] is args1[-2]
+
+    # flip the placement identity under an UNCHANGED content token: the
+    # slot must re-place, not serve the stale pair
+    monkeypatch.setattr(binpack.ArgPlacer, "device_token",
+                        lambda self: ("dev", "elsewhere", 999))
+    args3, _ = binpack.device_args(p)
+    assert args3[-3] is not args1[-3], \
+        "exist upload served across a device-identity flip"
+    monkeypatch.undo()
+    # flipping BACK is a miss again (the slot now holds the other identity)
+    args4, _ = binpack.device_args(p)
+    assert args4[-3] is not args3[-3]
+
+
+def test_mesh_single_device_flip_shared_problem_state_parity():
+    """One persistent ProblemState driven through a mesh solve, then a
+    single-device solve, then the mesh again (same cluster, same batch):
+    every hop must produce decisions identical to a state-free cold solve —
+    the exist/catalog device caches are namespaced per placement, so a flip
+    re-places instead of feeding one path the other's arrays."""
+    from factories import make_state_node
+    from karpenter_tpu.provisioning.problem_state import ProblemState
+
+    if len(jax.devices()) < 8:
+        pytest.skip("not enough devices")
+    its = construct_instance_types()[:24]
+    pool = make_nodepool(name="default")
+    nodes = [make_state_node(f"exist-{i}", cpu="16", memory="64Gi")
+             for i in range(3)]
+    pods = _mix_pods(6)
+
+    def solve(mesh, state):
+        ts = TensorScheduler([pool], {"default": its}, state_nodes=nodes,
+                             mesh=mesh, problem_state=state)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        return r
+
+    oracle = _claims_digest(solve(None, None))
+    ps = ProblemState()
+    mesh = make_solver_mesh(8)
+    for hop, m in (("mesh", mesh), ("single", None), ("mesh-again", mesh)):
+        r = solve(m, ps)
+        assert _claims_digest(r) == oracle, \
+            f"{hop} hop diverged after a placement flip"
+
+
+def test_sharded_pack_reconcile_memo_reused_on_unchanged_warm():
+    """The cross-shard reconcile fold is memoized against the warm token +
+    per-shard group content: a second solve of the identical batch through
+    the same ProblemState must serve the merged CohortSet from the memo
+    (pack.reconcile span attr merged=memo) with decisions unchanged."""
+    from karpenter_tpu.provisioning.problem_state import ProblemState
+
+    its = construct_instance_types()[:24]
+    pool = make_nodepool(name="default")
+    pods = _mix_pods(12, pods_per=9)
+    ps = ProblemState()
+
+    def solve(state):
+        ts = TensorScheduler([pool], {"default": its}, mesh=None,
+                             problem_state=state, pack_shards=4)
+        r = ts.solve(pods)
+        assert ts.fallback_reason == "", ts.fallback_reason
+        return r
+
+    oracle = solve(None)
+    assert _pack_span().attrs.get("sharded") == 4
+
+    r1 = solve(ps)
+    assert _reconcile_span().attrs.get("merged") == "fold"
+    r2 = solve(ps)
+    span2 = _reconcile_span()
+    assert span2.attrs.get("merged") == "memo", \
+        "unchanged warm solve re-ran the reconcile fold"
+    for r in (r1, r2):
+        assert _claims_digest(r) == _claims_digest(oracle)
+        assert r.pod_errors == oracle.pod_errors
+    # the memoized merge holds the same donor rows the fold produced
+    assert span2.attrs.get("donor_rows") is not None

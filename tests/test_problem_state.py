@@ -548,3 +548,100 @@ def test_churn_fuzzer_delta_matches_cold_every_step(seed):
 
     st = env.ps.stats
     assert st["delta_encodes"] > 0, st  # the stream actually rode deltas
+
+
+# -- sharded-state churn fuzzer (ISSUE 18) -----------------------------------
+
+
+class MeshChurnEnv(ChurnEnv):
+    """ChurnEnv whose schedulers (delta AND cold control) run on the
+    8-device (pods_groups x catalog) mesh: the persistent ProblemState is
+    sharded along the pods_groups axis, so the fuzzer's invalidation matrix
+    runs against per-shard exist tokens + the per-shard upload cache."""
+
+    def __init__(self, *args, **kwargs):
+        from karpenter_tpu.parallel.mesh import make_solver_mesh
+        self.mesh = make_solver_mesh(8)
+        super().__init__(*args, **kwargs)
+
+    def scheduler(self, ps, unavailable=True):
+        state_nodes = [sn for sn in self.cluster.state_nodes()
+                       if not sn.deleting()]
+        return TensorScheduler(
+            [self.pool], {"default": self.catalog},
+            state_nodes=state_nodes,
+            cluster=StateClusterView(self.store, self.cluster),
+            unavailable=self.registry if unavailable else None,
+            mesh=self.mesh, problem_state=ps)
+
+
+@pytest.mark.parametrize("seed", [7, 31, 61])
+def test_sharded_churn_fuzzer_delta_matches_cold_mesh_every_step(seed):
+    """The DEVIATIONS 19 invalidation matrix against the SHARDED state:
+    node churn (one shard's rows dirty), group moves (an app's shape
+    changes, shifting its FFD slot), vocab growth (a new node's hostname
+    enters the requirement vocabulary -> cold everywhere), drought-pattern
+    bumps and expiries — every step's delta solve on the mesh must match a
+    cold mesh solve of the same state BIT-IDENTICALLY."""
+    import random
+
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device virtual CPU platform")
+    rng = random.Random(seed)
+    env = MeshChurnEnv(n_nodes=6, pods_per_node=2,
+                       catalog=construct_instance_types())
+    shapes = [dict(cpu="100m"), dict(cpu="250m", spread_key="zone"),
+              dict(cpu="500m", host_spread=True), dict(cpu="750m")]
+    pending = {}
+    step_seq = 0
+    saw_shard_dirty = False
+    for step in range(24):
+        op = rng.choice(["arrive", "arrive", "complete", "node-churn",
+                         "group-move", "drought", "expire", "vocab-grow"])
+        if op == "arrive":
+            d = rng.randrange(6)
+            step_seq += 1
+            kw = dict(shapes[d % len(shapes)])
+            pending.setdefault(d, []).extend(
+                deployment(f"fzm-{d}-{step_seq}", rng.randrange(1, 5), **kw))
+        elif op == "complete" and pending:
+            d = rng.choice(list(pending))
+            drop = rng.randrange(0, len(pending[d]) + 1)
+            pending[d] = pending[d][drop:]
+            if not pending[d]:
+                del pending[d]
+        elif op == "node-churn":
+            env.complete_bound(f"churn-node-{rng.randrange(6):03d}")
+        elif op == "group-move" and pending:
+            # the group keeps its app identity but changes shape: a new
+            # signature lands in a different FFD slot
+            d = rng.choice(list(pending))
+            step_seq += 1
+            pending[d] = deployment(f"fzm-{d}-{step_seq}",
+                                    max(1, len(pending[d])),
+                                    cpu=f"{rng.choice([150, 350, 650])}m")
+        elif op == "drought":
+            it = rng.choice(env.catalog)
+            env.registry.mark(instance_type=it.name,
+                              zone=rng.choice(["test-zone-a",
+                                               "test-zone-b"]))
+        elif op == "expire":
+            env.clock.step(rng.choice([30, 400, 2000]))
+            env.registry.expire()
+        elif op == "vocab-grow":
+            # a brand-new hostname enters the requirement vocabulary:
+            # every shard's rows go cold at once
+            env.add_node(10 + step, pods_per_node=1)
+        batch = [p for pods in pending.values() for p in pods]
+        if not batch:
+            continue
+        env.solve_pair(batch)  # asserts delta == cold (both on the mesh)
+        sd = env.ps.last.get("shard_dirty")
+        if sd and sum(sd.values()) > 0:
+            saw_shard_dirty = True
+
+    st = env.ps.stats
+    assert st["delta_encodes"] > 0, st  # the stream actually rode deltas
+    assert saw_shard_dirty, \
+        "no step ever dirtied a shard's rows — the sharded state never engaged"
