@@ -41,7 +41,7 @@ N_ITS = int(os.environ.get("BENCH_ITS", "0"))  # 0 = kwok 144-type catalog
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 # provisioning|consolidation|single|spot|mesh|mesh-local|mesh-headroom|
 # sidecar|service|svc-faults|svc-fleet|minvalues|faults|replay|drought|
-# churn|trace|all
+# churn|stateplane|trace|all
 MODE = os.environ.get("BENCH_MODE", "all")
 # BENCH_MODE=service knobs: concurrent tenant clusters driving ONE sidecar,
 # timed warm-delta windows per tenant, % of each tenant's pods replaced per
@@ -116,6 +116,19 @@ CHURN_WINDOWS = int(os.environ.get("BENCH_CHURN_WINDOWS", "20"))
 CHURN_ARRIVALS = int(os.environ.get("BENCH_CHURN_ARRIVALS", "600"))
 CHURN_PODS_PER_NODE = int(os.environ.get("BENCH_CHURN_PODS_PER_NODE", "10"))
 CHURN_MIN_RATE = float(os.environ.get("BENCH_CHURN_MIN_RATE", "1000"))
+# BENCH_MODE=stateplane knobs (ISSUE 19): nodes in the warm fleet, bound
+# pods per node (node churn completes one), timed windows, node rows
+# dirtied per window, instance types, and the floor on
+# (two-private-states encode wall) / (shared-plane encode wall) measured
+# in the SAME run — the shared EncodePlane must be >= STATEPLANE_RATIO
+# times better at the steady-state encode.
+STATEPLANE_NODES = int(os.environ.get("BENCH_STATEPLANE_NODES", "2048"))
+STATEPLANE_PODS_PER_NODE = int(os.environ.get(
+    "BENCH_STATEPLANE_PODS_PER_NODE", "2"))
+STATEPLANE_WINDOWS = int(os.environ.get("BENCH_STATEPLANE_WINDOWS", "8"))
+STATEPLANE_CHURN = int(os.environ.get("BENCH_STATEPLANE_CHURN", "64"))
+STATEPLANE_ITS = int(os.environ.get("BENCH_STATEPLANE_ITS", "500"))
+STATEPLANE_RATIO = float(os.environ.get("BENCH_STATEPLANE_RATIO", "1.5"))
 # BENCH_MODE=sim knobs: clip the mixed-day scenario to the first N
 # simulated seconds (0 = the full 24 h; TestSimBudget clips for tier-1),
 # and the wall-clock compression floor the replay must hold
@@ -1046,6 +1059,242 @@ def bench_churn():
         "nodes_churned": churned_total,
         "warm_restored_groups": ps.stats["warm_restored_groups"],
         "delta_encodes": ps.stats["delta_encodes"],
+    }), flush=True)
+
+
+def bench_stateplane():
+    """ISSUE 19 acceptance line (BENCH_MODE=stateplane): the shared encode
+    plane vs two private ProblemStates, in the SAME run. A warm fleet of
+    STATEPLANE_NODES nodes absorbs STATEPLANE_WINDOWS churn windows; each
+    window dirties STATEPLANE_CHURN node rows (a bound pod completes) and
+    introduces one fresh deployment shape, then FOUR encode passes run
+    against the identical cluster state and pending batch: a
+    provisioning-style and a disruption-style pass over ONE EncodePlane
+    (two subscriber handles), and the same two passes over two PRIVATE
+    ProblemStates (the pre-ISSUE-19 layout). Pins the tentpole's claims:
+
+    (1) ROWS ENCODE ONCE per revision bump — the plane's
+        node_rows_encoded counter grows by exactly the dirtied rows per
+        window: the second subscriber reports zero reencodes (all rows
+        served shared), while each private baseline state pays every
+        dirty row again;
+    (2) ONE exist-side device upload serves both shared passes — the
+        vocab device-cache slot re-keys exactly once per revision bump
+        (on the provisioning pass) and the disruption pass is served the
+        SAME cached slot (object identity), crossing the host->device
+        boundary zero additional times;
+    (3) the steady-state encode wall time — the plane surface itself:
+        node_rows (dirty-row re-encode + stack assembly) plus the
+        window's group_row calls, summed over both passes — is
+        >= STATEPLANE_RATIO x better shared than private. The timed
+        section is the ENCODE layer, not build_problem wholesale: the
+        per-pass catalog-identity checks (_fits_vocab, cache keys) cost
+        the same on every path and would only dilute the comparison,
+        and the upload is untimed because the catalog-encoding device
+        cache is content-keyed and process-wide, so even the private
+        baseline is served the shared run's upload."""
+    from karpenter_tpu.api.nodeclaim import (COND_INITIALIZED, COND_LAUNCHED,
+                                             COND_REGISTERED, NodeClaim,
+                                             NodeClaimSpec)
+    from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus
+    from karpenter_tpu.kube.store import Store
+    from karpenter_tpu.ops import binpack
+    from karpenter_tpu.provisioning.grouping import group_pods
+    from karpenter_tpu.provisioning.problem_state import ProblemState
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informers import wire_informers
+    from karpenter_tpu.state.plane import EncodePlane
+    from karpenter_tpu.utils.clock import FakeClock
+
+    n_its = N_ITS or STATEPLANE_ITS
+    catalog = _catalog(n_its)
+    clock = FakeClock()
+    store = Store(clock)
+    cluster = Cluster(store, clock)
+    wire_informers(store, cluster)
+    nodepool = NodePool(metadata=ObjectMeta(name="default"),
+                        spec=NodePoolSpec(template=NodeClaimTemplate(
+                            spec=NodeClaimTemplateSpec())))
+    big = max(catalog, key=lambda it: (it.capacity.get("cpu", 0), it.name))
+    bound_by_node = {}
+    for i in range(STATEPLANE_NODES):
+        name = f"plane-node-{i:05d}"
+        labels = {
+            api_labels.LABEL_HOSTNAME: name,
+            api_labels.NODEPOOL_LABEL_KEY: "default",
+            api_labels.NODE_INITIALIZED_LABEL_KEY: "true",
+            api_labels.NODE_REGISTERED_LABEL_KEY: "true",
+            api_labels.LABEL_INSTANCE_TYPE: big.name,
+            api_labels.LABEL_TOPOLOGY_ZONE: f"test-zone-{'abc'[i % 3]}",
+            api_labels.CAPACITY_TYPE_LABEL_KEY:
+                api_labels.CAPACITY_TYPE_ON_DEMAND,
+        }
+        nc = NodeClaim(metadata=ObjectMeta(name=f"plane-nc-{i:05d}",
+                                           namespace="",
+                                           labels=dict(labels)),
+                       spec=NodeClaimSpec())
+        nc.status.provider_id = f"plane://{i}"
+        nc.status.node_name = name
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.conditions.set_true(cond, now=clock.now())
+        store.create(nc)
+        store.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"plane://{i}"),
+            status=NodeStatus(capacity=dict(big.capacity),
+                              allocatable=big.allocatable())))
+        pods_here = []
+        for j in range(STATEPLANE_PODS_PER_NODE):
+            p = Pod(metadata=ObjectMeta(name=f"pwarm-{i}-{j}",
+                                        namespace="default",
+                                        labels={"warm": f"w{i % 20}"}),
+                    spec=PodSpec(node_name=name),
+                    container_requests=[res.parse_list(
+                        {"cpu": "100m", "memory": "64Mi"})])
+            store.create(p)
+            pods_here.append(p)
+        bound_by_node[name] = pods_here
+
+    def batch(window: int) -> list:
+        """4 standing deployment shapes + ONE fresh shape per window (a
+        unique request combination, so its group signature is genuinely
+        new to every cache)."""
+        out = []
+        for k in range(4):
+            requests = res.parse_list({"cpu": _CPUS[k % 5],
+                                       "memory": _MEMS[k % 5]})
+            for j in range(4):
+                out.append(Pod(
+                    metadata=ObjectMeta(name=f"std-{window}-{k}-{j}",
+                                        namespace="default",
+                                        labels={"app": f"plane-{k}"}),
+                    container_requests=[requests]))
+        fresh = res.parse_list({"cpu": f"{101 + window}m", "memory": "96Mi"})
+        for j in range(4):
+            out.append(Pod(
+                metadata=ObjectMeta(name=f"roll-{window}-{j}",
+                                    namespace="default",
+                                    labels={"app": f"roll-{window}"}),
+                container_requests=[fresh]))
+        return out
+
+    def live_nodes():
+        return [sn for sn in cluster.state_nodes() if not sn.deleting()]
+
+    def build(ps, state_nodes, groups):
+        """Untimed full build_problem (the parity/upload-assert path)."""
+        ts = TensorScheduler([nodepool], {"default": catalog},
+                             state_nodes=state_nodes, problem_state=ps)
+        problem, _, _ = ts.build_problem(groups)
+        return problem
+
+    def encode_pass(ps, state_nodes, groups, vocab, zone_key):
+        """One subscriber's timed encode through the plane surface:
+        node rows (dirty re-encode + stack assembly) + group rows."""
+        t0 = time.perf_counter()
+        ps.node_rows(vocab, zone_key, state_nodes, [])
+        for g in groups:
+            ps.group_row(vocab, g)
+        return time.perf_counter() - t0
+
+    plane = EncodePlane(name="bench-stateplane")
+    sh_prov = plane.subscribe("provisioning")
+    sh_dis = plane.subscribe("disruption")
+    pr_prov = ProblemState()
+    pr_dis = ProblemState()
+    handles = (sh_prov, sh_dis, pr_prov, pr_dis)
+
+    # untimed warmup: the cold encode for every plane (catalog encode,
+    # full node-row encode, first stacks) + the first exist-side upload
+    nodes0 = live_nodes()
+    g0, reason = group_pods(batch(0))
+    assert g0 is not None, reason
+    for ps in handles:
+        p0 = build(ps, nodes0, g0)
+    binpack.device_args(p0)
+    ex_key = ("exist_side",)
+    from karpenter_tpu.provisioning.tensor_scheduler import (
+        _CATALOG_CACHE, _catalog_cache_key)
+    ce = _CATALOG_CACHE[_catalog_cache_key(catalog)]
+    vocab, zone_key = ce.vocab, ce.zone_key
+
+    shared_s = 0.0
+    private_s = 0.0
+    dirtied_total = 0
+    uploads = 0
+    for w in range(1, STATEPLANE_WINDOWS + 1):
+        dirtied = 0
+        for i in range(STATEPLANE_CHURN):
+            name = f"plane-node-{(w * 131 + i * 977) % STATEPLANE_NODES:05d}"
+            pods_here = bound_by_node[name]
+            if pods_here:
+                store.delete(pods_here.pop())
+                dirtied += 1
+        dirtied_total += dirtied
+        nodes = live_nodes()
+        groups, reason = group_pods(batch(w))
+        assert groups is not None, reason
+        enc0 = plane.stats["node_rows_encoded"]
+        t1 = encode_pass(sh_prov, nodes, groups, vocab, zone_key)
+        assert sh_prov.last["node_rows_reencoded"] == dirtied, \
+            (w, dirtied, sh_prov.last)
+        t2 = encode_pass(sh_dis, nodes, groups, vocab, zone_key)
+        # claim (1): the disruption subscriber re-encodes NOTHING — every
+        # row (including this window's dirty ones) is served shared
+        assert sh_dis.last["node_rows_reencoded"] == 0, sh_dis.last
+        assert plane.stats["node_rows_encoded"] - enc0 == dirtied, \
+            (w, dirtied, plane.stats)
+        t3 = encode_pass(pr_prov, nodes, groups, vocab, zone_key)
+        assert pr_prov.last["node_rows_reencoded"] == dirtied
+        t4 = encode_pass(pr_dis, nodes, groups, vocab, zone_key)
+        assert pr_dis.last["node_rows_reencoded"] == dirtied
+        shared_s += t1 + t2
+        private_s += t3 + t4
+        # claim (2), untimed: one upload per revision bump, shared by both
+        # passes. The slot tuple is replaced on upload, so object identity
+        # across the second device_args proves the disruption pass crossed
+        # the host->device boundary zero times.
+        p1 = build(sh_prov, nodes, groups)
+        p2 = build(sh_dis, nodes, groups)
+        assert p1.exist_token == p2.exist_token
+        before = p1.device_cache.get(ex_key)
+        binpack.device_args(p1)
+        slot1 = p1.device_cache.get(ex_key)
+        if dirtied:
+            assert slot1 is not before, "revision bump must re-upload"
+            uploads += 1
+        binpack.device_args(p2)
+        assert p2.device_cache.get(ex_key) is slot1, \
+            "disruption pass re-uploaded an exist side the plane shares"
+
+    assert plane.stats["node_rows_shared"] > 0
+    assert plane.stats["group_rows_shared"] > 0
+    assert plane.stats["stack_hits"] > 0
+    ratio = private_s / shared_s if shared_s else float("inf")
+    assert ratio >= STATEPLANE_RATIO, (
+        f"shared-plane encode only {ratio:.2f}x better than two private "
+        f"states (< {STATEPLANE_RATIO:.2f}x floor): shared "
+        f"{shared_s * 1000:.1f}ms vs private {private_s * 1000:.1f}ms")
+    print(json.dumps({
+        "metric": (f"one state plane: two-subscriber steady-state encode "
+                   f"wall vs two private ProblemStates in the same run "
+                   f"({STATEPLANE_NODES} nodes x {n_its} instance types, "
+                   f"{STATEPLANE_WINDOWS} churn windows, "
+                   f"{STATEPLANE_CHURN} rows dirtied per window; rows "
+                   "encode once per revision bump, one shared exist-side "
+                   "upload)"),
+        "value": round(ratio, 2),
+        "unit": "x encode speedup",
+        "vs_baseline": round(ratio / STATEPLANE_RATIO, 2),
+        "shared_ms": round(shared_s * 1000, 1),
+        "private_ms": round(private_s * 1000, 1),
+        "windows": STATEPLANE_WINDOWS,
+        "dirtied_rows": dirtied_total,
+        "exist_uploads": uploads,
+        "node_rows_encoded": plane.stats["node_rows_encoded"],
+        "node_rows_shared": plane.stats["node_rows_shared"],
+        "group_rows_shared": plane.stats["group_rows_shared"],
+        "stack_hits": plane.stats["stack_hits"],
     }), flush=True)
 
 
@@ -3184,7 +3433,8 @@ def bench_meshchurn_local():
     from karpenter_tpu.api.objects import (Node, NodeSpec, NodeStatus,
                                            ObjectMeta, PodSpec)
     from karpenter_tpu.kube.store import Store
-    from karpenter_tpu.metrics.registry import PROBLEM_STATE_SHARD_ROWS
+    from karpenter_tpu.metrics.registry import (EXIST_SPLICE_BYTES,
+                                                PROBLEM_STATE_SHARD_ROWS)
     from karpenter_tpu.ops.encode import shard_spans
     from karpenter_tpu.parallel.mesh import PODS_GROUPS_AXIS, make_solver_mesh
     from karpenter_tpu.provisioning.problem_state import (ProblemState,
@@ -3346,6 +3596,10 @@ def bench_meshchurn_local():
                 for s in range(n_shards)
                 for oc in ("uploaded", "upload_skipped")}
 
+    def splice_bytes():
+        return {oc: EXIST_SPLICE_BYTES.value({"outcome": oc})
+                for oc in ("uploaded", "skipped")}
+
     # untimed warmup: jit compile at the padded buckets, the cold node-row
     # encode, the first full-shard exist upload
     ts = scheduler(ps)
@@ -3374,6 +3628,7 @@ def bench_meshchurn_local():
 
     times = {"steady": [], "churn": [], "rollout": []}
     churn_count = 0
+    splice_skipped_bytes = 0.0
     pending_upload = {0}  # shards dirtied since the last device upload
     residency_checks = 0
     for w in range(1, MESHCHURN_WINDOWS + 1):
@@ -3400,6 +3655,7 @@ def bench_meshchurn_local():
                 {"cpu": "50m", "memory": f"{32 + w}Mi"})))
         batch = batch_for(w)
         before = upload_counts()
+        b_before = splice_bytes()
         ph0 = phase_seconds_by_name() if debug else None
         t0 = time.perf_counter()
         ts = scheduler(ps)
@@ -3424,23 +3680,40 @@ def bench_meshchurn_local():
             want = 8 if s == s_t else 0
             assert sd[s] == want, (w, flavor, s, sd)
         delta = {k: v - before[k] for k, v in upload_counts().items()}
+        b_delta = {k: v - b_before[k] for k, v in splice_bytes().items()}
         if flavor == "steady":
             assert ps.last["precompute"] == "reused", ps.last
             assert ps.last["warm_restored"] > 0, ps.last
             assert not any(delta.values()), (w, delta)
+            assert not any(b_delta.values()), (w, b_delta)
         elif flavor == "churn":
             # exist-only change with a stable group side: the delta kernel
             # splices exist_ok/exist_cap on the host — no device traffic
             assert ps.last["precompute"] == "delta", ps.last
             assert not any(delta.values()), (w, delta)
+            assert not any(b_delta.values()), (w, b_delta)
         else:  # rollout
             assert ps.last["precompute"] == "computed", ps.last
+            up_rows = skip_rows = 0
             for s in range(n_shards):
                 want_up = span_rows[s] if s in pending_upload else 0
                 want_skip = 0 if s in pending_upload else span_rows[s]
+                up_rows += want_up
+                skip_rows += want_skip
                 assert delta[(s, "uploaded")] == want_up, (w, s, delta)
                 assert delta[(s, "upload_skipped")] == want_skip, \
                     (w, s, delta)
+            # donated-splice byte accounting: clean spans' bytes stay
+            # device-resident (skipped > 0 whenever any shard was clean),
+            # and bytes/rows are rate-consistent across outcomes (cross-
+            # multiplied so no per-row byte size is hardcoded here)
+            assert (b_delta["skipped"] > 0) == (skip_rows > 0), \
+                (w, b_delta, skip_rows)
+            assert (b_delta["uploaded"] > 0) == (up_rows > 0), \
+                (w, b_delta, up_rows)
+            assert b_delta["skipped"] * up_rows == \
+                b_delta["uploaded"] * skip_rows, (w, b_delta)
+            splice_skipped_bytes += b_delta["skipped"]
             pending_upload.clear()
         residency_checks += 1
 
@@ -3519,6 +3792,7 @@ def bench_meshchurn_local():
         "exist_shards": n_shards,
         "rows_per_shard": span_rows[0],
         "shard_residency_windows": residency_checks,
+        "splice_skipped_bytes": int(splice_skipped_bytes),
         "parity_vs_cold": True,
     }), flush=True)
 
@@ -3626,6 +3900,9 @@ def main():
     if MODE == "churn":
         bench_churn()
         return
+    if MODE == "stateplane":
+        bench_stateplane()
+        return
     if MODE == "trace":
         bench_trace()
         return
@@ -3642,7 +3919,7 @@ def main():
             "mesh|mesh-local|mesh-headroom|meshscale|meshchurn|sidecar|"
             "service|"
             "svc-faults|svc-fleet|minvalues|faults|replay|drought|churn|"
-            "trace|fallbacks|sim")
+            "stateplane|trace|fallbacks|sim")
     pods = _pods()
     if N_ITS:
         print(json.dumps(bench_provisioning(pods, N_ITS)))

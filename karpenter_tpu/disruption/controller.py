@@ -177,9 +177,13 @@ class DisruptionController(SingletonController):
         # the per-pass shared DisruptionSnapshot (reconcile scope only)
         self._snapshot = None
         # the cross-pass streaming state: delta-applied snapshot layers,
-        # cached candidate rows, columnar budget accounting (stream.py)
+        # cached candidate rows, columnar budget accounting (stream.py).
+        # It subscribes to the provisioner's shared EncodePlane, so a
+        # disruption pass reuses the node/group rows the provisioning pass
+        # just encoded (and vice versa) instead of keeping a third copy.
         from .stream import StreamingDisruptionState
-        self.stream = StreamingDisruptionState()
+        self.stream = StreamingDisruptionState(
+            plane=getattr(provisioner, "state_plane", None))
 
     def reconcile(self) -> Optional[Result]:
         if not self.cluster.synced():

@@ -164,6 +164,17 @@ class DisruptionSnapshot:
              if np_.metadata.deletion_timestamp is None])
         self.nodepools = [np_ for np_ in nodepools
                           if self.instance_types_by_pool.get(np_.name)]
+        # cold snapshots (validation / standalone prefix probes, no stream)
+        # used to leave catalog_token unset, re-hashing ~2k instance types
+        # inside EVERY build_problem the snapshot's encodings issue: compute
+        # the content token ONCE per snapshot build here, over the exact
+        # pool ordering handed to the scheduler (weight order, IT-less
+        # dropped — the _ordered_union order contract)
+        from ..provisioning.tensor_scheduler import catalog_cache_token
+        catalog_token = (self._prefetched[3]
+                         if self._prefetched is not None else
+                         catalog_cache_token(self.nodepools,
+                                             self.instance_types_by_pool))
         self.ts = TensorScheduler(
             self.nodepools,
             {np_.name: self.instance_types_by_pool[np_.name]
@@ -181,8 +192,7 @@ class DisruptionSnapshot:
             # pinned so repeated builds skip re-hashing 2k instance types
             problem_state=(self.stream.problem_state
                            if self.stream is not None else None),
-            catalog_token=(self._prefetched[3]
-                           if self._prefetched is not None else None))
+            catalog_token=catalog_token)
         # candidate-build traffic: its fallback-ledger records must not
         # move the headline provisioning totals (explicit flag — the
         # tracing-based backstop is off when --trace-ring is 0)

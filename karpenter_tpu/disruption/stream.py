@@ -124,9 +124,15 @@ class StreamingDisruptionState:
     single-threaded disruption controller loop (or a bench/fuzzer driver).
     """
 
-    def __init__(self):
+    def __init__(self, plane=None):
+        # subscribe to the cluster's shared EncodePlane when the controller
+        # hands one over (node/group rows encoded once for provisioning AND
+        # disruption); a bare construction keeps a private plane, byte-
+        # identical to the historical private ProblemState (standalone
+        # drivers, fuzzers).
         from ..provisioning.problem_state import ProblemState
-        self.problem_state = ProblemState()
+        self.problem_state = (plane.subscribe("disruption")
+                              if plane is not None else ProblemState())
         self._snapshot = None
         self._cluster = None
         self._provisioner = None

@@ -343,6 +343,23 @@ PROBLEM_STATE_SHARD_ROWS = REGISTRY.counter(
     "ProblemState, by outcome: reencoded/clean at encode time, "
     "uploaded/upload_skipped at device-placement time",
     ("shard", "outcome"), max_series=256)
+STATE_PLANE_SUBSCRIBERS = REGISTRY.gauge(
+    "karpenter_state_plane_subscribers",
+    "Live subscriber handles per shared EncodePlane (state/plane.py); "
+    "pruned to the live-plane set on every refresh",
+    ("plane",), max_series=256)
+STATE_PLANE_ROWS = REGISTRY.counter(
+    "karpenter_state_plane_rows_total",
+    "Node/group rows served by the shared EncodePlane per subscriber, "
+    "by outcome: shared (cache hit, possibly encoded by another "
+    "subscriber) vs reencoded",
+    ("subscriber", "outcome"), max_series=256)
+EXIST_SPLICE_BYTES = REGISTRY.counter(
+    "karpenter_exist_splice_bytes_total",
+    "Exist-side per-shard delta placement bytes, by outcome: uploaded "
+    "(dirty spans spliced host->device) vs skipped (clean spans left "
+    "resident in the donated device buffer)",
+    ("outcome",), max_series=4)
 
 def phase_seconds_by_name() -> Dict[str, float]:
     """Total observed seconds per phase (span name) across every label

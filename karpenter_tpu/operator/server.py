@@ -298,6 +298,21 @@ def _debug_fallbacks(query: dict):
             json.dumps(LEDGER.snapshot(recent=n), indent=1) + "\n")
 
 
+def _debug_stateplane(query: dict):
+    """The shared encode-plane surface (process-global like /metrics and
+    /debug/fallbacks): every live EncodePlane's subscriber refcounts,
+    topology revision, node-row/group-row/stack cache occupancy and
+    shared-vs-reencoded counters — the first stop when
+    karpenter_state_plane_rows_total{outcome="reencoded"} moves. Refreshes
+    karpenter_state_plane_subscribers so the gauge and this view agree."""
+    import json
+    from ..state.plane import live_planes, refresh_subscriber_gauge
+    refresh_subscriber_gauge()
+    planes = sorted(live_planes(), key=lambda p: p.name)
+    return (200, "application/json",
+            json.dumps([p.debug_view() for p in planes], indent=1) + "\n")
+
+
 def _debug_sessions_factory(sessions):
     """The sidecar's session-table surface (ISSUE 11 satellite, the
     /debug/offerings snapshot pattern): per-tenant session digest, queue
@@ -367,6 +382,10 @@ class ServingGroup:
             # the fallback cost ledger is process-global (obs/fallbacks),
             # so its surface serves wherever /metrics does
             "/debug/fallbacks": _debug_fallbacks,
+            # the state plane's registry is likewise process-global
+            # (state.plane._LIVE_PLANES), so its surface serves wherever
+            # /metrics does
+            "/debug/stateplane": _debug_stateplane,
         }
         if manager is not None:
             metrics_routes["/debug/deadletter"] = \

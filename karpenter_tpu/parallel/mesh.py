@@ -43,6 +43,7 @@ timeout coping strategies (SURVEY.md §5 long-context note).
 
 from __future__ import annotations
 
+import functools
 import math
 import os
 from typing import List, Optional, Tuple
@@ -255,6 +256,16 @@ def _build_out_shardings(mesh: Mesh):
     return (mg, gmt, gmt, g0, g0, g0)
 
 
+# exist-side delta splice: write a dirty per-shard row block into the
+# resident replicated stack IN PLACE (the stack buffer is donated, so on
+# backends that honor donation no second full-stack allocation exists and
+# the clean rows never move). `start` is static: shard spans are fixed per
+# (N, S), so the compile count is bounded by the shard count.
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(2,))
+def _donated_row_splice(buf, block, start: int):
+    return jax.lax.dynamic_update_slice_in_dim(buf, block, start, axis=0)
+
+
 class _MeshPlacer(binpack.ArgPlacer):
     """device_args placement for a sharded dispatch: group-side arrays stay
     host numpy (the compiled executable auto-places uncommitted inputs per
@@ -302,36 +313,71 @@ class _MeshPlacer(binpack.ArgPlacer):
         # delta upload: the sharded ProblemState carved the exist stack into
         # contiguous per-shard row blocks (encode.shard_spans) with one
         # content token each. Only blocks whose token changed cross the
-        # host->device boundary; clean blocks reuse their cached replicated
-        # arrays and the full stack is reassembled device-side. This only
-        # runs on a full-token MISS (all-clean passes reuse the whole
-        # cached pair via device_args' exist_side slot).
-        from ..metrics.registry import PROBLEM_STATE_SHARD_ROWS
+        # host->device boundary: dirty spans are SPLICED into the resident
+        # full device buffers through a donated row-update (no device-side
+        # re-concatenation of clean blocks — PR-18 leftover b); clean spans
+        # never move. This only runs on a full-token MISS (all-clean passes
+        # reuse the whole cached pair via device_args' exist_side slot).
+        from ..metrics.registry import (EXIST_SPLICE_BYTES,
+                                        PROBLEM_STATE_SHARD_ROWS)
         spans = enc.shard_spans(N, len(tokens))
         key = ("exist_shards",) + self.cache_ns
+        host_leaves = tuple(exist) + (exist_avail,)
         prev = cache.get(key)
-        blocks = []
-        for s, (start, stop) in enumerate(spans):
-            if (prev is not None and s < len(prev[0])
-                    and prev[0][s] == tokens[s]):
-                blocks.append(prev[1][s])
-                PROBLEM_STATE_SHARD_ROWS.inc(
-                    {"shard": str(s), "outcome": "upload_skipped"},
-                    value=stop - start)
-            else:
-                put = lambda x: jax.device_put(
-                    np.ascontiguousarray(x[start:stop]), rep)
-                blocks.append((feas.Enc(*(put(x) for x in exist)),
-                               put(exist_avail)))
+        if prev is not None and (
+                len(prev[0]) != len(tokens)
+                or any(d.shape != np.shape(h) or d.dtype != np.asarray(h).dtype
+                       for d, h in zip(prev[1], host_leaves))):
+            # padded axis or vocab width moved: the resident buffers can't
+            # host a row splice — fall through to a whole-stack upload
+            prev = None
+        if prev is None:
+            put = lambda x: jax.device_put(np.asarray(x), rep)
+            dev = tuple(put(x) for x in host_leaves)
+            for s, (start, stop) in enumerate(spans):
                 PROBLEM_STATE_SHARD_ROWS.inc(
                     {"shard": str(s), "outcome": "uploaded"},
                     value=stop - start)
-        cache[key] = (tuple(tokens), tuple(blocks))
-        import jax.numpy as jnp
-        full_enc = feas.Enc(*(jnp.concatenate([b[0][i] for b in blocks])
-                              for i in range(6)))
-        full_avail = jnp.concatenate([b[1] for b in blocks])
-        return full_enc, full_avail
+            EXIST_SPLICE_BYTES.inc(
+                {"outcome": "uploaded"},
+                value=float(sum(np.asarray(h).nbytes for h in host_leaves)))
+        else:
+            dev = list(prev[1])
+            # the donated input is resident-only by construction: the
+            # exist_shards slot and the exist_side slot are both replaced
+            # with the spliced result below, so nothing can feed the
+            # pre-splice (deleted) buffers into a later dispatch. CPU
+            # backends decline donation (copy instead) — suppress the
+            # compile-time warning; semantics are identical.
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings("ignore", message=".*[Dd]onat")
+                for s, (start, stop) in enumerate(spans):
+                    if prev[0][s] == tokens[s]:
+                        PROBLEM_STATE_SHARD_ROWS.inc(
+                            {"shard": str(s), "outcome": "upload_skipped"},
+                            value=stop - start)
+                        EXIST_SPLICE_BYTES.inc(
+                            {"outcome": "skipped"},
+                            value=float(sum(
+                                np.asarray(h)[start:stop].nbytes
+                                for h in host_leaves)))
+                        continue
+                    PROBLEM_STATE_SHARD_ROWS.inc(
+                        {"shard": str(s), "outcome": "uploaded"},
+                        value=stop - start)
+                    up = 0
+                    for i, hx in enumerate(host_leaves):
+                        block = jax.device_put(
+                            np.ascontiguousarray(
+                                np.asarray(hx)[start:stop]), rep)
+                        up += block.nbytes
+                        dev[i] = _donated_row_splice(dev[i], block, start)
+                    EXIST_SPLICE_BYTES.inc({"outcome": "uploaded"},
+                                           value=float(up))
+            dev = tuple(dev)
+        cache[key] = (tuple(tokens), dev)
+        return feas.Enc(*dev[:6]), dev[6]
 
     def device_token(self) -> tuple:
         return ("mesh", mesh_cache_key(self.mesh))

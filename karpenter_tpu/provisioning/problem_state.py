@@ -1,4 +1,4 @@
-"""Persistent ProblemState: the incremental delta solver's cross-pass memory.
+"""Persistent ProblemState: a subscriber handle over the shared EncodePlane.
 
 Every reconcile pass used to rebuild the whole solve input from scratch:
 re-encode 5k state-node label sets, re-scan 50k scheduled cluster pods per
@@ -6,62 +6,35 @@ topology selector, re-encode every pod group, re-upload the node tensors,
 and re-pack every group — even when the pass differed from the previous one
 by a handful of pod arrivals. ProblemState lives across passes (owned by the
 Provisioner, handed to each per-solve TensorScheduler) and turns the solve
-into a delta application:
+into a delta application.
 
-- **node rows** — per-node encoded requirement rows / available vectors /
-  zone indices / taint views, keyed by ``(name, StateNode.revision)``
-  (state/cluster.py bumps the revision on every mutation an encode can
-  observe). Only dirty rows re-encode; the pow2-padded stacked tensors and
-  their device upload (PackProblem.exist_token) are reused byte-identical
-  while the node set is unchanged.
-- **group rows** — encoded requirement rows + request vectors keyed by the
-  content-stable ``grouping.group_signature``, so "the same deployment
-  arrived again" never re-encodes.
-- **topology counts** — per-group cluster topology occupancy
-  (izc/exist_counts/host_total) memoized against ``Cluster.topo_revision``:
-  while no scheduled pod binding or node changed, the 50k-pod selector
-  scans are skipped entirely.
+Since the state-plane unification the encode caches themselves live on a
+shared, refcounted ``state.plane.EncodePlane``: node rows, node stacks,
+group rows, and topology memos are encoded once per revision bump and
+shared by every subscriber of the same plane (provisioning passes, the
+streaming disruption engine, a sidecar session). ``ProblemState`` IS the
+PlaneHandle: constructed bare it subscribes to a fresh private plane
+(byte-identical to the historical private-state behavior); constructed via
+``plane.subscribe(name)`` it shares. The merged invalidation matrix —
+which delta invalidates what, and who pays — is documented ONCE on
+``karpenter_tpu/state/plane.py`` (DEVIATIONS 25).
+
+What remains HANDLE-private (per subscriber):
+
 - **warm-started packing** — after each pack the packer's state is
   checkpointed along the FFD group order (ops/binpack.py PackSeed); the
   next solve restores the longest clean prefix (groups whose signature,
   count, and topology rows are unchanged under an unchanged global input
   token) and re-packs only from there. Decisions are bit-identical to a
   cold solve by construction: the packer is sequentially deterministic, so
-  equal inputs up to position P imply byte-equal state at P.
-
-Invalidation matrix — every delta a pass can carry, and what it costs:
-
-| delta                                   | effect                         |
-|-----------------------------------------|--------------------------------|
-| pod arrival/completion (known group)    | group count changes: cached    |
-|                                         | rows reused, warm prefix up to |
-|                                         | the first dirty FFD position   |
-| new deployment shape (new signature)    | one group row encoded; warm    |
-|                                         | prefix cut at its FFD position |
-| new vocab entry (label/value/resource)  | FULL re-encode (cold): masks   |
-|                                         | enumerate the value universe   |
-| catalog change                          | cold (new catalog encoding)    |
-| node add/remove/update                  | dirty node rows re-encode;     |
-|                                         | exist tensors restack +        |
-|                                         | re-upload; warm pack disabled  |
-|                                         | for the pass (exist_avail is   |
-|                                         | shared mutable packer state)   |
-| scheduled-pod/binding change            | topology counts recompute      |
-|                                         | (per-group, memoized again     |
-|                                         | after one pass)                |
-| unavailable-offerings version bump      | drought mask arrays rebuilt    |
-|                                         | (already per-solve); warm pack |
-|                                         | invalidated via the pattern    |
-|                                         | set in the global token        |
-| daemonset set change                    | node rows cleared (overhead    |
-|                                         | rides in the avail vectors)    |
-| hostports / volumes / minValues floors  | warm pack disabled             |
-|                                         | (binpack._warm_usable);        |
-|                                         | delta encode still applies     |
-| topology/affinity coupling              | grouping demotes to the host   |
-|                                         | path exactly as a cold solve   |
-|                                         | would (partition_pods runs     |
-|                                         | per pass)                      |
+  equal inputs up to position P imply byte-equal state at P. Packer state
+  is one solver's memory — it is never shared across subscribers.
+- **mesh attachment** (attach_mesh) + per-shard exist tokens + the
+  cross-shard reconcile fold memo — bound to this subscriber's mesh carve.
+- **tensors memo** — the ((group_part, exist_part), PackTensors) of the
+  last precompute, a single slot keyed by this subscriber's own group set.
+- **reporting** — ``last``/``stats`` and the cold/delta ``encode_kind``,
+  tracked against this handle's OWN previous pass.
 
 Sharded-state rows (attach_mesh: the state carved along the mesh's
 pods_groups axis — per-shard exist-row tokens, per-shard pack seeds, the
@@ -81,64 +54,54 @@ cross-shard reconcile fold memo):
 |                                         | memo dropped (attach_mesh);    |
 |                                         | row + stack caches unaffected  |
 | new vocab entry (overflow) /            | cold everywhere — same as the  |
-| catalog change                          | unsharded rows above, per      |
-|                                         | shard too (tokens carry vocab) |
+| catalog change                          | plane matrix, per shard too    |
+|                                         | (tokens carry vocab)           |
 
 Anything the matrix cannot express falls back to a cold encode/pack; the
 fallback is always decision-equivalent, never semantic. The churn fuzzer
 (tests/test_problem_state.py) interleaves arrivals/deletions/node churn/
 drought marks and asserts delta == cold at every step; its sharded variant
 replays the same matrix against an attached mesh and asserts byte-identical
-decisions vs a cold mesh solve per window.
+decisions vs a cold mesh solve per window; the combined-loop fuzzer
+(tests/test_state_plane.py) replays the matrix with three subscribers on
+ONE plane.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..api import labels as api_labels
 from ..ops import binpack
-from ..ops import encode as enc
-from ..scheduling.requirements import Requirements, label_requirements
-from ..utils import resources as res
+from ..state.plane import MAX_SIG_ENTRIES, EncodePlane  # noqa: F401
 from .grouping import group_signature
 
 # _pow2_bucket is THE shape-bucketing policy — shared with the cold path
 # (build_problem) so the delta-built stacks stay byte-identical to it
-from .tensor_scheduler import _pow2_bucket  # noqa: E402
-
-# bound on signature-keyed caches: distinct deployment shapes seen across
-# the state's lifetime. Past it the cache clears wholesale (simple + rare:
-# a production cluster cycles far fewer shapes than this).
-MAX_SIG_ENTRIES = 4096
+# (re-exported here: bench/tests import it alongside ProblemState)
+from .tensor_scheduler import _pow2_bucket  # noqa: E402,F401
 
 
 class ProblemState:
-    """Cross-pass solver state. NOT thread-safe: owned by the single-threaded
-    provisioner loop (or a bench/fuzzer driver); per-solve TensorSchedulers
-    borrow it one at a time."""
+    """Cross-pass solver state: one subscriber's handle on an EncodePlane.
+    NOT thread-safe: owned by a single-threaded solver loop (or a bench/
+    fuzzer driver); per-solve TensorSchedulers borrow it one at a time."""
 
-    def __init__(self):
-        # vocab identity gates every cached row: complement-encoded masks
-        # enumerate the value universe, so rows are only valid against the
-        # exact vocabulary object they were encoded with. Strong refs keep
-        # ids from being recycled.
+    def __init__(self, plane: Optional[EncodePlane] = None,
+                 subscriber: str = "private"):
+        # bare construction = a private plane: byte-identical behavior to
+        # the historical per-owner ProblemState for every existing caller
+        if plane is None:
+            plane = EncodePlane(name=f"private:{subscriber}")
+        self.plane = plane
+        self.subscriber = subscriber
+        plane._attach(subscriber)
+        # cold/delta reporting is per-HANDLE: "delta" iff the catalog
+        # encoding is the one THIS subscriber's previous pass used, exactly
+        # as the private states reported before the plane unification.
+        # (Row validity is vocab-gated on the plane, not by this field.)
         self._last_vocab = None
-        # node rows: (name, identity) ->
-        #   ((identity, revision), enc_row, avail_vec, zone_idx, taints)
-        self._node_vocab = None
-        self._node_ds_token = None
-        self._node_rows: Dict[tuple, tuple] = {}
-        self._node_stack_token = None
-        self._node_stack = None
-        # group rows: signature -> (enc_row, req_vec), per vocab
-        self._group_vocab = None
-        self._group_rows: Dict[tuple, tuple] = {}
-        # topology counts: signature -> (izc_row, exist_row, host_total)
-        self._topo_token = None
-        self._topo_memo: Dict[tuple, tuple] = {}
         # warm-start seed from the previous pack
         self.seed: Optional[binpack.PackSeed] = None
         # sharded-state attachment (attach_mesh): per-shard pack seeds and
@@ -170,6 +133,11 @@ class ProblemState:
         self.begin_solve()
         self.stats["solves"] = 0
 
+    def close(self) -> None:
+        """Drop this handle's plane refcount (accounting only — plane
+        caches are content-gated and never die with a subscriber)."""
+        self.plane.release(self.subscriber)
+
     # -- per-solve lifecycle -------------------------------------------------
 
     def begin_solve(self) -> None:
@@ -182,13 +150,14 @@ class ProblemState:
 
     def attach_mesh(self, mesh_token, exist_shards: int,
                     pack_shards: int) -> None:
-        """Bind the state to a mesh/shard-count identity (called by each
+        """Bind the handle to a mesh/shard-count identity (called by each
         TensorScheduler construction). A flip — mesh recreated over other
         devices, shard count changed, mesh dropped — invalidates every
         per-shard artifact: seeds are keyed by (shard index, shard count)
         inside their global tokens and the reconcile memo by the block
         carve, so none of them can describe the new carve. Row, stack and
-        topology caches are shard-independent and survive untouched."""
+        topology caches live on the plane, are shard-independent, and
+        survive untouched."""
         key = (mesh_token, int(exist_shards), int(pack_shards))
         if key == self._attach_key:
             return
@@ -200,8 +169,9 @@ class ProblemState:
 
     def note_encode(self, vocab) -> str:
         """cold vs delta for this solve: delta iff the catalog encoding
-        (and with it the whole vocabulary) is the one the previous pass
-        used — the condition under which every cached row stays exact."""
+        (and with it the whole vocabulary) is the one THIS handle's
+        previous pass used — the condition under which every cached row
+        stays exact."""
         kind = "delta" if self._last_vocab is vocab else "cold"
         self._last_vocab = vocab
         self.last["encode_kind"] = kind
@@ -229,120 +199,28 @@ class ProblemState:
         """(exist_enc, exist_avail, exist_zone, taint_lists, exist_token)
         with the node axis pow2-padded — byte-identical to what
         build_problem's cold path constructs, with only dirty rows
-        re-encoded. taint_lists covers the REAL nodes only."""
-        from .tensor_scheduler import _node_remaining_daemons
+        re-encoded (once, on the plane, for every subscriber).
+        taint_lists covers the REAL nodes only."""
         ds_token = self._daemon_token(daemonset_pods)
-        if self._node_vocab is not vocab or self._node_ds_token != ds_token:
-            self._node_rows = {}
-            self._node_vocab = vocab
-            self._node_ds_token = ds_token
-            self._node_stack_token = None
-            self._node_stack = None
-        rows = self._node_rows
-        reencoded = 0
-        dirty_idx: List[int] = []
-        fresh: Dict[tuple, tuple] = {}
-        keys = []
-        for i, sn in enumerate(state_nodes):
-            # cache key (name, identity); row-validity token (identity,
-            # revision). The identity distinguishes both a deleted-and-
-            # recreated node under the same name (whose replayed event
-            # sequence can land on the same revision count) and two live
-            # StateNodes sharing a name (placeholder + claim entries) —
-            # name alone would alias their rows in the stacked tensors.
-            key = (sn.name(), getattr(sn, "identity", None))
-            keys.append(key)
-            rev = (key[1], getattr(sn, "revision", None))
-            row = rows.get(key)
-            if row is None or rev[0] is None or rev[1] is None \
-                    or row[0] != rev:
-                reqs = label_requirements(sn.labels())
-                known = Requirements(
-                    r for r in reqs.values()
-                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
-                    in vocab.key_idx)
-                avail = res.subtract(
-                    sn.available(),
-                    _node_remaining_daemons(sn, daemonset_pods))
-                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
-                row = (rev,
-                       enc.encode_requirements(vocab, known),
-                       enc.encode_resource_vector(vocab, avail,
-                                                  capacity=True),
-                       vocab.value_idx[zone_key].get(z, -1),
-                       sn.taints())
-                reencoded += 1
-                dirty_idx.append(i)
-            fresh[key] = row
-        self._node_rows = fresh
+        (exist_enc, exist_avail, exist_zone, taint_lists, exist_token,
+         reencoded, shard_tokens, shard_dirty) = self.plane.node_rows(
+            vocab, zone_key, state_nodes, daemonset_pods, ds_token,
+            self._attach_key[1], self.subscriber)
         self.last["node_rows_reencoded"] = reencoded
         self.stats["node_rows_reencoded"] += reencoded
-        revs = tuple((k, getattr(sn, "revision", None))
-                     for k, sn in zip(keys, state_nodes))
-        exist_token = (vocab, ds_token, revs)
-        N = len(state_nodes)
-        Np = _pow2_bucket(N, 16)
-        # per-shard exist tokens over contiguous Np/S row spans: a dirty
-        # row only breaks ITS span's token, so the mesh placer re-uploads
-        # one shard's block (rows past N are padding — constant, so they
-        # ride the span token implicitly via s/S/Np)
-        S = int(self._attach_key[1])
-        if S > 1 and Np % S == 0:
-            from ..metrics.registry import PROBLEM_STATE_SHARD_ROWS
-            shard_dirty: Dict[int, int] = {}
-            toks = []
-            for s, (start, stop) in enumerate(enc.shard_spans(Np, S)):
-                real = max(0, min(stop, N) - start)
-                d = sum(1 for i in dirty_idx if start <= i < stop)
-                shard_dirty[s] = d
-                toks.append((vocab, ds_token, revs[start:start + real],
-                             s, S, Np))
-                if d:
-                    PROBLEM_STATE_SHARD_ROWS.inc(
-                        {"shard": str(s), "outcome": "reencoded"}, value=d)
-                if real - d:
-                    PROBLEM_STATE_SHARD_ROWS.inc(
-                        {"shard": str(s), "outcome": "clean"},
-                        value=real - d)
-            self.exist_shard_tokens = tuple(toks)
+        self.exist_shard_tokens = shard_tokens
+        if shard_dirty is not None:
             self.last["shard_dirty"] = shard_dirty
-        else:
-            self.exist_shard_tokens = None
-        if self._node_stack_token == exist_token:
-            return self._node_stack + (exist_token,)
-        encs = [fresh[k][1] for k in keys]
-        taint_lists = [fresh[k][4] for k in keys]
-        if Np > N:
-            zero = enc.encode_requirements(vocab, Requirements())
-            encs = encs + [zero] * (Np - N)
-        exist_enc = enc.stack_encoded(encs)
-        avail = np.stack([fresh[k][2] for k in keys])
-        exist_avail = np.concatenate(
-            [avail, np.zeros((Np - N,) + avail.shape[1:], avail.dtype)]) \
-            if Np > N else avail
-        zones = np.array([fresh[k][3] for k in keys], dtype=np.int32)
-        exist_zone = np.concatenate([zones, np.full(Np - N, -1, np.int32)]) \
-            if Np > N else zones
-        self._node_stack = (exist_enc, exist_avail, exist_zone, taint_lists)
-        self._node_stack_token = exist_token
         return exist_enc, exist_avail, exist_zone, taint_lists, exist_token
 
     # -- group rows ----------------------------------------------------------
 
     def group_row(self, vocab, g) -> tuple:
-        """(enc_row, req_vec) for one group, signature-cached per vocab."""
-        if self._group_vocab is not vocab:
-            self._group_rows = {}
-            self._group_vocab = vocab
-        sig = self.sig(g)
-        row = self._group_rows.get(sig)
-        if row is None:
-            if len(self._group_rows) >= MAX_SIG_ENTRIES:
-                self._group_rows = {}
-            row = (enc.encode_requirements(vocab, g.requirements),
-                   enc.encode_resource_vector(vocab, g.requests,
-                                              capacity=False))
-            self._group_rows[sig] = row
+        """(enc_row, req_vec) for one group, signature-cached per vocab on
+        the plane (shared by every subscriber)."""
+        row, encoded = self.plane.group_row(vocab, self.sig(g), g,
+                                            self.subscriber)
+        if encoded:
             self.last["group_rows_encoded"] += 1
             self.stats["group_rows_encoded"] += 1
         return row
@@ -367,24 +245,23 @@ class ProblemState:
         sched_excl = frozenset(p.uid for p in pods if p.spec.node_name)
         token = (rev, tuple(zone_names),
                  tuple(sn.name() for sn in ts.state_nodes), sched_excl)
-        if token != self._topo_token:
-            self._topo_memo = {}
-            self._topo_token = token
+        memo = self.plane.topo_memo(token)
         sigs = [self.sig(g) for g in groups]
-        miss = [i for i, s in enumerate(sigs) if s not in self._topo_memo]
+        miss = [i for i, s in enumerate(sigs) if s not in memo]
         if miss:
-            if len(self._topo_memo) + len(miss) > MAX_SIG_ENTRIES:
+            if len(memo) + len(miss) > MAX_SIG_ENTRIES:
                 # overflow wipes the memo, so EVERY group of this solve
                 # must recompute — recomputing only the misses would leave
                 # the wiped hit entries dangling for the assembly below
-                self._topo_memo = {}
+                # (wiped IN PLACE: the plane holds the dict by token)
+                memo.clear()
                 miss = list(range(len(groups)))
             excl = {p.uid for p in pods}
             sub_izc, sub_exist, sub_host = ts.cluster_topology_counts(
                 [groups[i] for i in miss], zone_names, excl)
             for j, i in enumerate(miss):
-                self._topo_memo[sigs[i]] = (sub_izc[j], sub_exist[j],
-                                            int(sub_host[j]))
+                memo[sigs[i]] = (sub_izc[j], sub_exist[j],
+                                 int(sub_host[j]))
             self.last["topo_groups_counted"] += len(miss)
             self.stats["topo_groups_counted"] += len(miss)
         G = len(groups)
@@ -394,7 +271,7 @@ class ProblemState:
         exist_counts = np.zeros((G, N), dtype=np.int64)
         host_total = np.zeros(G, dtype=np.int64)
         for i, s in enumerate(sigs):
-            row = self._topo_memo[s]
+            row = memo[s]
             izc[i] = row[0]
             exist_counts[i] = row[1]
             host_total[i] = row[2]
@@ -473,3 +350,7 @@ class ProblemState:
             self.seed = None
             self.shard_seeds = None
             self.last["warm"] = "disabled:inexpressible"
+
+
+# the subscriber API's name for what `plane.subscribe` returns
+PlaneHandle = ProblemState

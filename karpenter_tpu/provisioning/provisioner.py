@@ -157,14 +157,21 @@ class Provisioner(SingletonController):
                  scheduler_factory=None, recorder=None, flight_recorder=None,
                  unavailable=None, problem_state=None):
         from ..events.recorder import Recorder
-        from .problem_state import ProblemState
         self.store = store
         # persistent cross-pass solver state (delta encode + warm-started
         # packing): attached to LIVE provisioning solves only — disruption
         # simulation probes solve hypothetical node subsets and must not
-        # thrash the caches (see schedule_with)
-        self.problem_state = (problem_state if problem_state is not None
-                              else ProblemState())
+        # thrash the caches (see schedule_with). The handle subscribes to
+        # the cluster's shared EncodePlane (state/plane.py); the disruption
+        # controller subscribes its streaming engine to the SAME plane so
+        # node/group rows encode once per revision bump for both loops.
+        if problem_state is not None:
+            self.problem_state = problem_state
+        else:
+            from ..state.plane import EncodePlane
+            self.problem_state = EncodePlane(name="cluster").subscribe(
+                "provisioning")
+        self.state_plane = self.problem_state.plane
         # state.unavailable.UnavailableOfferings: expired at the top of
         # every pass (an expiry re-triggers a solve via the hold signature)
         # and handed to every scheduler the default factory builds

@@ -55,22 +55,11 @@ SESSION_IDLE_SECONDS = float(
     os.environ.get("KARPENTER_SIDECAR_SESSION_TTL", "900"))
 
 
-class _ClusterRev:
-    """topo_revision shim hung off the session's WireClusterView so the
-    ProblemState topology-count memo can vouch for an unchanged cluster
-    snapshot across solves (the client bumps it by re-sending)."""
-
-    __slots__ = ("topo_revision",)
-
-    def __init__(self, rev: int = 0):
-        self.topo_revision = rev
-
-
 class _Session:
     def __init__(self, session_id: str, nodepools, instance_types,
                  tenant: str = ""):
-        from ..provisioning.problem_state import ProblemState
         from ..provisioning.tensor_scheduler import catalog_cache_token
+        from ..state.plane import EncodePlane
         self.id = session_id
         self.tenant = tenant or "default"
         self.nodepools = nodepools
@@ -88,9 +77,14 @@ class _Session:
         self.daemonset_pods: list = []
         self.lock = threading.Lock()
         # -- delta-session state (codec wire v1) ------------------------------
-        # persistent cross-solve ProblemState: dirty-row node re-encode,
-        # group-row/topology memos, exist-tensor upload reuse, warm pack
-        self.problem_state = ProblemState()
+        # persistent cross-solve encode plane + subscriber handle: dirty-row
+        # node re-encode, group-row/topology memos, exist-tensor upload
+        # reuse, warm pack. The plane also carries the session's
+        # topo_revision (the WIRE cluster view has no Cluster object — the
+        # plane is hung off it below, retiring the old _ClusterRev shim;
+        # the client bumps the revision by re-sending cluster state).
+        self.plane = EncodePlane(name=f"session:{session_id}")
+        self.problem_state = self.plane.subscribe("sidecar")
         self.template_list: list = []     # tid -> template dict (append-only)
         self.template_keys: list = []     # tid -> canonical content key
         self.tmpl_digest = codec.templates_digest(())
@@ -106,7 +100,7 @@ class _Session:
         self.ds_token = ""
         self.cluster_token = ""
         self.cluster_view = codec.WireClusterView(None)
-        self.cluster_view.cluster = _ClusterRev()
+        self.cluster_view.cluster = self.plane
         self._node_identity = itertools.count(1)
         # pinned catalog encoding (vocab identity): restored into the global
         # LRU before each solve so other tenants' churn can't cold-start us
@@ -633,7 +627,7 @@ def export_session_checkpoint(session: _Session) -> bytes:
         "ds_token": session.ds_token,
         "cluster": session.cluster_raw,
         "cluster_token": session.cluster_token,
-        "topo_revision": session.cluster_view.cluster.topo_revision,
+        "topo_revision": session.plane.topo_revision,
         "last_req_seq": session.last_req_seq,
         "responses": list(session.response_cache.items()),
         "counters": {"solves": session.solves, "resyncs": session.resyncs,
@@ -667,7 +661,8 @@ def _load_checkpoint_state(session: _Session, st: dict,
     session.daemonset_raw = list(st["daemonset"])
     session.ds_token = st["ds_token"]
     session.cluster_view = codec.WireClusterView(st["cluster"])
-    session.cluster_view.cluster = _ClusterRev(st["topo_revision"])
+    session.plane.topo_revision = int(st["topo_revision"])
+    session.cluster_view.cluster = session.plane
     session.cluster_raw = st["cluster"]
     session.cluster_token = st["cluster_token"]
     session.last_req_seq = st["last_req_seq"]
@@ -916,9 +911,9 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
         session.ds_token = ""
         session.cluster_token = ""
         session.cluster_raw = None
-        rev = session.cluster_view.cluster.topo_revision + 1
+        session.plane.bump_topo_revision()
         session.cluster_view = codec.WireClusterView(None)
-        session.cluster_view.cluster = _ClusterRev(rev)
+        session.cluster_view.cluster = session.plane
     new_templates = header.get("templates_new", ())
     for tid, d in new_templates:
         if tid != len(session.template_list):
@@ -984,8 +979,8 @@ def _apply_session_delta(session: _Session, header: dict, blobs,
         session.ds_token = str(header["ds_token"])
     if "cluster" in header:
         cv = codec.WireClusterView(header["cluster"])
-        cv.cluster = _ClusterRev(session.cluster_view.cluster.topo_revision
-                                 + 1)
+        session.plane.bump_topo_revision()
+        cv.cluster = session.plane
         session.cluster_view = cv
         session.cluster_raw = header["cluster"]
     if "cluster_token" in header:

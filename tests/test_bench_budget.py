@@ -590,6 +590,76 @@ class TestMeshChurnBudget:
             "BENCH_MODE=meshchurn missing from the unknown-mode error list"
 
 
+class TestStatePlaneBudget:
+    """ISSUE 19 guard: BENCH_MODE=stateplane at tier-1 scale. The bench's
+    own in-line asserts are the real matrix (rows encode ONCE per revision
+    bump with the second subscriber reporting zero reencodes, object-
+    identity proof that ONE exist-side upload served both passes, the
+    encode wall-time ratio gate) — this guard runs the SAME bench function
+    on a clipped shape with the in-bench ratio knob opened, then re-checks
+    the structural fields and a modest ratio floor from the reported
+    record so a silently-skipped assert can't pass. Ratio-only: no
+    absolute milliseconds that flake across boxes."""
+
+    BUDGET_SECONDS = 120.0
+    NODES = 512
+    WINDOWS = 4
+    CHURN = 32
+    # headline floor is 1.5 at 2048 nodes; the clipped shape measures
+    # ~1.6x but sums only ~25ms of encode, so hold a no-win-collapse
+    # floor with jitter headroom instead of the full gate
+    RATIO_FLOOR = 1.15
+
+    def test_stateplane_bench_shape_within_budget(self, capsys):
+        import json as _json
+
+        saved = (bench.STATEPLANE_NODES, bench.STATEPLANE_PODS_PER_NODE,
+                 bench.STATEPLANE_WINDOWS, bench.STATEPLANE_CHURN,
+                 bench.STATEPLANE_ITS, bench.STATEPLANE_RATIO)
+        (bench.STATEPLANE_NODES, bench.STATEPLANE_PODS_PER_NODE,
+         bench.STATEPLANE_WINDOWS, bench.STATEPLANE_CHURN,
+         bench.STATEPLANE_ITS, bench.STATEPLANE_RATIO) = \
+            (self.NODES, 2, self.WINDOWS, self.CHURN, 144, 1.0)
+        try:
+            t0 = time.perf_counter()
+            bench.bench_stateplane()
+            elapsed = time.perf_counter() - t0
+        finally:
+            (bench.STATEPLANE_NODES, bench.STATEPLANE_PODS_PER_NODE,
+             bench.STATEPLANE_WINDOWS, bench.STATEPLANE_CHURN,
+             bench.STATEPLANE_ITS, bench.STATEPLANE_RATIO) = saved
+        assert elapsed < self.BUDGET_SECONDS, (
+            f"clipped stateplane bench took {elapsed:.1f}s — the shared "
+            "plane likely stopped serving rows across subscribers")
+        line = _json.loads(
+            [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")][-1])
+        assert "one state plane" in line["metric"]
+        assert line["windows"] == self.WINDOWS
+        # rows encoded ONCE per revision bump: the shared plane's encode
+        # counter is exactly the cold warmup stack plus the dirtied rows —
+        # a second subscriber paying again would double the dirtied term
+        assert line["node_rows_encoded"] == self.NODES + line["dirtied_rows"]
+        assert line["node_rows_shared"] > 0
+        assert line["group_rows_shared"] > 0
+        assert line["stack_hits"] > 0
+        # every window dirtied rows, so every window re-keyed the shared
+        # exist-side upload exactly once (the identity assert that the
+        # second pass was served the SAME slot ran inside the bench)
+        assert line["exist_uploads"] == self.WINDOWS
+        assert line["value"] >= self.RATIO_FLOOR, (
+            f"shared-plane encode speedup collapsed to {line['value']}x "
+            f"(floor {self.RATIO_FLOOR}x)")
+
+    def test_bench_mode_stateplane_is_a_known_mode(self):
+        import re
+        with open(bench.__file__) as f:
+            src = f.read()
+        m = re.search(r"unknown BENCH_MODE.*?\"\)", src, re.S)
+        assert m and "stateplane" in m.group(0), \
+            "BENCH_MODE=stateplane missing from the unknown-mode error list"
+
+
 class TestServiceBudget:
     """ISSUE 8 guard: the BENCH_MODE=service line at test scale. The 0.5s
     warm-delta round-trip budget is asserted at 50k x 2k inside

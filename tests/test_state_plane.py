@@ -1,0 +1,234 @@
+"""One state plane (ISSUE 19 tentpole): the shared EncodePlane.
+
+The contract under test: provisioning, disruption, and sidecar-session
+solvers consuming ONE refcounted EncodePlane through subscriber handles
+make decisions bit-identical to the pre-ISSUE-19 layout (three private
+ProblemStates), while node/group rows encode once per revision bump and
+are served shared to every other subscriber. Covers the combined-loop
+fuzzer, the subscriber lifecycle (refcounts + gauge), the two-generation
+node-row cache that absorbs the provisioning/disruption node-subset
+alternation, and the /debug/stateplane surface.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.metrics.registry import (STATE_PLANE_ROWS,
+                                            STATE_PLANE_SUBSCRIBERS)
+from karpenter_tpu.provisioning.problem_state import ProblemState
+from karpenter_tpu.provisioning.provisioner import StateClusterView
+from karpenter_tpu.provisioning.tensor_scheduler import TensorScheduler
+from karpenter_tpu.state.plane import (EncodePlane, live_planes,
+                                       refresh_subscriber_gauge)
+
+from test_problem_state import ChurnEnv, deployment, digest
+
+pytestmark = pytest.mark.churn
+
+
+def _solve(env, ps, batch, state_nodes=None):
+    """One pass through a fresh scheduler bound to `ps` (the provisioner
+    constructs a scheduler per pass the same way)."""
+    if state_nodes is None:
+        state_nodes = [sn for sn in env.cluster.state_nodes()
+                       if not sn.deleting()]
+    ts = TensorScheduler(
+        [env.pool], {"default": env.catalog}, state_nodes=state_nodes,
+        cluster=StateClusterView(env.store, env.cluster),
+        unavailable=env.registry, problem_state=ps)
+    return ts.solve(batch)
+
+
+# -- combined-loop fuzzer ----------------------------------------------------
+
+
+class TestCombinedLoopFuzzer:
+    """Interleave provisioning, disruption, and sidecar-session passes
+    over ONE plane while the cluster churns; every pass is shadowed by
+    the same pass over a private ProblemState (the pre-ISSUE-19 layout)
+    and the decisions must be bit-identical."""
+
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_three_subscribers_one_plane_bit_identical(self, seed):
+        rng = random.Random(seed)
+        env = ChurnEnv(n_nodes=6, pods_per_node=2)
+        plane = EncodePlane(name=f"fuzz-{seed}")
+        shared = {
+            "provisioning": plane.subscribe("provisioning"),
+            "disruption": plane.subscribe("disruption"),
+            "sidecar": plane.subscribe("sidecar"),
+        }
+        private = {name: ProblemState() for name in shared}
+        assert plane.subscribers == {"provisioning": 1, "disruption": 1,
+                                     "sidecar": 1}
+
+        def batch(step):
+            shapes = [deployment(f"std-{k}", rng.randint(1, 3))
+                      for k in rng.sample(range(4), 2)]
+            if step % 3 == 0:
+                # a genuinely new deployment shape: unique request combo
+                shapes.append(deployment(f"roll-{step}", 2,
+                                         cpu=f"{201 + step}m"))
+            return [p for shape in shapes for p in shape]
+
+        next_node = 100
+        for step in range(12):
+            op = rng.choice(["arrive", "complete", "node-add",
+                             "node-remove", "arrive"])
+            if op == "complete":
+                names = [n for n, pods in env.bound.items() if pods]
+                if names:
+                    env.complete_bound(rng.choice(names))
+            elif op == "node-add":
+                env.add_node(next_node, pods_per_node=1)
+                next_node += 1
+            elif op == "node-remove":
+                names = sorted(env.bound)
+                if len(names) > 3:
+                    env.delete_node(rng.choice(names))
+            pods = batch(step)
+            all_nodes = [sn for sn in env.cluster.state_nodes()
+                         if not sn.deleting()]
+            # the disruption view excludes one candidate node (the
+            # non-deleting-subset alternation the two-generation cache
+            # exists for); the sidecar session sees the full set
+            victim = rng.randrange(len(all_nodes))
+            views = {
+                "provisioning": all_nodes,
+                "disruption": all_nodes[:victim] + all_nodes[victim + 1:],
+                "sidecar": all_nodes,
+            }
+            for name in ("provisioning", "disruption", "sidecar"):
+                r_sh = _solve(env, shared[name], pods, views[name])
+                r_pr = _solve(env, private[name], pods, views[name])
+                assert digest(r_sh) == digest(r_pr), (
+                    f"seed {seed} step {step}: {name} pass over the "
+                    "shared plane diverged from its private state")
+
+        # the reuse ledger: rows landed once on the plane and were served
+        # shared to the other subscribers, while each private state paid
+        # its own encodes
+        assert plane.stats["node_rows_shared"] > 0
+        assert plane.stats["group_rows_shared"] > 0
+        assert plane.stats["stack_hits"] > 0
+        private_encoded = sum(ps.plane.stats["node_rows_encoded"]
+                              for ps in private.values())
+        assert plane.stats["node_rows_encoded"] < private_encoded, (
+            "the shared plane re-encoded as much as three private states "
+            "- rows are not being shared across subscribers")
+        for name in shared:
+            assert STATE_PLANE_ROWS.value(
+                {"subscriber": name, "outcome": "shared"}) > 0
+
+
+# -- subscriber lifecycle ----------------------------------------------------
+
+
+class TestSubscriberLifecycle:
+    def test_refcounts_and_gauge(self):
+        plane = EncodePlane(name="lifecycle")
+        h1 = plane.subscribe("provisioning")
+        h2 = plane.subscribe("provisioning")
+        h3 = plane.subscribe("disruption")
+        assert plane.subscribers == {"provisioning": 2, "disruption": 1}
+        assert STATE_PLANE_SUBSCRIBERS.value({"plane": "lifecycle"}) == 3.0
+        h2.close()
+        assert plane.subscribers == {"provisioning": 1, "disruption": 1}
+        h1.close()
+        h3.close()
+        assert plane.subscribers == {}
+        refresh_subscriber_gauge()
+        assert STATE_PLANE_SUBSCRIBERS.value({"plane": "lifecycle"}) == 0.0
+
+    def test_bare_problem_state_gets_private_plane(self):
+        ps1 = ProblemState()
+        ps2 = ProblemState()
+        assert ps1.plane is not ps2.plane
+        assert ps1.plane.subscribers == {"private": 1}
+        assert ps1.plane.name.startswith("private:")
+
+    def test_live_planes_registry(self):
+        plane = EncodePlane(name="registry-probe")
+        assert plane in live_planes()
+
+    def test_topo_revision_bump(self):
+        plane = EncodePlane(name="rev")
+        assert plane.topo_revision == 0
+        assert plane.bump_topo_revision() == 1
+        assert plane.topo_revision == 1
+
+
+# -- two-generation node rows ------------------------------------------------
+
+
+class TestTwoGenerationRows:
+    def test_full_subset_full_alternation_reencodes_nothing(self):
+        """Provisioning (all nodes) and disruption (subset) alternate:
+        the single-generation private cache would drop the complement on
+        every subset pass; the plane's prev generation serves it back."""
+        env = ChurnEnv(n_nodes=5, pods_per_node=1)
+        plane = EncodePlane(name="twogen")
+        prov = plane.subscribe("provisioning")
+        dis = plane.subscribe("disruption")
+        pods = deployment("a", 3)
+        all_nodes = [sn for sn in env.cluster.state_nodes()
+                     if not sn.deleting()]
+        _solve(env, prov, pods, all_nodes)
+        assert prov.last["node_rows_reencoded"] == 5
+        _solve(env, dis, pods, all_nodes[:3])
+        assert dis.last["node_rows_reencoded"] == 0
+        # back to the full set: the two dropped-from-cur rows must come
+        # from the prev generation, not a re-encode
+        _solve(env, prov, pods, all_nodes)
+        assert prov.last["node_rows_reencoded"] == 0
+        assert plane.stats["node_rows_encoded"] == 5
+
+    def test_stack_slots_keep_both_views_resident(self):
+        """The alternating exist_tokens (full set vs subset) each keep a
+        stack slot: the second full-set pass is a stack hit, not a
+        rebuild."""
+        env = ChurnEnv(n_nodes=4, pods_per_node=1)
+        plane = EncodePlane(name="stacks")
+        prov = plane.subscribe("provisioning")
+        dis = plane.subscribe("disruption")
+        pods = deployment("a", 2)
+        all_nodes = [sn for sn in env.cluster.state_nodes()
+                     if not sn.deleting()]
+        _solve(env, prov, pods, all_nodes)
+        _solve(env, dis, pods, all_nodes[:2])
+        builds = plane.stats["stack_builds"]
+        _solve(env, prov, pods, all_nodes)
+        _solve(env, dis, pods, all_nodes[:2])
+        assert plane.stats["stack_builds"] == builds
+        assert plane.stats["stack_hits"] >= 2
+
+
+# -- debug surface -----------------------------------------------------------
+
+
+class TestDebugSurface:
+    def test_debug_view_reports_caches_and_stats(self):
+        env = ChurnEnv(n_nodes=3, pods_per_node=1)
+        plane = EncodePlane(name="view")
+        ps = plane.subscribe("provisioning")
+        _solve(env, ps, deployment("a", 2))
+        view = plane.debug_view()
+        assert view["name"] == "view"
+        assert view["subscribers"] == {"provisioning": 1}
+        assert view["node_caches"] and \
+            view["node_caches"][0]["rows_cur"] == 3
+        assert view["stats"]["node_rows_encoded"] == 3
+
+    def test_debug_stateplane_endpoint(self):
+        import json
+        from karpenter_tpu.operator.server import _debug_stateplane
+        plane = EncodePlane(name="endpoint-probe")
+        plane.subscribe("provisioning")
+        code, ctype, body = _debug_stateplane({})
+        assert code == 200 and ctype == "application/json"
+        names = [p["name"] for p in json.loads(body)]
+        assert "endpoint-probe" in names
+        # the endpoint refreshes the gauge as a side effect
+        assert STATE_PLANE_SUBSCRIBERS.value(
+            {"plane": "endpoint-probe"}) == 1.0
