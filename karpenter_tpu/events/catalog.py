@@ -302,3 +302,21 @@ def node_repair_blocked(node_name: str, nodeclaim_name: str,
                          message=reason, dedupe_ttl=15 * 60.0,
                          dedupe_values=(nodeclaim_name,)))
     return evs
+
+
+# -- warm-state integrity (state/audit.py, no reference analog) ---------------
+
+def state_corruption(layer: str, detail: str, seq: int) -> Event:
+    """The StateAuditor detected a corrupted warm-cache layer and
+    quarantined it to a cold rebuild for the pass. No reference analog:
+    the reference re-derives state every pass and has no warm caches to
+    corrupt. The incident sequence number rides the dedupe key so every
+    DISTINCT incident publishes exactly once — without it the recorder's
+    TTL dedupe would swallow a second corruption of the same layer."""
+    return Event(
+        object_kind="EncodePlane", object_name=layer,
+        type=WARNING, reason="StateCorruption",
+        message=_truncate(
+            f"Warm-state audit: corrupted {layer} quarantined to a cold "
+            f"rebuild ({detail or 'content digest mismatch'})"),
+        dedupe_values=(layer, str(seq)))

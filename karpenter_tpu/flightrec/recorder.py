@@ -255,6 +255,19 @@ class FlightRecorder:
         except Exception:  # noqa: BLE001
             metrics.FLIGHTREC_DROPPED.inc({"reason": "capture_error"})
 
+    def capture_corruption(self, layer: str, detail: str,
+                           seq: int = 0) -> None:
+        """Capture one warm-state corruption incident (state/audit.py).
+        The record is tiny — there are no solver inputs to pin, only the
+        quarantine context — so it encodes eagerly."""
+        from ..metrics import registry as metrics
+        try:
+            self._append(FlightRecord(
+                "state_corruption", self.clock.now(), 0.0,
+                {"layer": layer, "detail": detail, "seq": int(seq)}, None))
+        except Exception:  # noqa: BLE001 — recording must never cost a pass
+            metrics.FLIGHTREC_DROPPED.inc({"reason": "capture_error"})
+
     def _append(self, rec: FlightRecord) -> None:
         from ..metrics import registry as metrics
         with self._lock:

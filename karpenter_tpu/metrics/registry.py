@@ -354,6 +354,16 @@ STATE_PLANE_ROWS = REGISTRY.counter(
     "by outcome: shared (cache hit, possibly encoded by another "
     "subscriber) vs reencoded",
     ("subscriber", "outcome"), max_series=256)
+STATE_AUDIT = REGISTRY.counter(
+    "karpenter_state_audit_total",
+    "Warm-state integrity audits (state/audit.py StateAuditor) by cache "
+    "layer and outcome: audited (shadow re-encode / digest verify "
+    "matched) vs corrupt (mismatch -> the layer quarantined to a cold "
+    "rebuild for the pass). layer=device carries the mesh degradation "
+    "ladder: killed (device lost mid-dispatch), carve/single (the pass "
+    "completed on a degraded rung), readmitted (half-open probe "
+    "succeeded and the breaker re-closed)",
+    ("layer", "outcome"), max_series=64)
 EXIST_SPLICE_BYTES = REGISTRY.counter(
     "karpenter_exist_splice_bytes_total",
     "Exist-side per-shard delta placement bytes, by outcome: uploaded "
@@ -502,6 +512,12 @@ SIDECAR_MIGRATIONS = REGISTRY.counter(
     "(corrupt/truncated/version skew), 'export_error' = a post-solve "
     "checkpoint write that failed",
     ("reason",), max_series=16)
+SIDECAR_HANDOFF_EVICTED = REGISTRY.counter(
+    "karpenter_sidecar_handoff_evicted_total",
+    "Fleet handoff-store session checkpoints evicted, by reason: 'cap' "
+    "= LRU-dropped past the entry bound, 'ttl' = orphaned past the "
+    "expiry (the owning replica died without a successor restoring it)",
+    ("reason",), max_series=4)
 SIDECAR_REPLICA_SESSIONS = REGISTRY.gauge(
     "karpenter_sidecar_replica_sessions",
     "Live delta sessions held by each sidecar fleet replica (bounded "

@@ -309,8 +309,20 @@ def _debug_stateplane(query: dict):
     from ..state.plane import live_planes, refresh_subscriber_gauge
     refresh_subscriber_gauge()
     planes = sorted(live_planes(), key=lambda p: p.name)
+    # this HTTP thread races the owning solver loop, which mutates the
+    # plane caches mid-pass (they are deliberately lock-free); debug_view
+    # iterates copied views, but a resize can still land mid-copy — retry
+    # the lost race like /debug/offerings' snapshot does. Three straight
+    # losses means the loop is churning and the caller gets the error.
+    for attempt in range(3):
+        try:
+            views = [p.debug_view() for p in planes]
+            break
+        except RuntimeError:
+            if attempt == 2:
+                raise
     return (200, "application/json",
-            json.dumps([p.debug_view() for p in planes], indent=1) + "\n")
+            json.dumps(views, indent=1) + "\n")
 
 
 def _debug_sessions_factory(sessions):

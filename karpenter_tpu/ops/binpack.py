@@ -553,7 +553,52 @@ def _run_precompute(args, statics, shard=None):
     return out
 
 
+# -- injected device-loss verdicts (utils/chaos.DeviceKiller) ----------------
+# A real device loss surfaces as an XLA runtime error mid-dispatch; chaos
+# injects the same failure deterministically so the degradation ladder
+# (parallel/mesh.resilient_precompute) can be driven in tests and sim runs.
+
+_DEVICE_CHAOS = None
+
+
+class DeviceLossError(Exception):
+    """A device participating in this dispatch is gone (ICI link drop,
+    preempted donor chip, injected kill verdict). Carries the lost
+    device's id so the mesh ladder can feed its per-device breaker."""
+
+    def __init__(self, device_id, detail: str = ""):
+        super().__init__(f"device {device_id} lost"
+                         + (f": {detail}" if detail else ""))
+        self.device_id = device_id
+
+
+def install_device_chaos(killer):
+    """Install (or clear, with None) the seeded device-kill verdict source
+    consulted before every device dispatch; returns the previous hook so
+    callers can restore it."""
+    global _DEVICE_CHAOS
+    prev = _DEVICE_CHAOS
+    _DEVICE_CHAOS = killer
+    return prev
+
+
+def check_devices(device_ids) -> None:
+    """Raise DeviceLossError if the installed chaos verdict kills any of
+    the devices about to participate in a dispatch. No-op (one global
+    read) when no chaos is installed."""
+    killer = _DEVICE_CHAOS
+    if killer is not None:
+        hit = killer.verdict(device_ids)
+        if hit is not None:
+            raise DeviceLossError(hit, "injected kill verdict")
+
+
 def precompute(p: PackProblem) -> PackTensors:
+    # deliberately NOT chaos-checked: the meshless precompute is the
+    # host-path rung below the ladder (disruption snapshots, validation
+    # probes run it too), and the host is the one device the ladder
+    # assumes alive. Only resilient_precompute consults the kill verdict,
+    # against the devices actually participating in a mesh dispatch.
     from ..obs.tracer import TRACER
     args, statics = device_args(p)
     # single packed fetch: per-array device_get pays a host<->device round

@@ -949,3 +949,130 @@ def local_result_slice(mesh: Mesh, n_groups: int,
         else:
             spans.append((start, stop))
     return spans
+
+
+# -- device-loss degradation ladder (ISSUE 20) --------------------------------
+# A device error mid-dispatch used to fail the whole sharded pass and trip
+# the GLOBAL solver breaker (host fallback for every subsequent pass until
+# cooldown). The ladder instead re-places the solve WITHIN the same pass:
+# full mesh -> the largest pow2 carve of surviving devices -> a single
+# surviving device -> (exhausted) the caller's host oracle. Each lost
+# device feeds its OWN SolverCircuitBreaker, so a healthy fleet minus one
+# chip keeps solving on silicon, and the half-open probe re-admits the
+# device once it answers again. Decision parity across rungs is free:
+# sharded_precompute is bit-identical to binpack.precompute for ANY mesh
+# (pinned by the parity tests), so every rung yields the same tensors.
+
+#: per-device breaker tuning: a lost chip usually stays lost for seconds
+#: (preemption, link flap), so a short threshold opens fast and the
+#: half-open probe re-admits on the first healthy dispatch
+DEVICE_BREAKER_THRESHOLD = int(os.environ.get(
+    "KARPENTER_DEVICE_BREAKER_THRESHOLD", "3"))
+DEVICE_BREAKER_COOLDOWN = float(os.environ.get(
+    "KARPENTER_DEVICE_BREAKER_COOLDOWN", "30"))
+
+_DEVICE_BREAKERS: dict = {}
+_CARVE_CACHE: dict = {}
+
+
+class DeviceLadderExhausted(Exception):
+    """Every rung of the device-loss ladder failed this pass. The caller
+    (TensorScheduler._solve) serves the host oracle WITHOUT counting the
+    global breaker — each lost device already fed its own."""
+
+
+def device_breaker(device_id: int, now=None):
+    """The per-device SolverCircuitBreaker (process-wide: device identity
+    outlives any one mesh object). publish=False — only the global solver
+    breaker owns the circuit-state gauge."""
+    from ..provisioning.tensor_scheduler import SolverCircuitBreaker
+    b = _DEVICE_BREAKERS.get(int(device_id))
+    if b is None:
+        b = SolverCircuitBreaker(threshold=DEVICE_BREAKER_THRESHOLD,
+                                 cooldown=DEVICE_BREAKER_COOLDOWN, now=now)
+        _DEVICE_BREAKERS[int(device_id)] = b
+    return b
+
+
+def reset_device_breakers() -> None:
+    """Test/bench isolation: drop every per-device breaker (and the carve
+    cache, whose meshes may reference revived devices)."""
+    _DEVICE_BREAKERS.clear()
+    _CARVE_CACHE.clear()
+
+
+def _carve_mesh(live) -> Mesh:
+    """A mesh over the largest power-of-two prefix of the surviving
+    devices (pow2 keeps the padded shard shapes in the compile-cache
+    buckets; the carve is cached by device-id tuple so a repeated
+    degradation never rebuilds it)."""
+    n = 1 << (len(live).bit_length() - 1)
+    picked = tuple(sorted(live, key=lambda d: int(d.id))[:n])
+    key = tuple(int(d.id) for d in picked)
+    m = _CARVE_CACHE.get(key)
+    if m is None:
+        m = make_solver_mesh(devices=list(picked))
+        _CARVE_CACHE[key] = m
+    return m
+
+
+def resilient_precompute(p: binpack.PackProblem, mesh: Mesh
+                         ) -> binpack.PackTensors:
+    """sharded_precompute behind the degradation ladder: on a device loss
+    the pass re-places itself on the surviving carve (then a single
+    survivor) instead of failing. Raises DeviceLadderExhausted only when
+    no device is willing to solve."""
+    from ..metrics.registry import STATE_AUDIT
+    devices = list(mesh.devices.flat)
+    down: set = set()
+    while True:
+        live = [d for d in devices
+                if int(d.id) not in down and device_breaker(d.id).allow()]
+        probing = [d for d in live
+                   if device_breaker(d.id).state != "closed"]
+        try:
+            if len(live) == len(devices):
+                binpack.check_devices([int(d.id) for d in live])
+                out = sharded_precompute(p, mesh)
+                rung = "mesh"
+            elif len(live) >= 1:
+                carve = _carve_mesh(live)
+                live = list(carve.devices.flat)
+                probing = [d for d in live
+                           if device_breaker(d.id).state != "closed"]
+                binpack.check_devices([int(d.id) for d in live])
+                out = sharded_precompute(p, carve)
+                rung = "carve" if len(live) > 1 else "single"
+            else:
+                raise DeviceLadderExhausted(
+                    f"all {len(devices)} mesh devices down or "
+                    "breaker-open")
+        except DeviceLadderExhausted:
+            raise
+        except binpack.DeviceLossError as e:
+            device_breaker(e.device_id).record_failure()
+            down.add(int(e.device_id))
+            STATE_AUDIT.inc({"layer": "device", "outcome": "killed"})
+            continue
+        except Exception:
+            # un-attributed dispatch failure: every participant takes the
+            # blame and the pass drops a rung. Over-counting is safe — a
+            # healthy device's breaker re-closes on the next pass's
+            # half-open probe — while under-counting would retry the same
+            # dead rung forever.
+            for d in live:
+                device_breaker(d.id).record_failure()
+                down.add(int(d.id))
+            STATE_AUDIT.inc({"layer": "device", "outcome": "killed"},
+                            len(live))
+            if not live:
+                raise
+            continue
+        for d in live:
+            device_breaker(d.id).record_success()
+        if probing:
+            STATE_AUDIT.inc({"layer": "device", "outcome": "readmitted"},
+                            len(probing))
+        if rung != "mesh":
+            STATE_AUDIT.inc({"layer": "device", "outcome": rung})
+        return out

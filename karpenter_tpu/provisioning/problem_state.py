@@ -74,6 +74,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..ops import binpack
+from ..state import audit as _audit
 from ..state.plane import MAX_SIG_ENTRIES, EncodePlane  # noqa: F401
 from .grouping import group_signature
 
@@ -104,6 +105,10 @@ class ProblemState:
         self._last_vocab = None
         # warm-start seed from the previous pack
         self.seed: Optional[binpack.PackSeed] = None
+        # content digest over the warm seed(s), recorded by finish_pack and
+        # verified by warm_start when a StateAuditor is attached (None
+        # otherwise — the unaudited path never pays for it)
+        self._warm_digest: Optional[int] = None
         # sharded-state attachment (attach_mesh): per-shard pack seeds and
         # the cross-shard reconcile fold memo are only meaningful against
         # ONE (mesh identity, exist-shard count, pack-shard count) tuple
@@ -147,6 +152,8 @@ class ProblemState:
                      "warm": "none", "warm_restored": 0, "warm_matched": 0,
                      "precompute": "computed"}
         self.stats["solves"] += 1
+        if self.plane.auditor is not None:
+            self.plane.auditor.begin_pass()
 
     def attach_mesh(self, mesh_token, exist_shards: int,
                     pack_shards: int) -> None:
@@ -247,6 +254,39 @@ class ProblemState:
                  tuple(sn.name() for sn in ts.state_nodes), sched_excl)
         memo = self.plane.topo_memo(token)
         sigs = [self.sig(g) for g in groups]
+        auditor = self.plane.auditor
+        if auditor is not None and memo:
+            # lazy digest check on every served entry (entries grow a 4th
+            # digest element; the assembly below reads fields 0-2 by index
+            # so it never sees it), plus ONE sampled entry recounted fresh
+            # from the cluster — quarantine wipes the memo in place so
+            # this solve recomputes cold
+            hit_idx = [i for i, s in enumerate(sigs) if s in memo]
+            corrupt = False
+            for i in hit_idx:
+                row = memo[sigs[i]]
+                if len(row) <= 3:
+                    # adopted: counted while no auditor was attached —
+                    # digest on first audited serve so later serves verify
+                    memo[sigs[i]] = row + (_audit.content_digest(row),)
+                elif _audit.content_digest(row[:3]) != row[3]:
+                    auditor.incident("topo_memo",
+                                     "entry failed its serve-time digest")
+                    memo.clear()
+                    corrupt = True
+                    break
+            if not corrupt and hit_idx and auditor.take_topo_audit():
+                i = hit_idx[auditor.rng.randrange(len(hit_idx))]
+                f_izc, f_exist, f_host = ts.cluster_topology_counts(
+                    [groups[i]], zone_names, {p.uid for p in pods})
+                fresh = (f_izc[0], f_exist[0], int(f_host[0]))
+                if _audit.content_digest(fresh) != \
+                        _audit.content_digest(memo[sigs[i]][:3]):
+                    auditor.incident("topo_memo",
+                                     "entry diverged from a fresh recount")
+                    memo.clear()
+                else:
+                    auditor.audited("topo_memo")
         miss = [i for i, s in enumerate(sigs) if s not in memo]
         if miss:
             if len(memo) + len(miss) > MAX_SIG_ENTRIES:
@@ -260,8 +300,10 @@ class ProblemState:
             sub_izc, sub_exist, sub_host = ts.cluster_topology_counts(
                 [groups[i] for i in miss], zone_names, excl)
             for j, i in enumerate(miss):
-                memo[sigs[i]] = (sub_izc[j], sub_exist[j],
-                                 int(sub_host[j]))
+                entry = (sub_izc[j], sub_exist[j], int(sub_host[j]))
+                if auditor is not None:
+                    entry = entry + (_audit.content_digest(entry),)
+                memo[sigs[i]] = entry
             self.last["topo_groups_counted"] += len(miss)
             self.stats["topo_groups_counted"] += len(miss)
         G = len(groups)
@@ -295,6 +337,20 @@ class ProblemState:
         if ts.initial_zone_counts is not None:
             self.last["warm"] = "disabled:initial_zone_counts"
             return None
+        auditor = self.plane.auditor
+        if auditor is not None and self._warm_digest is not None:
+            # restore-time digest check: a corrupted checkpoint would
+            # otherwise replay wrong packer state as "warm" decisions
+            if _audit.warm_digest(self.seed, self.shard_seeds) != \
+                    self._warm_digest:
+                auditor.incident(
+                    "warm_checkpoint",
+                    "seed failed its restore-time digest")
+                self.seed = None
+                self.shard_seeds = None
+                self._warm_digest = None
+            else:
+                auditor.audited("warm_checkpoint")
         global_token = (
             vocab,                      # identity: the whole encoding
             tuple(ts.drought_patterns),
@@ -350,6 +406,14 @@ class ProblemState:
             self.seed = None
             self.shard_seeds = None
             self.last["warm"] = "disabled:inexpressible"
+        if self.plane.auditor is not None:
+            self._warm_digest = _audit.warm_digest(self.seed,
+                                                   self.shard_seeds)
+        else:
+            # keep the recorded digest in lockstep with the seeds: an
+            # auditor detached for a few passes (bench off-phase) must not
+            # leave a stale digest that reads as corruption on re-attach
+            self._warm_digest = None
 
 
 # the subscriber API's name for what `plane.subscribe` returns

@@ -483,6 +483,14 @@ class TensorScheduler:
             # designed, so the breaker doesn't count it either way
             return self._host_solve(pods, str(e))
         except Exception as e:  # noqa: BLE001 — device-failure degradation
+            from ..parallel.mesh import DeviceLadderExhausted
+            if isinstance(e, DeviceLadderExhausted):
+                # every ladder rung is gone: each lost device already fed
+                # its OWN breaker, so the global one must not double-trip
+                # — serve the host oracle and let the next pass's
+                # half-open probes re-test the fleet
+                return self._host_solve(pods,
+                                        f"device ladder exhausted: {e}")
             self.circuit.record_failure()
             if self.force_tensor:
                 raise
@@ -709,12 +717,15 @@ class TensorScheduler:
     # -- tensor path ----------------------------------------------------------
 
     def precompute(self, problem) -> binpack.PackTensors:
-        """Device feasibility precompute, sharded over self.mesh when set.
-        Shared by the provisioning solve and the consolidation prefix
-        simulator (disruption/prefix.py), so one mesh knob scales both."""
+        """Device feasibility precompute, sharded over self.mesh when set
+        (behind the device-loss degradation ladder: a device lost
+        mid-dispatch re-places the solve on the surviving carve instead of
+        failing the pass). Shared by the provisioning solve and the
+        consolidation prefix simulator (disruption/prefix.py), so one mesh
+        knob scales both."""
         if self.mesh is not None:
-            from ..parallel.mesh import sharded_precompute
-            return sharded_precompute(problem, self.mesh)
+            from ..parallel.mesh import resilient_precompute
+            return resilient_precompute(problem, self.mesh)
         return binpack.precompute(problem)
 
     def build_problem(self, groups: List[PodGroup]):

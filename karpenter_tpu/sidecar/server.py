@@ -359,6 +359,16 @@ ADMISSION = AdmissionQueue(
 # -- fleet replication: handoff store + per-replica state ---------------------
 
 
+#: HandoffStore bounds: checkpoints are fleet-sized state with no natural
+#: death signal — a replica that dies without a successor restoring its
+#: sessions would otherwise pin them forever. LRU cap + TTL expiry bound
+#: the store; both evictions count karpenter_sidecar_handoff_evicted_total.
+HANDOFF_MAX_ENTRIES = int(os.environ.get(
+    "KARPENTER_SIDECAR_HANDOFF_MAX", "1024"))
+HANDOFF_TTL_SECONDS = float(os.environ.get(
+    "KARPENTER_SIDECAR_HANDOFF_TTL", "3600"))
+
+
 class HandoffStore:
     """Shared session-checkpoint plane for a sidecar fleet: each replica
     writes a checkpoint frame after every acked delta solve and a draining
@@ -366,25 +376,71 @@ class HandoffStore:
     warm on first contact (lazy restore in _get_session) instead of
     NACKing the client into a cold bootstrap. In-process fleets (the
     simulator, tests, bench) share one instance; a real deployment would
-    back the same three-method contract with an external store."""
+    back the same three-method contract with an external store.
 
-    def __init__(self):
+    Bounded (ISSUE 20): at most ``max_entries`` checkpoints, LRU-evicted
+    on overflow (reason="cap"), and entries older than ``ttl_seconds``
+    expire lazily on read plus via ``sweep()`` from the idle-GC loop
+    (reason="ttl") — an orphaned checkpoint whose owner died without a
+    successor can no longer pin fleet-sized state forever. ``now`` is
+    injectable for fake-clock tests; a restore refreshes both recency and
+    the TTL clock (the session is evidently still wanted)."""
+
+    def __init__(self, max_entries: Optional[int] = None,
+                 ttl_seconds: Optional[float] = None, now=None):
         self._lock = threading.Lock()
-        self._ckpts: Dict[str, bytes] = {}
+        self._ckpts: "OrderedDict[str, tuple]" = OrderedDict()
+        self.max_entries = (HANDOFF_MAX_ENTRIES if max_entries is None
+                            else int(max_entries))
+        self.ttl_seconds = (HANDOFF_TTL_SECONDS if ttl_seconds is None
+                            else float(ttl_seconds))
+        self._now = now or time.monotonic
         self.puts = 0       # checkpoint writes (post-solve + drain export)
         self.restores = 0   # checkpoints handed to a restoring replica
+        self.evicted = 0
+
+    def _evict(self, session_id: str, reason: str) -> None:
+        # caller holds self._lock
+        from ..metrics.registry import SIDECAR_HANDOFF_EVICTED
+        self._ckpts.pop(session_id, None)
+        self.evicted += 1
+        SIDECAR_HANDOFF_EVICTED.inc({"reason": reason})
 
     def put(self, session_id: str, data: bytes) -> None:
         with self._lock:
-            self._ckpts[session_id] = data
+            self._ckpts.pop(session_id, None)
+            self._ckpts[session_id] = (data, self._now())
             self.puts += 1
+            while len(self._ckpts) > self.max_entries:
+                self._evict(next(iter(self._ckpts)), "cap")
 
     def get(self, session_id: str) -> Optional[bytes]:
         with self._lock:
-            data = self._ckpts.get(session_id)
-            if data is not None:
-                self.restores += 1
+            entry = self._ckpts.get(session_id)
+            if entry is None:
+                return None
+            data, stored_at = entry
+            if self.ttl_seconds and \
+                    self._now() - stored_at >= self.ttl_seconds:
+                self._evict(session_id, "ttl")
+                return None
+            self._ckpts.move_to_end(session_id)
+            self._ckpts[session_id] = (data, self._now())
+            self.restores += 1
             return data
+
+    def sweep(self) -> int:
+        """TTL-expire orphaned checkpoints (called from the replica's
+        idle-GC cadence); returns how many were dropped."""
+        if not self.ttl_seconds:
+            return 0
+        with self._lock:
+            now = self._now()
+            stale = [sid for sid, (_, at) in self._ckpts.items()
+                     if now - at >= self.ttl_seconds]
+            for sid in stale:
+                self._evict(sid, "ttl")
+            return len(stale)
 
     def discard(self, session_id: str) -> None:
         with self._lock:
@@ -1300,6 +1356,9 @@ def _idle_gc_loop(stop: threading.Event,
     rep = _replica(replica)
     while not stop.wait(1.0):
         _reap_idle_sessions(replica=rep)
+        if rep.handoff is not None:
+            # TTL-expire orphaned fleet checkpoints on the same cadence
+            rep.handoff.sweep()
         if rep.idle_for(0.5):
             gc.collect()
 

@@ -249,6 +249,45 @@ class FleetSimulator:
                                      retry_budget=64.0, refund=1.0)
             sess._retry_tokens = sess.retry.retry_budget
             self.solver_session = sess
+        # anti-entropy chaos (requires `backend: tensor`): boot a
+        # StateAuditor on the provisioner's state plane when the scenario
+        # corrupts warm state, and a DeviceKiller + solver mesh when it
+        # kills devices. Both event kinds are deliberately UNLEDGERED
+        # (the per-replica rolling_restart precedent): the audit contract
+        # is that a chaos run's ledger digest equals the fault-free run's.
+        self.state_corruptor = None
+        self.state_auditor = None
+        self.device_killer = None
+        self._prev_device_chaos = None
+        kinds = {e.kind for e in scenario.events}
+        if "corrupt_state" in kinds:
+            from ..state.audit import StateAuditor
+            from ..utils.chaos import StateCorruptor
+            self.state_corruptor = StateCorruptor(seed=scenario.seed)
+            self.state_auditor = StateAuditor(
+                seed=scenario.seed, recorder=self.op.recorder,
+                flightrec=self.op.flightrec, now=self.clock.now)
+            self.state_auditor.attach(self.op.provisioner.state_plane)
+        if "kill_device" in kinds:
+            from ..ops import binpack
+            from ..parallel.mesh import make_solver_mesh
+            from ..utils.chaos import DeviceKiller
+            self.device_killer = DeviceKiller()
+            self._prev_device_chaos = binpack.install_device_chaos(
+                self.device_killer)
+            # the ladder needs a mesh to degrade from; decision parity is
+            # free (sharded_precompute is bit-identical to the host
+            # precompute for any mesh, pinned by the parity tests)
+            mesh = make_solver_mesh()
+            prov = self.op.provisioner
+            base_factory = prov.scheduler_factory
+
+            def mesh_factory(*a, **kw):
+                ts = base_factory(*a, **kw)
+                ts.mesh = mesh
+                return ts
+
+            prov.scheduler_factory = mesh_factory
         self.kwok.store = self.op.store
         # pre-install the drought schedule CLOCK so duration'd windows
         # (zonal outages) expire at their simulated instant
@@ -786,6 +825,30 @@ class FleetSimulator:
         for i in range(1, len(self.sidecar_replicas)):
             self._after(i * interval, lambda idx=i: restart(idx))
 
+    def _ev_corrupt_state(self, ev, t: float) -> None:
+        """Seeded warm-state corruption. NOT ledgered: the acceptance
+        contract is ledger-digest equality with the fault-free run — the
+        auditor must detect the fault before the corrupt entry is served
+        and quarantine-heal it without any decision difference, so the
+        only admissible trace is metrics/events, never the ledger."""
+        prov = self.op.provisioner
+        self.state_corruptor.corrupt(prov.state_plane,
+                                     handle=prov.problem_state,
+                                     layer=ev.params["layer"],
+                                     count=ev.params["count"])
+
+    def _ev_kill_device(self, ev, t: float) -> None:
+        """Device-loss window: solver device `device` (modulo the host
+        device count) dies now and revives after `duration`. NOT ledgered
+        — the degradation ladder must keep the decisions (hence the
+        ledger digest) identical to the fault-free run."""
+        import jax
+        ids = sorted(int(d.id) for d in jax.devices())
+        dev = ids[ev.params["device"] % len(ids)]
+        self.device_killer.kill(dev)
+        self._after(ev.params["duration"],
+                    lambda: self.device_killer.revive(dev))
+
     def _ev_slo(self, ev, t: float) -> None:
         watcher = self.op.slo
         budgets = dict(ev.params["budgets"])
@@ -820,6 +883,14 @@ class FleetSimulator:
         try:
             return self._run()
         finally:
+            if self.device_killer is not None:
+                # restore the process-global chaos hook and drop the
+                # per-device breakers this run may have opened — device
+                # identity (and with it breaker state) outlives the sim
+                from ..ops import binpack
+                from ..parallel import mesh as _mesh
+                binpack.install_device_chaos(self._prev_device_chaos)
+                _mesh.reset_device_breakers()
             if self.sidecar_server is not None:
                 if self.solver_session is not None:
                     self.solver_session.close()

@@ -129,6 +129,19 @@ def _capacity_type(v):
     return v
 
 
+# mirrors utils.chaos.StateCorruptor.LAYERS plus "all" (pick per injection);
+# kept literal so scenario validation stays import-light
+_STATE_LAYERS = ("node_rows", "group_rows", "exist_stack", "topo_memo",
+                 "warm_checkpoint", "all")
+
+
+def _state_layer(v):
+    v = _str(v)
+    if v not in _STATE_LAYERS:
+        raise TypeError("one of " + ", ".join(repr(s) for s in _STATE_LAYERS))
+    return v
+
+
 def _budgets(v):
     if not isinstance(v, dict) or not v:
         raise TypeError("a non-empty {span: seconds} mapping")
@@ -263,6 +276,26 @@ EVENT_KINDS: Dict[str, Dict[str, tuple]] = {
     "rolling_restart": {
         "interval": (_pos, False, 5.0),
         "drain_grace": (_nonneg, False, 0.5),
+    },
+    # anti-entropy chaos (requires `backend: tensor`): flip / stale / truncate
+    # `count` cached entries in the named warm-state `layer` ("all" picks a
+    # layer per injection) — the StateAuditor must detect every one before
+    # the corrupt entry is served and quarantine-heal within the pass.
+    # Deliberately unledgered: a run with corrupt_state events must produce
+    # a ledger digest identical to the fault-free run (the audit contract).
+    "corrupt_state": {
+        "layer": (_state_layer, False, "all"),
+        "count": (_count, False, 1),
+    },
+    # device-loss window (requires `backend: tensor`): solver device `device`
+    # (modulo the host device count) dies at `at` and revives after
+    # `duration`; mesh solves inside the window must complete through the
+    # degradation ladder (surviving carve / single device) with identical
+    # decisions. Unledgered for the same digest-parity contract as
+    # corrupt_state.
+    "kill_device": {
+        "device": (_replicas, False, 0),
+        "duration": (_pos, True, None),
     },
 }
 
@@ -566,6 +599,16 @@ def parse_scenario(data, source: str = "<dict>") -> Scenario:
             ctx.fail("'replicas' requires 'backend: sidecar' (there is no "
                      "fleet to replicate on the tensor backend)",
                      key_lines.get("replicas", line))
+    else:
+        # state chaos targets the in-process warm state plane and the
+        # solver device mesh; on the sidecar backend both live across the
+        # wire and the window would silently do nothing — reject the
+        # typo'd experiment the same way wire_chaos is rejected above
+        for ev in events:
+            if ev.kind in ("corrupt_state", "kill_device"):
+                ctx.fail(f"{ev.kind} event at t={ev.at:g}s requires "
+                         "'backend: tensor' (state chaos targets the "
+                         "in-process state plane and device mesh)", ev.line)
     if not top["replicas"]:
         # rolling_restart drains through the fleet handoff store; with no
         # fleet there is nothing to migrate to and the event would silently

@@ -131,6 +131,7 @@ from ..api import labels as api_labels
 from ..ops import encode as enc
 from ..scheduling.requirements import Requirements, label_requirements
 from ..utils import resources as res
+from . import audit as _audit
 
 # bound on signature-keyed caches: distinct deployment shapes seen across
 # the plane's lifetime. Past it the cache clears wholesale (simple + rare:
@@ -189,6 +190,10 @@ class EncodePlane:
 
     def __init__(self, name: str = "private"):
         self.name = name
+        # optional StateAuditor (state/audit.py): when attached, every row
+        # serve is digest-verified and each pass runs sampled shadow
+        # audits; None keeps the pre-audit fast path byte-identical
+        self.auditor = None
         # monotonic revision for wire-backed cluster views (sidecar): the
         # plane IS the `cluster` object on the session's WireClusterView
         self.topo_revision = 0
@@ -253,54 +258,118 @@ class EncodePlane:
                 cache.ds_token = ds_token
         return cache
 
+    def _encode_node_row(self, vocab, zone_key: int, sn, daemonset_pods,
+                         rev, remaining_daemons) -> tuple:
+        """Cold-encode ONE node row (the auditor's shadow audits reuse
+        exactly this path, so a shadow compare is a true cold replay)."""
+        reqs = label_requirements(sn.labels())
+        known = Requirements(
+            r for r in reqs.values()
+            if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
+            in vocab.key_idx)
+        avail = res.subtract(
+            sn.available(), remaining_daemons(sn, daemonset_pods))
+        z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
+        return (rev,
+                enc.encode_requirements(vocab, known),
+                enc.encode_resource_vector(vocab, avail, capacity=True),
+                vocab.value_idx[zone_key].get(z, -1),
+                sn.taints())
+
+    def _quarantine_node_layer(self, cache: _NodeCache, auditor) -> None:
+        """Per-layer quarantine: one corrupted row means neither
+        generation (nor any stack built from them) can be trusted — drop
+        them all and rebuild cold within the same pass."""
+        cache.cur = {}
+        cache.prev = {}
+        cache.stacks.clear()
+        auditor.quarantine_stacks()
+
     def node_rows(self, vocab, zone_key: int, state_nodes, daemonset_pods,
                   ds_token: tuple, exist_shards: int, subscriber: str
                   ) -> tuple:
         """(exist_enc, exist_avail, exist_zone, taint_lists, exist_token,
         reencoded, shard_tokens, shard_dirty) — byte-identical to what
         build_problem's cold path constructs, with only dirty rows
-        re-encoded ONCE for every subscriber."""
+        re-encoded ONCE for every subscriber. With an auditor attached,
+        rows carry a trailing content digest (consumers index fields 0-4,
+        so the extra element is invisible to them) verified on every
+        serve; a mismatch quarantines the layer and the outer loop
+        restarts ONCE over the now-cold caches — the second attempt
+        re-encodes everything, so it cannot quarantine again."""
         from ..provisioning.tensor_scheduler import (_node_remaining_daemons,
                                                      _pow2_bucket)
+        auditor = self.auditor
         cache = self._node_cache(vocab, ds_token)
-        cur, prev = cache.cur, cache.prev
-        reencoded = 0
-        dirty_idx: List[int] = []
-        fresh: Dict[tuple, tuple] = {}
-        keys = []
-        for i, sn in enumerate(state_nodes):
-            # cache key (name, identity); row-validity token (identity,
-            # revision). The identity distinguishes both a deleted-and-
-            # recreated node under the same name (whose replayed event
-            # sequence can land on the same revision count) and two live
-            # StateNodes sharing a name (placeholder + claim entries) —
-            # name alone would alias their rows in the stacked tensors.
-            key = (sn.name(), getattr(sn, "identity", None))
-            keys.append(key)
-            rev = (key[1], getattr(sn, "revision", None))
-            row = cur.get(key)
-            if row is None:
-                row = prev.get(key)
-            if row is None or rev[0] is None or rev[1] is None \
-                    or row[0] != rev:
-                reqs = label_requirements(sn.labels())
-                known = Requirements(
-                    r for r in reqs.values()
-                    if api_labels.NORMALIZED_LABELS.get(r.key, r.key)
-                    in vocab.key_idx)
-                avail = res.subtract(
-                    sn.available(),
-                    _node_remaining_daemons(sn, daemonset_pods))
-                z = sn.labels().get(api_labels.LABEL_TOPOLOGY_ZONE, "")
-                row = (rev,
-                       enc.encode_requirements(vocab, known),
-                       enc.encode_resource_vector(vocab, avail,
-                                                  capacity=True),
-                       vocab.value_idx[zone_key].get(z, -1),
-                       sn.taints())
-                reencoded += 1
-                dirty_idx.append(i)
-            fresh[key] = row
+        for _attempt in (0, 1):
+            cur, prev = cache.cur, cache.prev
+            reencoded = 0
+            dirty_idx: List[int] = []
+            fresh: Dict[tuple, tuple] = {}
+            keys = []
+            quarantined = False
+            for i, sn in enumerate(state_nodes):
+                # cache key (name, identity); row-validity token (identity,
+                # revision). The identity distinguishes both a deleted-and-
+                # recreated node under the same name (whose replayed event
+                # sequence can land on the same revision count) and two live
+                # StateNodes sharing a name (placeholder + claim entries) —
+                # name alone would alias their rows in the stacked tensors.
+                key = (sn.name(), getattr(sn, "identity", None))
+                keys.append(key)
+                rev = (key[1], getattr(sn, "revision", None))
+                row = cur.get(key)
+                if row is None:
+                    row = prev.get(key)
+                if row is None or rev[0] is None or rev[1] is None \
+                        or row[0] != rev:
+                    row = self._encode_node_row(vocab, zone_key, sn,
+                                                daemonset_pods, rev,
+                                                _node_remaining_daemons)
+                    if auditor is not None:
+                        row = row + (_audit.row_digest(row),)
+                    reencoded += 1
+                    dirty_idx.append(i)
+                elif auditor is not None and len(row) > 5 \
+                        and _audit.row_digest(row) != row[5]:
+                    auditor.incident("node_rows",
+                                     f"row {key[0]!r} failed its serve-time "
+                                     "digest")
+                    self._quarantine_node_layer(cache, auditor)
+                    quarantined = True
+                    break
+                elif auditor is not None and len(row) <= 5:
+                    # adopted: encoded while no auditor was attached, so
+                    # digest it on first audited serve (verify_group's
+                    # adopt semantics) — from here on it is verifiable
+                    row = row + (_audit.row_digest(row),)
+                fresh[key] = row
+            if not quarantined and auditor is not None \
+                    and reencoded < len(state_nodes):
+                # sampled shadow audit: re-encode K clean rows cold and
+                # byte-compare — catches a row whose digest was recorded
+                # over already-wrong content (the lazy check cannot)
+                dirty = set(dirty_idx)
+                clean = [i for i in range(len(state_nodes))
+                         if i not in dirty]
+                for j in auditor.sample_indices(len(clean)):
+                    i = clean[j]
+                    sn = state_nodes[i]
+                    row = fresh[keys[i]]
+                    cold = self._encode_node_row(vocab, zone_key, sn,
+                                                 daemonset_pods, row[0],
+                                                 _node_remaining_daemons)
+                    if _audit.row_digest(cold) != _audit.row_digest(row):
+                        auditor.incident(
+                            "node_rows",
+                            f"row {sn.name()!r} diverged from its cold "
+                            "shadow re-encode")
+                        self._quarantine_node_layer(cache, auditor)
+                        quarantined = True
+                        break
+                    auditor.audited("node_rows")
+            if not quarantined:
+                break
         cache.prev = cache.cur
         cache.cur = fresh
         self.stats["node_rows_encoded"] += reencoded
@@ -346,6 +415,17 @@ class EncodePlane:
                         value=real - d)
             shard_tokens = tuple(toks)
         stack = cache.stacks.get(exist_token)
+        if stack is not None and auditor is not None:
+            # the slot digest guards the stacked tensors themselves: rows
+            # are verified above, but a stack is a cached COPY of them
+            if auditor.verify_stack(exist_token, stack):
+                auditor.audited("exist_stack")
+            else:
+                auditor.incident("exist_stack",
+                                 f"slot of {N} rows failed its digest")
+                cache.stacks.clear()
+                auditor.quarantine_stacks()
+                stack = None
         if stack is not None:
             cache.stacks.move_to_end(exist_token)
             self.stats["stack_hits"] += 1
@@ -369,6 +449,8 @@ class EncodePlane:
         while len(cache.stacks) > MAX_STACKS:
             cache.stacks.popitem(last=False)
         self.stats["stack_builds"] += 1
+        if auditor is not None:
+            auditor.record_stack(exist_token, stack)
         return stack + (exist_token, reencoded, shard_tokens, shard_dirty)
 
     # -- group rows ----------------------------------------------------------
@@ -385,7 +467,31 @@ class EncodePlane:
                 self._group_caches.popitem(last=False)
         else:
             self._group_caches.move_to_end(vocab)
+        auditor = self.auditor
         row = rows.get(sig)
+        if row is not None and auditor is not None:
+            # lazy digest check on reuse; group rows must stay 2-tuples
+            # (callers unpack them), so digests live in the auditor's
+            # side table rather than on the row
+            if not auditor.verify_group(vocab, sig, row):
+                auditor.incident("group_rows",
+                                 "cached row failed its serve-time digest")
+                rows.clear()
+                auditor.quarantine_groups(vocab)
+                row = None
+            elif auditor.take_group_audit():
+                cold = (enc.encode_requirements(vocab, g.requirements),
+                        enc.encode_resource_vector(vocab, g.requests,
+                                                   capacity=False))
+                if _audit.content_digest(cold) != _audit.content_digest(row):
+                    auditor.incident(
+                        "group_rows",
+                        "cached row diverged from its cold shadow re-encode")
+                    rows.clear()
+                    auditor.quarantine_groups(vocab)
+                    row = None
+                else:
+                    auditor.audited("group_rows")
         if row is not None:
             self.stats["group_rows_shared"] += 1
             STATE_PLANE_ROWS.inc({"subscriber": subscriber,
@@ -397,6 +503,8 @@ class EncodePlane:
                enc.encode_resource_vector(vocab, g.requests,
                                           capacity=False))
         rows[sig] = row
+        if auditor is not None:
+            auditor.record_group(vocab, sig, row)
         self.stats["group_rows_encoded"] += 1
         STATE_PLANE_ROWS.inc({"subscriber": subscriber,
                               "outcome": "reencoded"})
@@ -423,20 +531,31 @@ class EncodePlane:
     # -- introspection (/debug/stateplane) -----------------------------------
 
     def debug_view(self) -> dict:
+        # iterate COPIED views: the owning solver loop mutates these
+        # OrderedDicts mid-pass while the /debug/stateplane HTTP thread
+        # renders them (the caller still retries a lost race, see
+        # operator/server._debug_stateplane)
         node_caches = []
-        for vocab, cache in self._node_caches.items():
+        for vocab, cache in list(self._node_caches.items()):
             node_caches.append({
                 "vocab": hex(id(vocab)),
                 "rows_cur": len(cache.cur), "rows_prev": len(cache.prev),
                 "stacks": len(cache.stacks),
             })
-        return {
+        view = {
             "name": self.name,
             "subscribers": dict(self.subscribers),
             "topo_revision": self.topo_revision,
             "node_caches": node_caches,
             "group_rows": {hex(id(v)): len(rows)
-                           for v, rows in self._group_caches.items()},
+                           for v, rows in list(self._group_caches.items())},
             "topo_tokens": len(self._topo_memos),
             "stats": dict(self.stats),
         }
+        if self.auditor is not None:
+            view["audit"] = {
+                "passes": self.auditor.passes,
+                "incidents": len(self.auditor.incidents),
+                "stats": dict(self.auditor.stats),
+            }
+        return view

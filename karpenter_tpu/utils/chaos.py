@@ -259,3 +259,209 @@ def chaos_pause(injector: Optional[FaultInjector]):
         yield
     finally:
         injector.enabled = prev
+
+
+class DeviceKiller:
+    """Seeded device-kill verdict source for the mesh dispatch path
+    (installed via ops/binpack.install_device_chaos). ``kill``/``revive``
+    toggle a device's liveness; ``verdict(ids)`` returns the first dead
+    device among a dispatch's participants (counting the hit) or None —
+    the dispatch then raises DeviceLossError for it, driving the
+    degradation ladder exactly the way a real mid-solve chip loss would."""
+
+    def __init__(self):
+        self.dead: set = set()
+        self.counts: Counter = Counter()
+        self.enabled = True
+
+    def kill(self, device_id: int) -> None:
+        self.dead.add(int(device_id))
+
+    def revive(self, device_id: int) -> None:
+        self.dead.discard(int(device_id))
+
+    def verdict(self, device_ids) -> Optional[int]:
+        if not self.enabled or not self.dead:
+            return None
+        for did in device_ids:
+            if int(did) in self.dead:
+                self.counts[int(did)] += 1
+                return int(did)
+        return None
+
+
+class StateCorruptor:
+    """Seeded corruption of the warm solver state: the chaos half of the
+    anti-entropy loop (state/audit.py detects, quarantines, heals what
+    this injects). Targets the live caches of one EncodePlane (and the
+    warm-pack seed of one ProblemState handle) with three fault kinds:
+
+    - ``bit_flip``  — one byte of a cached ndarray flipped IN PLACE;
+    - ``stale_value`` — an entry's content replaced while its validity
+      token (and any recorded digest) is kept, the silently-stale-row
+      failure mode token checks alone can never catch;
+    - ``truncate`` — an array shortened, the torn-write analog.
+
+    Every fault lands on the CURRENT serve path (cur-generation node rows,
+    resident stacks, live memo entries, the live seed) so an attached
+    auditor must detect 100% of them before the entry is served; the
+    prev-generation and dead-token cases are pinned by directed tests.
+    ``corrupt`` returns the injected records; with no candidates in a
+    layer nothing is injected (and nothing counted)."""
+
+    LAYERS = ("node_rows", "group_rows", "exist_stack", "topo_memo",
+              "warm_checkpoint")
+    KINDS = ("bit_flip", "stale_value", "truncate")
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.counts: Counter = Counter()
+        self.injected: list = []
+
+    # -- array mutation helpers ----------------------------------------------
+
+    def _flip(self, arr) -> bool:
+        import numpy as np
+        try:
+            flat = arr.view(np.uint8).reshape(-1)
+        except (ValueError, AttributeError):
+            return False
+        if not flat.size:
+            return False
+        flat[self.rng.randrange(flat.size)] ^= 0xFF
+        return True
+
+    def _arrays_in(self, obj, out) -> None:
+        import numpy as np
+        if isinstance(obj, np.ndarray):
+            if obj.size:
+                out.append(obj)
+        elif isinstance(obj, (tuple, list)):
+            for item in obj:
+                self._arrays_in(item, out)
+        elif isinstance(obj, dict):
+            for item in obj.values():
+                self._arrays_in(item, out)
+        elif hasattr(obj, "__dict__"):
+            for item in vars(obj).values():
+                self._arrays_in(item, out)
+
+    # -- per-layer injections ------------------------------------------------
+
+    def _corrupt_node_rows(self, plane, kind: str) -> Optional[dict]:
+        caches = [c for c in plane._node_caches.values() if c.cur]
+        if not caches:
+            return None
+        cache = self.rng.choice(caches)
+        key = self.rng.choice(sorted(cache.cur, key=repr))
+        row = cache.cur[key]
+        if kind == "bit_flip":
+            if not self._flip(row[2]):
+                return None
+        elif kind == "stale_value":
+            # zone index perturbed; rev (row[0]) and any digest kept
+            cache.cur[key] = row[:3] + (int(row[3]) + 1,) + row[4:]
+        else:
+            cache.cur[key] = row[:2] + (row[2][:-1],) + row[3:]
+        return {"layer": "node_rows", "kind": kind, "key": key[0]}
+
+    def _corrupt_group_rows(self, plane, kind: str) -> Optional[dict]:
+        import numpy as np
+        tables = [t for t in plane._group_caches.values() if t]
+        if not tables:
+            return None
+        rows = self.rng.choice(tables)
+        sig = self.rng.choice(sorted(rows, key=repr))
+        enc_row, req_vec = rows[sig]
+        if kind == "bit_flip":
+            if not self._flip(req_vec):
+                return None
+        elif kind == "stale_value":
+            rows[sig] = (enc_row, req_vec + np.float64(1.0))
+        else:
+            rows[sig] = (enc_row, req_vec[:-1])
+        return {"layer": "group_rows", "kind": kind}
+
+    def _corrupt_exist_stack(self, plane, kind: str) -> Optional[dict]:
+        caches = [c for c in plane._node_caches.values() if c.stacks]
+        if not caches:
+            return None
+        stacks = self.rng.choice(caches).stacks
+        token = next(reversed(stacks))  # the most recently served slot
+        exist_enc, exist_avail, exist_zone, taints = stacks[token]
+        if kind == "bit_flip":
+            if not self._flip(exist_avail):
+                return None
+        elif kind == "stale_value":
+            stacks[token] = (exist_enc, exist_avail + 1.0, exist_zone,
+                             taints)
+        else:
+            stacks[token] = (exist_enc, exist_avail[:-1], exist_zone,
+                             taints)
+        return {"layer": "exist_stack", "kind": kind}
+
+    def _corrupt_topo_memo(self, plane, kind: str) -> Optional[dict]:
+        memos = [m for m in plane._topo_memos.values() if m]
+        if not memos:
+            return None
+        memo = memos[-1]  # the most recently proven token's entries
+        sig = self.rng.choice(sorted(memo, key=repr))
+        entry = memo[sig]
+        if kind == "bit_flip":
+            if not self._flip(entry[0]):
+                return None
+        elif kind == "stale_value":
+            memo[sig] = entry[:2] + (int(entry[2]) + 1,) + entry[3:]
+        else:
+            memo[sig] = (entry[0][:-1],) + entry[1:]
+        return {"layer": "topo_memo", "kind": kind}
+
+    def _corrupt_warm_checkpoint(self, handle, kind: str) -> Optional[dict]:
+        if handle is None:
+            return None
+        arrays: list = []
+        for seed in [handle.seed] + list(handle.shard_seeds or []):
+            if seed is None:
+                continue
+            for ck in getattr(seed, "checkpoints", ()) or ():
+                self._arrays_in(ck.rows, arrays)
+                self._arrays_in(ck.exist_avail, arrays)
+        if not arrays:
+            return None
+        # every warm fault is an in-place flip: the seed's digest was
+        # recorded by finish_pack, so any content change is detectable —
+        # the kind only varies which failure mode produced it
+        if not self._flip(self.rng.choice(arrays)):
+            return None
+        return {"layer": "warm_checkpoint", "kind": "bit_flip"}
+
+    # -- driver --------------------------------------------------------------
+
+    def corrupt(self, plane, handle=None, layer: str = "all",
+                count: int = 1) -> list:
+        """Inject up to ``count`` seeded faults into ``plane`` (and
+        ``handle``'s warm seed for the warm_checkpoint layer). Returns the
+        records actually injected; layers with no live candidates are
+        skipped (nothing counted), so detection assertions can compare
+        against the return value exactly."""
+        injectors = {
+            "node_rows": lambda k: self._corrupt_node_rows(plane, k),
+            "group_rows": lambda k: self._corrupt_group_rows(plane, k),
+            "exist_stack": lambda k: self._corrupt_exist_stack(plane, k),
+            "topo_memo": lambda k: self._corrupt_topo_memo(plane, k),
+            "warm_checkpoint":
+                lambda k: self._corrupt_warm_checkpoint(handle, k),
+        }
+        out = []
+        for _ in range(count):
+            layers = list(self.LAYERS) if layer == "all" else [layer]
+            self.rng.shuffle(layers)
+            kind = self.rng.choice(self.KINDS)
+            for name in layers:
+                rec = injectors[name](kind)
+                if rec is not None:
+                    self.counts[rec["layer"]] += 1
+                    self.injected.append(rec)
+                    out.append(rec)
+                    break
+        return out

@@ -46,3 +46,8 @@ def pytest_configure(config):
         "consistent-hash failover, rolling restarts across N in-process "
         "replicas — deterministic; tier-1 eligible except soaks that also "
         "carry `slow`)")
+    config.addinivalue_line(
+        "markers",
+        "audit: anti-entropy tests (seeded state corruption + device-loss "
+        "chaos against the StateAuditor and the degradation ladder — "
+        "deterministic: fixed seeds, fake clock — tier-1 eligible)")

@@ -286,3 +286,69 @@ class TestFleetSimSmoke:
         byte-identical scheduling truth."""
         assert (self._run()["ledger_digest"]
                 == self._run(replicas=1)["ledger_digest"])
+
+
+class TestHandoffStoreBounds:
+    """ISSUE 20 satellite: the shared checkpoint plane is BOUNDED. Before
+    this, an orphaned session (owner died without a successor ever
+    touching the checkpoint) pinned fleet-sized state forever; now the
+    store LRU-evicts past max_entries and TTL-expires stale entries both
+    lazily on read and from the idle-GC sweep — every eviction counted
+    on karpenter_sidecar_handoff_evicted_total{reason}."""
+
+    def _metric(self, reason):
+        from karpenter_tpu.metrics.registry import SIDECAR_HANDOFF_EVICTED
+        return SIDECAR_HANDOFF_EVICTED.value({"reason": reason})
+
+    def test_cap_evicts_least_recently_used(self):
+        store = srv.HandoffStore(max_entries=3, ttl_seconds=0)
+        before = self._metric("cap")
+        for i in range(3):
+            store.put(f"s{i}", b"ck%d" % i)
+        assert store.get("s0") == b"ck0"  # refresh: s1 is now the LRU
+        store.put("s3", b"ck3")
+        assert len(store) == 3
+        assert store.get("s1") is None, "cap eviction must drop the LRU"
+        assert store.get("s0") == b"ck0"
+        assert store.evicted == 1
+        assert self._metric("cap") == before + 1
+
+    def test_ttl_expires_lazily_on_get(self):
+        clock = {"t": 0.0}
+        store = srv.HandoffStore(max_entries=8, ttl_seconds=60,
+                                 now=lambda: clock["t"])
+        before = self._metric("ttl")
+        store.put("sess", b"ck")
+        clock["t"] = 59.0
+        assert store.get("sess") == b"ck"
+        # the restore refreshed the TTL clock: still alive at t=118
+        clock["t"] = 118.0
+        assert store.get("sess") == b"ck"
+        clock["t"] = 178.0
+        assert store.get("sess") is None
+        assert len(store) == 0
+        assert self._metric("ttl") == before + 1
+
+    def test_sweep_expires_orphans_in_bulk(self):
+        clock = {"t": 0.0}
+        store = srv.HandoffStore(max_entries=8, ttl_seconds=60,
+                                 now=lambda: clock["t"])
+        before = self._metric("ttl")
+        for i in range(4):
+            store.put(f"s{i}", b"ck")
+        clock["t"] = 30.0
+        store.put("fresh", b"ck")
+        clock["t"] = 61.0
+        assert store.sweep() == 4
+        assert len(store) == 1 and store.get("fresh") == b"ck"
+        assert store.evicted == 4
+        assert self._metric("ttl") == before + 4
+
+    def test_zero_ttl_disables_expiry(self):
+        clock = {"t": 0.0}
+        store = srv.HandoffStore(max_entries=8, ttl_seconds=0,
+                                 now=lambda: clock["t"])
+        store.put("sess", b"ck")
+        clock["t"] = 1e9
+        assert store.sweep() == 0
+        assert store.get("sess") == b"ck"

@@ -58,13 +58,15 @@ class TestSimRegressionGate:
     def test_all_library_scenarios_are_pinned(self, pin):
         """The golden covers every library pin: mixed-day, the ISSUE-14
         disruption-wave (drift + expiration waves through the streaming
-        engine), and the ISSUE-17 service-fleet roll (replicated sidecar
+        engine), the ISSUE-17 service-fleet roll (replicated sidecar
         kill + rolling restart — the digest must not depend on the
         replica count, so the fleet run is part of the byte-exact
-        contract)."""
+        contract), and the ISSUE-20 state-chaos run (corrupt_state +
+        kill_device windows — unledgered, so the digest must equal a
+        fault-free run's)."""
         names = {p["scenario"] for p in pin["pins"]}
         assert names == {"mixed-day.yaml", "disruption-wave.yaml",
-                         "service-fleet.yaml"}
+                         "service-fleet.yaml", "state-chaos.yaml"}
 
     def test_report_shape_covers_new_sections(self, pin):
         """The ISSUE-12 report sections are part of the pinned shape: the

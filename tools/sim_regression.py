@@ -35,6 +35,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # runnable from anywhere, venv or not
     sys.path.insert(0, REPO)
+# state-chaos pins a device-loss window: its digest-parity contract needs
+# >= 2 surviving solver devices (one survivor short of that, the ladder
+# exhausts into the host oracle and the solve ledger's `fallback` field
+# diverges from the fault-free run). Match the tests/conftest.py device
+# count BEFORE jax is first imported; a no-op when conftest already did.
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = \
+        (_xla + " --xla_force_host_platform_device_count=8").strip()
 GOLDEN_PATH = os.path.join(REPO, "tests", "goldens", "sim-regression.json")
 SCENARIO = "mixed-day.yaml"
 CLIP_SECONDS = 7200.0
@@ -43,9 +52,11 @@ CLIP_SECONDS = 7200.0
 # drift wave so the streaming disruption engine's decisions are part of
 # the byte-exact contract, service-fleet (ISSUE 17) pins the 3-replica
 # sidecar fleet — checkpoint restores, kills and the rolling restart must
-# stay invisible to scheduling truth
+# stay invisible to scheduling truth, state-chaos (ISSUE 20) pins the
+# anti-entropy contract — corruption quarantine and the device-loss
+# ladder must leave the ledger byte-identical to a fault-free timeline
 SCENARIOS = ((SCENARIO, CLIP_SECONDS), ("disruption-wave.yaml", 9000.0),
-             ("service-fleet.yaml", 7200.0))
+             ("service-fleet.yaml", 7200.0), ("state-chaos.yaml", 2400.0))
 
 # report sections whose KEYS are data (shape classes seen, event kinds
 # applied, ...): compared as opaque "dict" leaves, not recursed — their
